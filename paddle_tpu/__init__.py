@@ -1,0 +1,43 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid v1.7, built on JAX/XLA/Pallas/pjit.
+
+Architecture (vs the reference at /root/reference):
+  * Program IR (core/ir.py) mirrors ProgramDesc's structure, but whole blocks
+    compile to single XLA computations (core/executor.py) instead of per-op
+    kernel dispatch.
+  * One jax lowering rule per op (ops/) replaces per-(place,dtype,layout)
+    kernels; grads are synthesized from lowerings via jax.vjp (core/backward.py).
+  * Distribution is mesh-sharding (compiler.py, parallel/) instead of NCCL
+    op-handles; collectives ride ICI via GSPMD/shard_map.
+"""
+
+from paddle_tpu.core import (
+    CPUPlace,
+    TPUPlace,
+    Program,
+    Scope,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    name_scope,
+    program_guard,
+    scope_guard,
+    is_compiled_with_tpu,
+)
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.backward import append_backward, gradients
+import paddle_tpu.ops  # noqa: F401  (registers the op library)
+from paddle_tpu import layers
+from paddle_tpu import initializer
+from paddle_tpu import optimizer
+from paddle_tpu import regularizer
+from paddle_tpu import clip
+from paddle_tpu.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu.layers.tensor import data
+from paddle_tpu.utils.flags import set_flags, get_flags
+
+# Alias namespace matching the reference's `fluid` surface
+CUDAPlace = TPUPlace  # source compatibility: device index semantics match
+
+__version__ = "0.1.0"

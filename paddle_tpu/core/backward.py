@@ -1,0 +1,362 @@
+"""Tape-free autodiff by program rewriting.
+
+The same architecture as the reference's append_backward
+(reference: python/paddle/fluid/backward.py:1139 — walk forward ops in
+reverse, emit grad OpDescs, sum-aggregate repeated gradients :361) with one
+TPU-native twist: instead of 560 hand-written grad kernels, the grad op for
+any forward op is synthesized from its jax lowering rule via jax.vjp at
+lowering time (see synthesize_grad_op_def). Because the whole block compiles
+as one XLA computation, the recomputed forward primals inside each vjp are
+CSE'd against the forward pass — zero duplicate FLOPs after XLA optimization.
+Ops can still override with a hand-written grad lowering
+(register_grad, the analog of reference grad_op_desc_maker.h), e.g. dropout
+reusing its saved mask.
+
+Grad op calling convention (desc-level):
+  type:    f"{fwd_type}_grad"
+  inputs:  every forward input slot, every forward output slot, plus
+           f"{out_slot}@GRAD" per forward output slot that has a gradient
+  outputs: f"{in_slot}@GRAD" per forward input slot needing a gradient
+  attrs:   forward attrs + __fwd_inputs__/__fwd_outputs__ slot lists
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.ir import Parameter
+from paddle_tpu.core.registry import OpDef, OpRegistry
+from paddle_tpu.utils.enforce import EnforceError, enforce
+
+_OP_ROLE_FORWARD = 0
+_OP_ROLE_BACKWARD = 1
+_OP_ROLE_OPTIMIZE = 2
+_OP_ROLE_LOSS = 256
+
+
+# ---------------------------------------------------------------------------
+# generic grad lowering via jax.vjp
+# ---------------------------------------------------------------------------
+
+
+def _is_diff(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def make_generic_grad_lowering(base):
+    def lower(ins, attrs):
+        fwd_in_slots = [s for s in attrs["__fwd_inputs__"] if s in ins]
+        fwd_out_slots = attrs["__fwd_outputs__"]
+        fwd_ins = {s: ins[s] for s in fwd_in_slots}
+        diff_slots = [
+            s
+            for s in fwd_in_slots
+            if s not in base.nondiff_inputs and all(_is_diff(x) for x in fwd_ins[s])
+        ]
+        if not diff_slots:
+            return {}
+        frozen = {s: fwd_ins[s] for s in fwd_in_slots if s not in diff_slots}
+        clean_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
+
+        def f(diff_part):
+            full = dict(frozen)
+            full.update(diff_part)
+            if "__rng_key__" in ins:
+                full["__rng_key__"] = ins["__rng_key__"]
+            outs = base.lower(full, clean_attrs)
+            result = {}
+            for s in fwd_out_slots:
+                if s in outs:
+                    vals = outs[s]
+                    result[s] = list(vals) if isinstance(vals, (list, tuple)) else [vals]
+            return result
+
+        primal_in = {s: list(fwd_ins[s]) for s in diff_slots}
+        primal_out, vjp = jax.vjp(f, primal_in)
+        cotangents = {}
+        for s, primals in primal_out.items():
+            gslot = f"{s}@GRAD"
+            given = ins.get(gslot)
+            cots = []
+            for i, p in enumerate(primals):
+                if given is not None and i < len(given) and given[i] is not None:
+                    cots.append(given[i].astype(p.dtype))
+                else:
+                    cots.append(jnp.zeros_like(p))
+            cotangents[s] = cots
+        (gins,) = vjp(cotangents)
+        return {f"{s}@GRAD": gins[s] for s in diff_slots}
+
+    return lower
+
+
+_GRAD_DEF_CACHE = {}
+
+
+def resolve_op_def(op_type):
+    """Registry lookup that lazily synthesizes `<type>_grad` defs."""
+    if OpRegistry.has(op_type):
+        return OpRegistry.get(op_type)
+    if op_type.endswith("_grad"):
+        cached = _GRAD_DEF_CACHE.get(op_type)
+        if cached is not None:
+            return cached
+        base_type = op_type[: -len("_grad")]
+        if OpRegistry.has(base_type):
+            base = OpRegistry.get(base_type)
+            lower = base.grad if base.grad is not None else make_generic_grad_lowering(base)
+            gdef = OpDef(op_type, lower, stateful=base.stateful)
+            _GRAD_DEF_CACHE[op_type] = gdef
+            return gdef
+    raise EnforceError(f"op {op_type} is not registered")
+
+
+# ---------------------------------------------------------------------------
+# append_backward
+# ---------------------------------------------------------------------------
+
+
+def _requires_grad_vars(block, ops, no_grad_set):
+    """Forward propagation of the requires-grad property."""
+    produced = {n for op in ops for n in op.output_names()}
+    requires = set()
+    for v in block.vars.values():
+        if v.name in no_grad_set:
+            continue
+        if isinstance(v, Parameter) and v.trainable:
+            requires.add(v.name)
+        elif not v.stop_gradient and v.name not in produced:
+            # leaf inputs explicitly marked differentiable (gradients() API)
+            requires.add(v.name)
+    for op in ops:
+        if any(n in requires for n in op.input_names()):
+            for n in op.output_names():
+                v = block._find_var_recursive(n)
+                if n in no_grad_set or (v is not None and v.stop_gradient):
+                    continue
+                requires.add(n)
+    return requires
+
+
+def _create_grad_var(block, fwd_name, grad_name):
+    if grad_name in block.vars:
+        return block.vars[grad_name]
+    fwd = block._find_var_recursive(fwd_name)
+    return block.create_var(
+        name=grad_name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else "float32",
+        persistable=False,
+        stop_gradient=True,
+    )
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """Append grad ops for `loss` to its program; returns [(param, grad)].
+
+    reference: python/paddle/fluid/backward.py:1139.
+    """
+    block = loss.block
+    program = block.program
+    no_grad_set = set(no_grad_set or ())
+    enforce(
+        loss.shape is None or all(d == 1 or d == -1 for d in loss.shape),
+        f"loss must be scalar-like, got shape {loss.shape}",
+    )
+
+    fwd_ops = list(block.ops)
+    # find the op producing the loss; everything after it is irrelevant
+    loss_op_idx = None
+    for i in reversed(range(len(fwd_ops))):
+        if loss.name in fwd_ops[i].output_names():
+            loss_op_idx = i
+            break
+    enforce(loss_op_idx is not None, f"loss var {loss.name} has no producer op")
+    fwd_ops = fwd_ops[: loss_op_idx + 1]
+    if fwd_ops:
+        fwd_ops[-1].attrs["op_role"] = _OP_ROLE_LOSS
+
+    requires = _requires_grad_vars(block, fwd_ops, no_grad_set)
+
+    # relevance: ops on a path from requires-grad vars to the loss
+    pending = {loss.name}
+    relevant = []
+    for op in reversed(fwd_ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        if any(n in pending for n in op.output_names()) and any(
+            n in requires for n in op.input_names()
+        ):
+            relevant.append(op)
+            pending.update(n for n in op.input_names() if n in requires)
+    relevant_set = set(id(op) for op in relevant)
+
+    # partial-gradient bookkeeping: var -> list of partial grad var names
+    partials = {}
+
+    def finalize(name):
+        """Collapse partial grads for `name` into the canonical `name@GRAD`,
+        inserting a sum op when there are multiple contributions
+        (reference: python/paddle/fluid/backward.py:361)."""
+        canonical = name + "@GRAD"
+        plist = partials.get(name)
+        if not plist:
+            return None
+        if len(plist) == 1:
+            if plist[0] != canonical:
+                _create_grad_var(block, name, canonical)
+                block.append_op(
+                    "assign",
+                    inputs={"X": [plist[0]]},
+                    outputs={"Out": [canonical]},
+                    attrs={"op_role": _OP_ROLE_BACKWARD},
+                )
+            partials[name] = [canonical]
+            return canonical
+        _create_grad_var(block, name, canonical)
+        block.append_op(
+            "sum",
+            inputs={"X": list(plist)},
+            outputs={"Out": [canonical]},
+            attrs={"op_role": _OP_ROLE_BACKWARD},
+        )
+        partials[name] = [canonical]
+        return canonical
+
+    def add_partial(name, producing_op_hint):
+        canonical = name + "@GRAD"
+        existing = partials.setdefault(name, [])
+        pname = canonical if not existing else f"{name}@GRAD@RENAME@{len(existing)}"
+        existing.append(pname)
+        _create_grad_var(block, name, pname)
+        return pname
+
+    # seed: d loss / d loss = 1
+    loss_grad_name = loss.name + "@GRAD"
+    _create_grad_var(block, loss.name, loss_grad_name)
+    block.append_op(
+        "fill_constant",
+        inputs={},
+        outputs={"Out": [loss_grad_name]},
+        attrs={
+            "shape": list(loss.shape) if loss.shape else [1],
+            "dtype": loss.dtype,
+            "value": 1.0,
+            "op_role": _OP_ROLE_BACKWARD,
+        },
+    )
+    partials[loss.name] = [loss_grad_name]
+
+    for op in reversed(fwd_ops):
+        if id(op) not in relevant_set:
+            continue
+        # outputs' grads must be finalized before this op's grad runs
+        out_grad_slots = {}
+        has_any = False
+        for slot, names in op.outputs.items():
+            gnames = []
+            for n in names:
+                g = finalize(n)
+                gnames.append(g)
+                if g is not None:
+                    has_any = True
+            out_grad_slots[slot] = gnames
+        if not has_any:
+            continue
+        grad_inputs = {}
+        for slot, names in op.inputs.items():
+            grad_inputs[slot] = list(names)
+        for slot, names in op.outputs.items():
+            grad_inputs[slot] = list(names)
+            gnames = out_grad_slots[slot]
+            if any(g is not None for g in gnames):
+                filled = []
+                for i, g in enumerate(gnames):
+                    if g is None:
+                        # zero-fill grads for unused sibling outputs so the
+                        # slot stays well-formed in the desc
+                        zname = f"{names[i]}@GRAD@ZERO"
+                        _create_grad_var(block, names[i], zname)
+                        block.append_op(
+                            "fill_zeros_like",
+                            inputs={"X": [names[i]]},
+                            outputs={"Out": [zname]},
+                            attrs={"op_role": _OP_ROLE_BACKWARD},
+                        )
+                        filled.append(zname)
+                    else:
+                        filled.append(g)
+                grad_inputs[f"{slot}@GRAD"] = filled
+        grad_outputs = {}
+        for slot, names in op.inputs.items():
+            gnames = []
+            for n in names:
+                v = block._find_var_recursive(n)
+                if (
+                    n in requires
+                    and n not in no_grad_set
+                    and not (v is not None and v.stop_gradient and not isinstance(v, Parameter))
+                ):
+                    gnames.append(add_partial(n, op))
+                else:
+                    gnames.append(None)
+            if any(g is not None for g in gnames):
+                grad_outputs[f"{slot}@GRAD"] = [
+                    g if g is not None else f"{names[i]}@GRAD@UNUSED"
+                    for i, g in enumerate(gnames)
+                ]
+                for i, g in enumerate(gnames):
+                    if g is None:
+                        _create_grad_var(block, names[i], f"{names[i]}@GRAD@UNUSED")
+        if not grad_outputs:
+            continue
+        grad_attrs = {
+            k: v for k, v in op.attrs.items() if k != "op_callstack"
+        }
+        grad_attrs["__fwd_inputs__"] = list(op.inputs.keys())
+        grad_attrs["__fwd_outputs__"] = list(op.outputs.keys())
+        grad_attrs["op_role"] = _OP_ROLE_BACKWARD
+        block.append_op(
+            f"{op.type}_grad",
+            inputs=grad_inputs,
+            outputs=grad_outputs,
+            attrs=grad_attrs,
+        )
+
+    # finalize any leaf grads never finalized (params consumed once)
+    params_and_grads = []
+    if parameter_list is not None:
+        params = [
+            block._find_var_recursive(p) if isinstance(p, str) else p
+            for p in parameter_list
+        ]
+    else:
+        params = [
+            v
+            for v in block.program.global_block().vars.values()
+            if isinstance(v, Parameter) and v.trainable
+        ]
+    for p in params:
+        if p.name in no_grad_set:
+            continue
+        g = finalize(p.name)
+        if g is not None:
+            params_and_grads.append((p, block.vars[g]))
+    return params_and_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Compute d(targets)/d(inputs) (reference: python/paddle/fluid/
+    backward.py:1672). Currently supports a single scalar target."""
+    target = targets[0] if isinstance(targets, (list, tuple)) else targets
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    block = target.block
+    for v in inputs:
+        v.stop_gradient = False
+    pg = append_backward(
+        target, parameter_list=None, no_grad_set=no_grad_set
+    )
+    out = []
+    for v in inputs:
+        gname = v.name + "@GRAD"
+        out.append(block.vars.get(gname))
+    return out

@@ -1,0 +1,77 @@
+"""Variable type taxonomy and dtype conversion.
+
+Mirrors the surface of the reference's VarType proto
+(reference: paddle/fluid/framework/framework.proto:104) mapped onto numpy/jax
+dtypes. bfloat16 is first-class — it is the TPU-native low-precision type.
+"""
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+
+    _BF16 = jnp.bfloat16
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+class VarType:
+    # tensor element types
+    BOOL = "bool"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FP16 = "float16"
+    BF16 = "bfloat16"
+    FP32 = "float32"
+    FP64 = "float64"
+    # variable kinds (reference framework.proto:122-140)
+    DENSE_TENSOR = "dense_tensor"
+    SELECTED_ROWS = "selected_rows"
+    READER = "reader"
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "int": "int32",
+    "long": "int64",
+}
+
+_FLOAT_TYPES = {"float16", "bfloat16", "float32", "float64"}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str / np.dtype / jnp dtype) to a canonical
+    string name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+    elif _BF16 is not None and dtype == _BF16:
+        name = "bfloat16"
+    else:
+        name = np.dtype(dtype).name
+    name = _ALIASES.get(name, name)
+    return name
+
+
+def to_numpy_dtype(dtype):
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        return _BF16
+    return np.dtype(name)
+
+
+def is_float_dtype(dtype):
+    return convert_dtype(dtype) in _FLOAT_TYPES
+
+
+def is_integer_dtype(dtype):
+    return convert_dtype(dtype) in {"int8", "uint8", "int16", "int32", "int64"}

@@ -1,0 +1,84 @@
+"""Device identity ("Place") and device discovery.
+
+TPU-native analog of the reference's Place variant
+(reference: paddle/fluid/platform/place.h:79 — CUDAPlace/CPUPlace/
+CUDAPinnedPlace) with TPUPlace replacing CUDAPlace, and of device discovery in
+``InitDevices`` (reference: paddle/fluid/platform/init.cc:116). Discovery here
+goes through the PJRT client that jax exposes rather than the CUDA driver.
+"""
+
+import functools
+
+
+class Place:
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((self._kind, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def jax_device(self):
+        import jax
+
+        return jax.devices("cpu")[0] if "cpu" in _platforms() else jax.devices()[0]
+
+
+class TPUPlace(Place):
+    """One TPU chip, identified by its index in the local PJRT device list."""
+
+    _kind = "tpu"
+
+    def jax_device(self):
+        import jax
+
+        devs = _accelerator_devices()
+        if not devs:
+            # CPU fallback keeps programs runnable on hosts without a TPU
+            # (tests force JAX_PLATFORMS=cpu with a virtual 8-device mesh).
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+@functools.lru_cache(maxsize=None)
+def _platforms():
+    import jax
+
+    return {d.platform for d in jax.devices()}
+
+
+def _accelerator_devices():
+    import jax
+
+    return [d for d in jax.devices() if d.platform != "cpu"]
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def tpu_device_count():
+    devs = _accelerator_devices()
+    if devs:
+        return len(devs)
+    import jax
+
+    return jax.device_count()
+
+
+def get_all_places():
+    return [TPUPlace(i) for i in range(tpu_device_count())]

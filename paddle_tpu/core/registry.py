@@ -1,0 +1,131 @@
+"""Operator registry.
+
+TPU-native analog of the reference's OpInfoMap/OpRegistry
+(reference: paddle/fluid/framework/op_registry.h:68, op_info.h). Where the
+reference registers per-(place, dtype, layout) *kernels* chosen at run time
+(reference: paddle/fluid/framework/operator.cc:1041 ChooseKernel), an op here
+registers:
+
+  * ``lower``   — a jax lowering rule: (inputs, attrs) -> outputs, traced into
+                  the whole-block XLA computation. Dtype/device dispatch is
+                  XLA's job; there is exactly one lowering per op.
+  * ``infer_shape`` — static shape/dtype inference used at graph-build time
+                  (reference: shape_inference.h), optional.
+  * ``grad``    — a custom IR grad maker (reference: grad_op_desc_maker.h),
+                  optional: the default grad op is synthesized generically from
+                  the lowering rule via jax.vjp (see core/backward.py), which
+                  is the TPU-native replacement for per-op hand-written grad
+                  kernels.
+  * ``pallas``  — optional hand-written Pallas TPU kernel overriding the jnp
+                  lowering for ops XLA fuses poorly.
+
+Inputs/outputs are dicts: slot name -> list of jax arrays, mirroring the
+reference's named variable lists on OpDesc.
+"""
+
+from paddle_tpu.utils.enforce import EnforceError
+
+
+class OpDef:
+    def __init__(
+        self,
+        type,
+        lower,
+        infer_shape=None,
+        grad=None,
+        pallas=None,
+        nondiff_inputs=(),
+        stateful=False,
+    ):
+        self.type = type
+        self.lower = lower
+        self.infer_shape = infer_shape
+        self.grad = grad
+        self.pallas = pallas
+        # input slots that never receive gradients (indices, masks, ...)
+        self.nondiff_inputs = frozenset(nondiff_inputs)
+        # stateful ops (random, print, ...) must not be CSE'd away
+        self.stateful = stateful
+
+    def lowering(self, use_pallas=True):
+        if use_pallas and self.pallas is not None:
+            return self.pallas
+        return self.lower
+
+
+class OpRegistry:
+    _ops = {}
+
+    @classmethod
+    def register(cls, op_def):
+        if op_def.type in cls._ops:
+            raise EnforceError(f"op {op_def.type} registered twice")
+        cls._ops[op_def.type] = op_def
+
+    @classmethod
+    def get(cls, type):
+        try:
+            return cls._ops[type]
+        except KeyError:
+            raise EnforceError(f"op {type} is not registered")
+
+    @classmethod
+    def has(cls, type):
+        return type in cls._ops
+
+    @classmethod
+    def all_types(cls):
+        return sorted(cls._ops)
+
+
+def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False):
+    """Decorator form:  @register_op("relu")  def _(ins, attrs): ..."""
+
+    def deco(fn):
+        OpRegistry.register(
+            OpDef(
+                type,
+                fn,
+                infer_shape=infer_shape,
+                grad=grad,
+                pallas=pallas,
+                nondiff_inputs=nondiff_inputs,
+                stateful=stateful,
+            )
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type):
+    return OpRegistry.get(type)
+
+
+def has_op_def(type):
+    return OpRegistry.has(type)
+
+
+def register_grad(fwd_type):
+    """Attach a custom IR grad maker to an already-registered op.
+
+    The maker has signature (op: Operator, grad_out_names: dict, grad_in_names
+    factory) and appends grad OpDescs — see core/backward.py for the calling
+    convention.
+    """
+
+    def deco(fn):
+        OpRegistry.get(fwd_type).grad = fn
+        return fn
+
+    return deco
+
+
+def register_pallas(fwd_type):
+    """Attach a Pallas TPU kernel as the preferred lowering for an op."""
+
+    def deco(fn):
+        OpRegistry.get(fwd_type).pallas = fn
+        return fn
+
+    return deco

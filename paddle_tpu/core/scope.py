@@ -1,0 +1,71 @@
+"""Scope: name -> device array state, with parent-chain lookup.
+
+TPU-native analog of the reference's Scope
+(reference: paddle/fluid/framework/scope.h:46). Instead of type-erased mutable
+Variables, a Scope holds immutable jax.Arrays; the executor threads them
+functionally through compiled steps and writes the updated arrays back, with
+buffer donation standing in for in-place mutation (reference's inplace pass /
+eager deletion — paddle/fluid/framework/ir/memory_optimize_pass/).
+"""
+
+import contextlib
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+        if parent is not None:
+            parent.kids.append(self)
+
+    def new_scope(self):
+        return Scope(parent=self)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        scope = self
+        while scope is not None:
+            if name in scope._vars:
+                return scope._vars[name]
+            scope = scope.parent
+        return None
+
+    def has_var(self, name):
+        return self.find_var(name) is not None
+
+    def var_names(self):
+        return list(self._vars)
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def find_var_numpy(self, name):
+        v = self.find_var(name)
+        return None if v is None else np.asarray(v)
+
+    def drop_kids(self):
+        self.kids = []
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old

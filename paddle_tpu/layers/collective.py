@@ -1,0 +1,90 @@
+"""Collective ops at the layer level.
+
+The reference inserts c_allreduce/c_allgather ops bound to NCCL rings
+(reference: python/paddle/fluid/layers/collective.py:20,108;
+paddle/fluid/operators/collective/c_allreduce_op.h:105). Here a collective op
+is an annotation in the IR: when the program is compiled for a mesh
+(compiler.CompiledProgram / parallel/), the lowering emits jax.lax.psum et al.
+over the named mesh axis — XLA maps them onto ICI. Outside a mesh context
+they are identity (single-device semantics), mirroring single-trainer runs.
+"""
+
+import jax
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.ops.common import first
+from paddle_tpu.parallel.env import current_mesh_axis
+
+__all__ = ["_allreduce", "_c_allgather", "_c_broadcast", "_c_reducescatter"]
+
+
+def _make_collective(op_type, lax_fn):
+    @register_op(op_type)
+    def _lower(ins, attrs, _fn=lax_fn):
+        x = first(ins, "X")
+        axis = current_mesh_axis(attrs.get("ring_id", 0))
+        if axis is None:
+            return {"Out": [x]}
+        return {"Out": [_fn(x, axis)]}
+
+
+_make_collective("c_allreduce_sum", lambda x, ax: jax.lax.psum(x, ax))
+_make_collective("c_allreduce_max", lambda x, ax: jax.lax.pmax(x, ax))
+_make_collective("c_allreduce_min", lambda x, ax: jax.lax.pmin(x, ax))
+_make_collective(
+    "c_allreduce_prod",
+    lambda x, ax: jax.lax.all_gather(x, ax).prod(axis=0),
+)
+_make_collective(
+    "c_allgather", lambda x, ax: jax.lax.all_gather(x, ax, tiled=True)
+)
+_make_collective(
+    "c_broadcast",
+    lambda x, ax: jax.lax.all_gather(x, ax)[0],
+)
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ins, attrs):
+    x = first(ins, "X")
+    axis = current_mesh_axis(attrs.get("ring_id", 0))
+    if axis is None:
+        return {"Out": [x]}
+    return {"Out": [jax.lax.psum_scatter(x, axis, tiled=True)]}
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync_calc_stream(ins, attrs):
+    # stream sync is meaningless under XLA's single-computation schedule
+    return {"Out": [first(ins, "X")]}
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync_comm_stream(ins, attrs):
+    return {"Out": [first(ins, "X")]}
+
+
+def _collective_layer(op_type, x, ring_id=0, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        op_type, {"X": [x.name]}, {"Out": [out.name]}, {"ring_id": ring_id}
+    )
+    return out
+
+
+def _allreduce(x, ring_id=0, use_calc_stream=False, name=None):
+    return _collective_layer("c_allreduce_sum", x, ring_id, name)
+
+
+def _c_allgather(x, nranks=1, ring_id=0, name=None):
+    return _collective_layer("c_allgather", x, ring_id, name)
+
+
+def _c_broadcast(x, root=0, ring_id=0, name=None):
+    return _collective_layer("c_broadcast", x, ring_id, name)
+
+
+def _c_reducescatter_layer(x, nranks=1, ring_id=0, name=None):
+    return _collective_layer("c_reducescatter", x, ring_id, name)

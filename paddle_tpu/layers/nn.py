@@ -1,0 +1,901 @@
+"""User-facing neural-net layer functions.
+
+API surface modeled on the reference's fluid.layers
+(reference: python/paddle/fluid/layers/nn.py — fc at :205, ~200 layers).
+Every function appends OpDescs to the current block via LayerHelper; no
+computation happens at build time.
+"""
+
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = [
+    "fc",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "layer_norm",
+    "instance_norm",
+    "group_norm",
+    "embedding",
+    "dropout",
+    "softmax",
+    "log_softmax",
+    "matmul",
+    "mul",
+    "relu",
+    "relu6",
+    "sigmoid",
+    "tanh",
+    "gelu",
+    "leaky_relu",
+    "elu",
+    "swish",
+    "hard_swish",
+    "hard_sigmoid",
+    "softplus",
+    "softsign",
+    "prelu",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "square_error_cost",
+    "huber_loss",
+    "kldiv_loss",
+    "mse_loss",
+    "accuracy",
+    "auc",
+    "topk",
+    "one_hot",
+    "l2_normalize",
+    "clip",
+    "clip_by_norm",
+    "mean",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "elementwise_op",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "scale",
+    "sqrt",
+    "square",
+    "abs",
+    "exp",
+    "log",
+    "sin",
+    "cos",
+    "erf",
+    "pow",
+    "argmax",
+    "argmin",
+    "unsqueeze",
+    "squeeze",
+]
+
+
+def _single_op(op_type, x, attrs=None, out_dtype=None, name=None, extra_inputs=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(out_dtype or x.dtype)
+    inputs = {"X": [x.name]}
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    helper.append_op(op_type, inputs, {"Out": [out.name]}, attrs or {})
+    return out
+
+
+# -- dense / conv -----------------------------------------------------------
+
+
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    """reference: python/paddle/fluid/layers/nn.py:205."""
+    helper = LayerHelper(
+        "fc", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
+    )
+    dtype = input.dtype
+    input_shape = input.shape
+    in_features = 1
+    for d in input_shape[num_flatten_dims:]:
+        in_features *= d
+    w = helper.create_parameter(
+        helper.param_attr, shape=[in_features, size], dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "mul",
+        {"X": [input.name], "Y": [w.name]},
+        {"Out": [out.name]},
+        {"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+    )
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[size], dtype=dtype, is_bias=True
+        )
+        out = helper.append_bias_op(out, b, axis=num_flatten_dims)
+    return helper.append_activation(out)
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+    data_format="NCHW",
+):
+    """reference: python/paddle/fluid/layers/nn.py conv2d."""
+    helper = LayerHelper(
+        "conv2d", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
+    )
+    dtype = input.dtype
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    enforce(channels % groups == 0, "channels must divide groups")
+    filter_shape = [num_filters, channels // groups] + list(filter_size)
+    import math
+
+    fan_in = (channels // groups) * filter_size[0] * filter_size[1]
+    from paddle_tpu.initializer import NormalInitializer
+
+    default_init = NormalInitializer(0.0, math.sqrt(2.0 / fan_in))
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=filter_shape,
+        dtype=dtype,
+        default_initializer=default_init,
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv2d",
+        {"Input": [input.name], "Filter": [w.name]},
+        {"Output": [out.name]},
+        {
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "data_format": data_format,
+        },
+    )
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_filters], dtype=dtype, is_bias=True
+        )
+        out = helper.append_bias_op(out, b, axis=1 if data_format == "NCHW" else 3)
+    return helper.append_activation(out)
+
+
+def conv2d_transpose(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper(
+        "conv2d_transpose",
+        param_attr=param_attr,
+        bias_attr=bias_attr,
+        act=act,
+        name=name,
+    )
+    dtype = input.dtype
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    channels = input.shape[1]
+    filter_shape = [channels, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        {"Input": [input.name], "Filter": [w.name]},
+        {"Output": [out.name]},
+        {"strides": stride, "paddings": padding, "groups": groups},
+    )
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_filters], dtype=dtype, is_bias=True
+        )
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def pool2d(
+    input,
+    pool_size=-1,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    exclusive=True,
+    adaptive=False,
+    name=None,
+):
+    helper = LayerHelper("pool2d", name=name)
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride, pool_stride]
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding, pool_padding]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d",
+        {"X": [input.name]},
+        {"Out": [out.name]},
+        {
+            "pooling_type": pool_type,
+            "ksize": pool_size,
+            "strides": pool_stride,
+            "paddings": pool_padding,
+            "global_pooling": global_pooling,
+            "exclusive": exclusive,
+            "adaptive": adaptive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    use_global_stats=False,
+):
+    """reference: python/paddle/fluid/layers/nn.py batch_norm. Running stats
+    are persistable non-trainable parameters updated through MeanOut/
+    VarianceOut (functionally, via scope write-back)."""
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper(
+        "batch_norm", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
+    )
+    dtype = input.dtype if input.dtype != "float16" else "float32"
+    channels = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(
+        helper.param_attr,
+        shape=[channels],
+        dtype=dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = helper.create_parameter(
+        helper.bias_attr, shape=[channels], dtype=dtype, is_bias=True
+    )
+    mean = helper.create_parameter(
+        ParamAttr(
+            name=moving_mean_name,
+            initializer=ConstantInitializer(0.0),
+            trainable=False,
+        ),
+        shape=[channels],
+        dtype=dtype,
+    )
+    variance = helper.create_parameter(
+        ParamAttr(
+            name=moving_variance_name,
+            initializer=ConstantInitializer(1.0),
+            trainable=False,
+        ),
+        shape=[channels],
+        dtype=dtype,
+    )
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+    out = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        {
+            "X": [input.name],
+            "Scale": [scale.name],
+            "Bias": [bias.name],
+            "Mean": [mean.name],
+            "Variance": [variance.name],
+        },
+        {
+            "Y": [out.name],
+            "MeanOut": [mean.name],
+            "VarianceOut": [variance.name],
+            "SavedMean": [saved_mean.name],
+            "SavedVariance": [saved_var.name],
+        },
+        {
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    from paddle_tpu.initializer import ConstantInitializer
+
+    helper = LayerHelper(
+        "layer_norm", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
+    )
+    dtype = input.dtype
+    import math
+
+    norm_shape = [int(math.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input.name]}
+    if scale:
+        s = helper.create_parameter(
+            helper.param_attr,
+            shape=norm_shape,
+            dtype=dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=norm_shape, dtype=dtype, is_bias=True
+        )
+        inputs["Bias"] = [b.name]
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "layer_norm",
+        inputs,
+        {"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+        {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    from paddle_tpu.initializer import ConstantInitializer
+
+    helper = LayerHelper(
+        "instance_norm", param_attr=param_attr, bias_attr=bias_attr, name=name
+    )
+    channels = input.shape[1]
+    s = helper.create_parameter(
+        helper.param_attr,
+        shape=[channels],
+        dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    b = helper.create_parameter(
+        helper.bias_attr, shape=[channels], dtype=input.dtype, is_bias=True
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    sv = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "instance_norm",
+        {"X": [input.name], "Scale": [s.name], "Bias": [b.name]},
+        {"Y": [out.name], "SavedMean": [sm.name], "SavedVariance": [sv.name]},
+        {"epsilon": epsilon},
+    )
+    return out
+
+
+def group_norm(
+    input, groups, epsilon=1e-5, param_attr=None, bias_attr=None, act=None, name=None
+):
+    from paddle_tpu.initializer import ConstantInitializer
+
+    helper = LayerHelper(
+        "group_norm", param_attr=param_attr, bias_attr=bias_attr, act=act, name=name
+    )
+    channels = input.shape[1]
+    s = helper.create_parameter(
+        helper.param_attr,
+        shape=[channels],
+        dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    b = helper.create_parameter(
+        helper.bias_attr, shape=[channels], dtype=input.dtype, is_bias=True
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    v = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "group_norm",
+        {"X": [input.name], "Scale": [s.name], "Bias": [b.name]},
+        {"Y": [out.name], "Mean": [m.name], "Variance": [v.name]},
+        {"groups": groups, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    is_distributed=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+    name=None,
+):
+    """reference: python/paddle/fluid/layers/nn.py embedding. is_sparse is
+    accepted for API parity; dense gather is the TPU path (the PS stack
+    handles the huge-table case)."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(helper.param_attr, shape=list(size), dtype=dtype)
+    w.is_distributed = is_distributed
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table_v2",
+        {"W": [w.name], "Ids": [input.name]},
+        {"Out": [out.name]},
+        {"padding_idx": -1 if padding_idx is None else padding_idx},
+    )
+    return out
+
+
+def dropout(
+    x,
+    dropout_prob,
+    is_test=False,
+    seed=0,
+    name=None,
+    dropout_implementation="downgrade_in_infer",
+):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        {"X": [x.name]},
+        {"Out": [out.name], "Mask": [mask.name]},
+        {
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+# -- activations ------------------------------------------------------------
+
+
+def _make_act(op_type):
+    def act_fn(x, name=None, **attrs):
+        return _single_op(op_type, x, attrs, name=name)
+
+    act_fn.__name__ = op_type
+    return act_fn
+
+
+relu = _make_act("relu")
+relu6 = _make_act("relu6")
+sigmoid = _make_act("sigmoid")
+tanh = _make_act("tanh")
+leaky_relu = _make_act("leaky_relu")
+elu = _make_act("elu")
+swish = _make_act("swish")
+hard_swish = _make_act("hard_swish")
+hard_sigmoid = _make_act("hard_sigmoid")
+softplus = _make_act("softplus")
+softsign = _make_act("softsign")
+sqrt = _make_act("sqrt")
+square = _make_act("square")
+abs = _make_act("abs")
+exp = _make_act("exp")
+log = _make_act("log")
+sin = _make_act("sin")
+cos = _make_act("cos")
+erf = _make_act("erf")
+
+
+def gelu(x, approximate=False, name=None):
+    return _single_op("gelu", x, {"approximate": approximate}, name=name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _single_op("pow", x, {"factor": factor}, name=name)
+
+
+def softmax(input, axis=-1, name=None):
+    return _single_op("softmax", input, {"axis": axis}, name=name)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _single_op("log_softmax", input, {"axis": axis}, name=name)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from paddle_tpu.initializer import ConstantInitializer
+
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    alpha_shape = [1] if mode == "all" else [x.shape[1]]
+    alpha = helper.create_parameter(
+        helper.param_attr,
+        shape=alpha_shape,
+        dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25),
+    )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "prelu",
+        {"X": [x.name], "Alpha": [alpha.name]},
+        {"Out": [out.name]},
+        {"mode": mode},
+    )
+    return out
+
+
+# -- elementwise / math -----------------------------------------------------
+
+
+def elementwise_op(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        op_type, {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]}, {"axis": axis}
+    )
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_pow", x, y, axis, act, name)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul",
+        {"X": [x.name], "Y": [y.name]},
+        {"Out": [out.name]},
+        {"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": alpha},
+    )
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul",
+        {"X": [x.name], "Y": [y.name]},
+        {"Out": [out.name]},
+        {"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "scale",
+        {"X": [x.name]},
+        {"Out": [out.name]},
+        {"scale": scale, "bias": bias, "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out)
+
+
+def mean(x, name=None):
+    return _single_op("mean", x, name=name)
+
+
+def _make_reduce(op_type):
+    def fn(input, dim=None, keep_dim=False, name=None):
+        attrs = {
+            "dim": dim if dim is not None else [0],
+            "keep_dim": keep_dim,
+            "reduce_all": dim is None,
+        }
+        return _single_op(op_type, input, attrs, name=name)
+
+    fn.__name__ = op_type
+    return fn
+
+
+reduce_sum = _make_reduce("reduce_sum")
+reduce_mean = _make_reduce("reduce_mean")
+reduce_max = _make_reduce("reduce_max")
+reduce_min = _make_reduce("reduce_min")
+reduce_prod = _make_reduce("reduce_prod")
+
+
+def clip(x, min, max, name=None):
+    return _single_op("clip", x, {"min": min, "max": max}, name=name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_op("clip_by_norm", x, {"max_norm": max_norm}, name=name)
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    sq = square(x)
+    ssum = reduce_sum(sq, dim=[axis] if axis is not None else None, keep_dim=True)
+    norm = sqrt(elementwise_add(ssum, fill_constant_like(ssum, epsilon)))
+    return elementwise_div(x, norm)
+
+
+def fill_constant_like(x, value):
+    from paddle_tpu.layers.tensor import fill_constant
+
+    return fill_constant(shape=[1], dtype=x.dtype, value=value)
+
+
+# -- losses & metrics -------------------------------------------------------
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100, name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "cross_entropy",
+        {"X": [input.name], "Label": [label.name]},
+        {"Y": [out.name]},
+        {"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(
+    logits,
+    label,
+    soft_label=False,
+    ignore_index=-100,
+    return_softmax=False,
+    axis=-1,
+    name=None,
+):
+    helper = LayerHelper("softmax_with_cross_entropy", name=name)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        {"Logits": [logits.name], "Label": [label.name]},
+        {"Softmax": [softmax_out.name], "Loss": [loss.name]},
+        {"soft_label": soft_label, "ignore_index": ignore_index, "axis": axis},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(
+    x, label, ignore_index=-100, normalize=False, name=None
+):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        {"X": [x.name], "Label": [label.name]},
+        {"Out": [out.name]},
+        {"ignore_index": ignore_index, "normalize": normalize},
+    )
+    return out
+
+
+def square_error_cost(input, label, name=None):
+    helper = LayerHelper("square_error_cost", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "square_error_cost",
+        {"X": [input.name], "Y": [label.name]},
+        {"Out": [out.name]},
+    )
+    return out
+
+
+def mse_loss(input, label, name=None):
+    return mean(square_error_cost(input, label), name=name)
+
+
+def huber_loss(input, label, delta, name=None):
+    helper = LayerHelper("huber_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    residual = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True
+    )
+    helper.append_op(
+        "huber_loss",
+        {"X": [input.name], "Y": [label.name]},
+        {"Out": [out.name], "Residual": [residual.name]},
+        {"delta": delta},
+    )
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "kldiv_loss",
+        {"X": [x.name], "Target": [target.name]},
+        {"Loss": [out.name]},
+        {"reduction": reduction},
+    )
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op(
+        "top_k",
+        {"X": [input.name]},
+        {"Out": [values.name], "Indices": [indices.name]},
+        {"k": k},
+    )
+    return values, indices
+
+
+def accuracy(input, label, k=1, name=None):
+    """reference: python/paddle/fluid/layers/metric_op.py accuracy."""
+    helper = LayerHelper("accuracy", name=name)
+    values, indices = topk(input, k)
+    acc = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    correct = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    total = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op(
+        "accuracy",
+        {"Out": [values.name], "Indices": [indices.name], "Label": [label.name]},
+        {"Accuracy": [acc.name], "Correct": [correct.name], "Total": [total.name]},
+    )
+    return acc
+
+
+def auc(input, label, num_thresholds=4095, name=None):
+    """Streaming AUC; stats are persistable state vars
+    (reference: python/paddle/fluid/layers/metric_op.py auc)."""
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("auc", name=name)
+    stat_pos = helper.create_parameter(
+        ParamAttr(initializer=ConstantInitializer(0.0), trainable=False),
+        shape=[num_thresholds + 1],
+        dtype="int64",
+    )
+    stat_neg = helper.create_parameter(
+        ParamAttr(initializer=ConstantInitializer(0.0), trainable=False),
+        shape=[num_thresholds + 1],
+        dtype="int64",
+    )
+    auc_out = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    helper.append_op(
+        "auc",
+        {
+            "Predict": [input.name],
+            "Label": [label.name],
+            "StatPos": [stat_pos.name],
+            "StatNeg": [stat_neg.name],
+        },
+        {
+            "AUC": [auc_out.name],
+            "StatPosOut": [stat_pos.name],
+            "StatNegOut": [stat_neg.name],
+        },
+        {"num_thresholds": num_thresholds},
+    )
+    return auc_out, [stat_pos, stat_neg]
+
+
+def one_hot(input, depth, name=None):
+    return _single_op("one_hot", input, {"depth": depth}, out_dtype="float32", name=name)
+
+
+def argmax(x, axis=-1, name=None):
+    return _single_op("arg_max", x, {"axis": axis}, out_dtype="int64", name=name)
+
+
+def argmin(x, axis=-1, name=None):
+    return _single_op("arg_min", x, {"axis": axis}, out_dtype="int64", name=name)
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "unsqueeze2",
+        {"X": [input.name]},
+        {"Out": [out.name], "XShape": [xshape.name]},
+        {"axes": axes},
+    )
+    return out
+
+
+def squeeze(input, axes=None, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op(
+        "squeeze2",
+        {"X": [input.name]},
+        {"Out": [out.name], "XShape": [xshape.name]},
+        {"axes": axes or []},
+    )
+    return out

@@ -1,0 +1,14 @@
+"""Operator library: importing this package registers every op lowering.
+
+The analog of the reference's static-registrar op library
+(reference: paddle/fluid/operators/ — 560 REGISTER_OPERATOR sites); here
+registration is module import, and there is one jax lowering per op instead
+of per-(place, dtype, layout) kernels.
+"""
+
+from paddle_tpu.ops import common  # noqa: F401
+from paddle_tpu.ops import math  # noqa: F401
+from paddle_tpu.ops import nn  # noqa: F401
+from paddle_tpu.ops import tensor  # noqa: F401
+from paddle_tpu.ops import optimizers  # noqa: F401
+from paddle_tpu.ops import control_flow  # noqa: F401

@@ -1,0 +1,115 @@
+"""Control-flow ops: while / cond, lowered to lax.while_loop / lax.cond.
+
+The reference runs sub-blocks through a nested Executor on the host
+(reference: paddle/fluid/operators/controlflow/while_op.cc:43,
+conditional_block_op.h) — a host round-trip per iteration. Here the sub-block
+is traced once and becomes a lax structured-control-flow region inside the
+same XLA computation: no host involvement, static shapes, compiler-visible
+loop body (the form XLA requires and the TPU rewards).
+
+Carried state is inferred from the IR: every variable the sub-block writes
+that already exists in the enclosing env is loop-carried; pure temporaries
+stay local. This replaces the reference's StepScope machinery
+(reference: paddle/fluid/operators/controlflow/while_op_helper.cc).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first
+from paddle_tpu.utils.enforce import EnforceError
+
+
+def _sub_block_rw(sub):
+    written, read = [], []
+    seen_w, seen_r = set(), set()
+    for sop in sub.ops:
+        for n in sop.input_names():
+            if n not in seen_r:
+                read.append(n)
+                seen_r.add(n)
+        for n in sop.output_names():
+            if n not in seen_w:
+                written.append(n)
+                seen_w.add(n)
+    return written, read
+
+
+def run_control_flow_op(op, block, env, rng_key, interpret):
+    if op.type == "while":
+        _run_while(op, block, env, rng_key, interpret)
+    elif op.type == "conditional_block":
+        _run_cond(op, block, env, rng_key, interpret)
+    else:
+        raise EnforceError(f"unhandled control-flow op {op.type}")
+
+
+def _run_while(op, block, env, rng_key, interpret):
+    sub = block.program.block(op.attrs["sub_block"])
+    cond_name = op.inputs["Condition"][0]
+    written, _ = _sub_block_rw(sub)
+    carry_names = [n for n in dict.fromkeys([cond_name] + written) if n in env]
+    outer = dict(env)
+
+    def cond_fn(carry):
+        c = carry[0][cond_name]
+        return jnp.reshape(c, ()).astype(bool)
+
+    def body_fn(carry):
+        state, it = carry
+        local = dict(outer)
+        local.update(state)
+        # fold the iteration counter so stateful ops (dropout etc.) draw
+        # fresh randomness each trip
+        interpret(sub, local, jax.random.fold_in(rng_key, it))
+        return {n: local[n] for n in carry_names}, it + 1
+
+    init = ({n: env[n] for n in carry_names}, jnp.zeros((), jnp.uint32))
+    final, _ = jax.lax.while_loop(cond_fn, body_fn, init)
+    env.update(final)
+
+
+def _run_cond(op, block, env, rng_key, interpret):
+    """Two-armed conditional: attrs sub_block (true) and optionally
+    sub_block_false; outputs listed in op.outputs['Out']."""
+    cond = env[op.inputs["Cond"][0]]
+    true_blk = block.program.block(op.attrs["sub_block"])
+    false_idx = op.attrs.get("sub_block_false", -1)
+    out_names = op.outputs.get("Out", [])
+    outer = dict(env)
+
+    def run_branch(blk):
+        def fn(_):
+            local = dict(outer)
+            interpret(blk, local, rng_key)
+            return tuple(local[n] for n in out_names)
+
+        return fn
+
+    def fallthrough(_):
+        return tuple(outer[n] for n in out_names)
+
+    false_fn = (
+        run_branch(block.program.block(false_idx)) if false_idx >= 0 else fallthrough
+    )
+    outs = jax.lax.cond(
+        jnp.reshape(cond, ()).astype(bool), run_branch(true_blk), false_fn, 0
+    )
+    for n, v in zip(out_names, outs):
+        env[n] = v
+
+
+# -- small helper ops used by loop constructs -------------------------------
+
+
+@register_op("increment")
+def _increment(ins, attrs):
+    x = first(ins, "X")
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register_op("less_than_scalar")
+def _less_than_scalar(ins, attrs):
+    x = first(ins, "X")
+    return {"Out": [x < attrs["value"]]}

@@ -1,0 +1,535 @@
+"""Neural-network op lowerings: conv/pool/norm/activation/loss/embedding.
+
+Replaces the reference's cuDNN-backed kernels (reference:
+paddle/fluid/operators/conv_cudnn_op.cu, pool_op.cu, batch_norm_op.cu,
+softmax_with_cross_entropy_op.cu, lookup_table_op.cu) with lax/jnp lowerings:
+convs hit the MXU via lax.conv_general_dilated, norms/activations fuse into
+their neighbors under whole-block XLA compilation.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op, register_grad
+from paddle_tpu.ops.common import (
+    first,
+    maybe,
+    normalize_padding,
+    rng_key,
+)
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def _activation(name, fn):
+    @register_op(name)
+    def _lower(ins, attrs, _fn=fn):
+        return {"Out": [_fn(first(ins, "X"), attrs)]}
+
+
+_activation("relu", lambda x, a: jax.nn.relu(x))
+_activation("relu6", lambda x, a: jnp.minimum(jax.nn.relu(x), a.get("threshold", 6.0)))
+_activation("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_activation("tanh", lambda x, a: jnp.tanh(x))
+_activation("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)))
+_activation("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)))
+_activation("elu", lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)))
+_activation("softplus", lambda x, a: jax.nn.softplus(x))
+_activation("softsign", lambda x, a: jax.nn.soft_sign(x))
+_activation("silu", lambda x, a: jax.nn.silu(x))
+_activation("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_activation(
+    "hard_sigmoid",
+    lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+)
+_activation(
+    "hard_swish",
+    lambda x, a: x
+    * jnp.clip(x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0))
+    / a.get("scale", 6.0),
+)
+_activation("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+@register_op("softmax")
+def _softmax(ins, attrs):
+    return {"Out": [jax.nn.softmax(first(ins, "X"), axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ins, attrs):
+    return {"Out": [jax.nn.log_softmax(first(ins, "X"), axis=attrs.get("axis", -1))]}
+
+
+@register_op("prelu")
+def _prelu(ins, attrs):
+    x, alpha = first(ins, "X"), first(ins, "Alpha")
+    if attrs.get("mode", "all") == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+
+
+@register_op("conv2d")
+def _conv2d(ins, attrs):
+    """reference: paddle/fluid/operators/conv_op.cc (NCHW, OIHW filters)."""
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    strides = attrs.get("strides", [1, 1])
+    dilations = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1)
+    layout = attrs.get("data_format", "NCHW")
+    if layout == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        spatial = x.shape[1:3]
+    else:
+        dn = ("NCHW", "OIHW", "NCHW")
+        spatial = x.shape[2:4]
+    ksize = w.shape[2:4] if layout == "NCHW" else w.shape[0:2]
+    padding = normalize_padding(attrs, 2, ksize, strides, spatial)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=tuple(strides),
+        padding=padding,
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ins, attrs):
+    attrs = dict(attrs)
+    x = first(ins, "Input")
+    channels = x.shape[1] if attrs.get("data_format", "NCHW") == "NCHW" else x.shape[-1]
+    attrs["groups"] = channels
+    return {"Output": _conv2d(ins, attrs)["Output"]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ins, attrs):
+    """Transposed conv as an input-dilated forward conv (supports groups,
+    which lax.conv_transpose does not). Filter layout follows the reference:
+    [in_c, out_c/groups, kh, kw] (reference: paddle/fluid/operators/
+    conv_transpose_op.cc)."""
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    strides = tuple(attrs.get("strides", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pads = attrs.get("paddings", [0, 0])
+    if len(pads) == 2:
+        ph, pw = pads
+        pads4 = (ph, ph, pw, pw)
+    else:
+        pads4 = tuple(pads)
+    in_c, oc_per_g, kh, kw = w.shape
+    # [in_c, out_c/g, kh, kw] -> flipped, grouped OIHW [out_c, in_c/g, kh, kw]
+    wf = jnp.flip(w, (2, 3))
+    wf = wf.reshape(groups, in_c // groups, oc_per_g, kh, kw)
+    wf = jnp.swapaxes(wf, 1, 2).reshape(groups * oc_per_g, in_c // groups, kh, kw)
+    padding = (
+        (kh - 1 - pads4[0], kh - 1 - pads4[1]),
+        (kw - 1 - pads4[2], kw - 1 - pads4[3]),
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        wf,
+        window_strides=(1, 1),
+        padding=padding,
+        lhs_dilation=strides,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def _pool2d(ins, attrs):
+    """reference: paddle/fluid/operators/pool_op.cc."""
+    x = first(ins, "X")
+    ptype = attrs.get("pooling_type", "max")
+    layout = attrs.get("data_format", "NCHW")
+    if layout != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    spatial = x.shape[2:4]
+    if attrs.get("global_pooling", False) or (
+        attrs.get("adaptive", False) and list(attrs.get("ksize", [1, 1])) == [1, 1]
+    ):
+        red = jnp.max if ptype == "max" else jnp.mean
+        out = red(x, axis=(2, 3), keepdims=True)
+    elif attrs.get("adaptive", False):
+        oh, ow = attrs["ksize"]
+        red = jnp.max if ptype == "max" else jnp.mean
+        # adaptive pooling with uniform regions (exact when divisible)
+        n, c, h, wd = x.shape
+        out = red(
+            x[:, :, : (h // oh) * oh, : (wd // ow) * ow].reshape(
+                n, c, oh, h // oh, ow, wd // ow
+            ),
+            axis=(3, 5),
+        )
+    else:
+        ksize = tuple(attrs.get("ksize", [2, 2]))
+        strides = tuple(attrs.get("strides", ksize))
+        padding = normalize_padding(attrs, 2, ksize, strides, spatial)
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
+        pads4 = ((0, 0), (0, 0)) + padding
+        if ptype == "max":
+            out = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, strides4, pads4
+            )
+            out = out.astype(x.dtype)
+        else:
+            summed = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides4, pads4
+            )
+            if attrs.get("exclusive", True) and any(p != (0, 0) for p in padding):
+                ones = jnp.ones_like(x)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strides4, pads4
+                )
+                out = summed / counts
+            else:
+                out = summed / (ksize[0] * ksize[1])
+    if layout != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm", nondiff_inputs=("Mean", "Variance"))
+def _batch_norm(ins, attrs):
+    """reference: paddle/fluid/operators/batch_norm_op.cc. Running stats are
+    data outputs (MeanOut/VarianceOut), not side effects — functional-state
+    threading replaces the reference's in-place variable mutation."""
+    x = first(ins, "X")
+    scale, bias = first(ins, "Scale"), first(ins, "Bias")
+    mean, var = first(ins, "Mean"), first(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    axes = (
+        tuple(i for i in range(x.ndim) if i != 1)
+        if layout == "NCHW"
+        else tuple(range(x.ndim - 1))
+    )
+    shape = (1, -1) + (1,) * (x.ndim - 2) if layout == "NCHW" else (-1,)
+    if attrs.get("is_test", False) or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        compute = x.astype(jnp.float32)
+        use_mean = jnp.mean(compute, axis=axes)
+        use_var = jnp.var(compute, axis=axes)
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean.astype(mean.dtype)
+        var_out = momentum * var + (1.0 - momentum) * use_var.astype(var.dtype)
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - use_mean.reshape(shape)) * inv.reshape(shape)
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_var],
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ins, attrs):
+    x = first(ins, "X")
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    compute = x.astype(jnp.float32)
+    mean = jnp.mean(compute, axis=axes, keepdims=True)
+    var = jnp.var(compute, axis=axes, keepdims=True)
+    y = (compute - mean) / jnp.sqrt(var + eps)
+    scale, bias = maybe(ins, "Scale"), maybe(ins, "Bias")
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape).astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape).astype(jnp.float32)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [jnp.squeeze(mean, axes)],
+        "Variance": [jnp.squeeze(var, axes)],
+    }
+
+
+@register_op("instance_norm")
+def _instance_norm(ins, attrs):
+    x = first(ins, "X")
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    scale, bias = maybe(ins, "Scale"), maybe(ins, "Bias")
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return {"Y": [y], "SavedMean": [mean], "SavedVariance": [var]}
+
+
+@register_op("group_norm")
+def _group_norm(ins, attrs):
+    x = first(ins, "X")
+    groups = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    y = ((g - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    scale, bias = maybe(ins, "Scale"), maybe(ins, "Bias")
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return {"Y": [y], "Mean": [mean.reshape(n, groups)], "Variance": [var.reshape(n, groups)]}
+
+
+# ---------------------------------------------------------------------------
+# dropout (stateful: consumes the executor-provided rng key)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dropout", stateful=True)
+def _dropout(ins, attrs):
+    """reference: paddle/fluid/operators/dropout_op.cc. Both implementations
+    of the reference are supported; mask is a saved output consumed by the
+    custom grad (so backward reuses the forward mask instead of re-sampling)."""
+    x = first(ins, "X")
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones_like(x)]}
+    key = rng_key(ins)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+@register_grad("dropout")
+def _dropout_grad(ins, attrs):
+    dout = first(ins, "Out@GRAD")
+    mask = first(ins, "Mask")
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        dx = dout if impl == "upscale_in_train" else dout * (1.0 - p)
+    elif impl == "upscale_in_train":
+        dx = dout * mask / (1.0 - p)
+    else:
+        dx = dout * mask
+    return {"X@GRAD": [dx]}
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+
+@register_op("lookup_table_v2", nondiff_inputs=("Ids",))
+def _lookup_table(ins, attrs):
+    """reference: paddle/fluid/operators/lookup_table_op.cc. Dense gather on
+    TPU; the billion-feature sparse path lives in the PS stack instead
+    (SelectedRows grads are a host-side concern there)."""
+    w, ids = first(ins, "W"), first(ins, "Ids")
+    out = jnp.take(w, ids, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+@register_op("lookup_table", nondiff_inputs=("Ids",))
+def _lookup_table_v1(ins, attrs):
+    w, ids = first(ins, "W"), first(ins, "Ids")
+    if ids.ndim == 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return _lookup_table({"W": [w], "Ids": [ids]}, attrs)
+
+
+@register_op("one_hot", nondiff_inputs=("X",))
+def _one_hot(ins, attrs):
+    x = first(ins, "X")
+    depth = attrs.get("depth")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("cross_entropy", nondiff_inputs=("Label",))
+def _cross_entropy(ins, attrs):
+    x, label = first(ins, "X"), first(ins, "Label")
+    eps = 1e-8
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if label.ndim == x.ndim:
+            label = label[..., 0]
+        picked = jnp.take_along_axis(x, label[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(picked + eps)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(label[..., None] == ignore, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy", nondiff_inputs=("Label",))
+def _softmax_with_ce(ins, attrs):
+    """reference: paddle/fluid/operators/softmax_with_cross_entropy_op.cu —
+    fused, numerically stable via log-sum-exp."""
+    logits, label = first(ins, "Logits"), first(ins, "Label")
+    axis = attrs.get("axis", -1)
+    log_probs = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(log_probs)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
+    else:
+        squeezed = label[..., 0] if label.ndim == logits.ndim else label
+        picked = jnp.take_along_axis(
+            log_probs, squeezed[..., None].astype(jnp.int32), axis=axis
+        )
+        loss = -picked
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(squeezed[..., None] == ignore, 0.0, loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ins, attrs):
+    x, label = first(ins, "X"), first(ins, "Label")
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        norm = jnp.maximum(jnp.sum(label != ignore).astype(loss.dtype), 1.0)
+        loss = loss / norm
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    delta = attrs.get("delta", 1.0)
+    diff = y - x
+    absd = jnp.abs(diff)
+    loss = jnp.where(
+        absd <= delta, 0.5 * jnp.square(diff), delta * (absd - 0.5 * delta)
+    )
+    return {"Out": [loss], "Residual": [diff]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    diff = x - y
+    absd = jnp.abs(diff)
+    loss = jnp.where(
+        absd < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff), absd - 0.5 / sigma2
+    )
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, x.ndim)), keepdims=False).reshape(-1, 1)], "Diff": [diff]}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ins, attrs):
+    x, target = first(ins, "X"), first(ins, "Target")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-10)) - x)
+    reduction = attrs.get("reduction", "mean")
+    if reduction == "mean":
+        loss = jnp.mean(loss).reshape((1,))
+    elif reduction == "sum":
+        loss = jnp.sum(loss).reshape((1,))
+    elif reduction == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape((1,))
+    return {"Loss": [loss]}
+
+
+# ---------------------------------------------------------------------------
+# metrics (reference: paddle/fluid/operators/metrics/)
+# ---------------------------------------------------------------------------
+
+
+@register_op("accuracy", nondiff_inputs=("Out", "Indices", "Label"))
+def _accuracy(ins, attrs):
+    idx, label = first(ins, "Indices"), first(ins, "Label")
+    if label.ndim == 1:
+        label = label[:, None]
+    correct = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(idx.shape[0], jnp.float32)
+    return {
+        "Accuracy": [(num_correct / total).reshape((1,))],
+        "Correct": [num_correct.astype(jnp.int32).reshape((1,))],
+        "Total": [jnp.asarray([idx.shape[0]], jnp.int32)],
+    }
+
+
+@register_op("auc", nondiff_inputs=("Predict", "Label"))
+def _auc(ins, attrs):
+    """Streaming AUC via fixed histogram buckets
+    (reference: paddle/fluid/operators/metrics/auc_op.cc)."""
+    pred, label = first(ins, "Predict"), first(ins, "Label")
+    stat_pos, stat_neg = first(ins, "StatPos"), first(ins, "StatNeg")
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_score = pred[:, -1] if pred.ndim == 2 else pred
+    bucket = jnp.clip(
+        (pos_score * num_thresholds).astype(jnp.int64), 0, num_thresholds
+    )
+    lab = label.reshape(-1).astype(jnp.int64)
+    pos_inc = jnp.zeros_like(stat_pos).at[bucket].add(lab)
+    neg_inc = jnp.zeros_like(stat_neg).at[bucket].add(1 - lab)
+    new_pos = stat_pos + pos_inc
+    new_neg = stat_neg + neg_inc
+    # integrate trapezoid over descending threshold
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    total_pos, total_neg = tp[-1], fp[-1]
+    tpr = tp / jnp.maximum(total_pos, 1)
+    fpr = fp / jnp.maximum(total_neg, 1)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {
+        "AUC": [auc.reshape((1,))],
+        "StatPosOut": [new_pos],
+        "StatNegOut": [new_neg],
+    }

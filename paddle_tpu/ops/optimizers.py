@@ -1,0 +1,266 @@
+"""Optimizer update rules as ops.
+
+Mirrors the reference's optimizer op kernels (reference:
+paddle/fluid/operators/optimizers/sgd_op.h, momentum_op.h, adam_op.h,
+lamb_op.h, lars_momentum_op.cc ...). Updates are pure functions returning
+*Out states; the executor's buffer donation makes them in-place at the XLA
+level. All moment arithmetic runs in fp32 even for bf16 params.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+@register_op("sgd")
+def _sgd(ins, attrs):
+    p, g, lr = first(ins, "Param"), first(ins, "Grad"), first(ins, "LearningRate")
+    out = _f32(p) - _f32(lr) * _f32(g)
+    return {"ParamOut": [out.astype(p.dtype)]}
+
+
+@register_op("momentum")
+def _momentum(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    v, lr = _f32(first(ins, "Velocity")), _f32(first(ins, "LearningRate"))
+    mu = attrs.get("mu", 0.9)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if rd and attrs.get("regularization_method", "") == "l2_decay":
+        g = g + rd * p
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - lr * (g + mu * v_out)
+    else:
+        p_out = p - lr * v_out
+    param = first(ins, "Param")
+    return {
+        "ParamOut": [p_out.astype(param.dtype)],
+        "VelocityOut": [v_out],
+    }
+
+
+@register_op("adam")
+def _adam(ins, attrs):
+    p = _f32(first(ins, "Param"))
+    g = _f32(first(ins, "Grad"))
+    m1, m2 = _f32(first(ins, "Moment1")), _f32(first(ins, "Moment2"))
+    b1p, b2p = _f32(first(ins, "Beta1Pow")), _f32(first(ins, "Beta2Pow"))
+    lr = _f32(first(ins, "LearningRate"))
+    b1 = float(maybe(ins, "Beta1Tensor", attrs.get("beta1", 0.9)))
+    b2 = float(maybe(ins, "Beta2Tensor", attrs.get("beta2", 0.999)))
+    eps = attrs.get("epsilon", 1e-8)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    param = first(ins, "Param")
+    return {
+        "ParamOut": [p_out.astype(param.dtype)],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("adamw")
+def _adamw(ins, attrs):
+    """Decoupled weight decay on top of adam."""
+    coeff = attrs.get("coeff", 0.01)
+    p = _f32(first(ins, "Param"))
+    lr = _f32(first(ins, "LearningRate"))
+    outs = _adam(ins, attrs)
+    decayed = outs["ParamOut"][0].astype(jnp.float32) - lr * coeff * p
+    outs["ParamOut"] = [decayed.astype(first(ins, "Param").dtype)]
+    return outs
+
+
+@register_op("adagrad")
+def _adagrad(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    moment, lr = _f32(first(ins, "Moment")), _f32(first(ins, "LearningRate"))
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = moment + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "MomentOut": [m_out],
+    }
+
+
+@register_op("rmsprop")
+def _rmsprop(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    ms, lr = _f32(first(ins, "MeanSquare")), _f32(first(ins, "LearningRate"))
+    mom = _f32(first(ins, "Moment"))
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    if attrs.get("centered", False):
+        mg = _f32(first(ins, "MeanGrad"))
+        mg_out = rho * mg + (1 - rho) * g
+        ms_out = rho * ms + (1 - rho) * jnp.square(g)
+        denom = jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+    else:
+        mg_out = None
+        ms_out = rho * ms + (1 - rho) * jnp.square(g)
+        denom = jnp.sqrt(ms_out + eps)
+    mom_out = momentum * mom + lr * g / denom
+    p_out = p - mom_out
+    outs = {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "MomentOut": [mom_out],
+        "MeanSquareOut": [ms_out],
+    }
+    if mg_out is not None:
+        outs["MeanGradOut"] = [mg_out]
+    return outs
+
+
+@register_op("adamax")
+def _adamax(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    m, inf_norm = _f32(first(ins, "Moment")), _f32(first(ins, "InfNorm"))
+    b1p, lr = _f32(first(ins, "Beta1Pow")), _f32(first(ins, "LearningRate"))
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (inf_out + eps)
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "MomentOut": [m_out],
+        "InfNormOut": [inf_out],
+    }
+
+
+@register_op("adadelta")
+def _adadelta(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    avg_sq_grad = _f32(first(ins, "AvgSquaredGrad"))
+    avg_sq_upd = _f32(first(ins, "AvgSquaredUpdate"))
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    asg_out = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_out + eps)) * g
+    asu_out = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    p_out = p + update
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "AvgSquaredGradOut": [asg_out],
+        "AvgSquaredUpdateOut": [asu_out],
+    }
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    moment, lr = _f32(first(ins, "Moment")), _f32(first(ins, "LearningRate"))
+    decay, eps = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6)
+    m_out = decay * moment + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "MomentOut": [m_out],
+    }
+
+
+@register_op("ftrl")
+def _ftrl(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    sq, lin = _f32(first(ins, "SquaredAccumulator")), _f32(
+        first(ins, "LinearAccumulator")
+    )
+    lr = _f32(first(ins, "LearningRate"))
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    x = jnp.clip(new_lin, -l1, l1) - new_lin
+    y = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_out = x / y
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [new_lin],
+    }
+
+
+@register_op("lamb")
+def _lamb(ins, attrs):
+    """reference: paddle/fluid/operators/optimizers/lamb_op.h — layerwise
+    adaptive moments, the large-batch BERT optimizer."""
+    p = _f32(first(ins, "Param"))
+    g = _f32(first(ins, "Grad"))
+    m1, m2 = _f32(first(ins, "Moment1")), _f32(first(ins, "Moment2"))
+    b1p, b2p = _f32(first(ins, "Beta1Pow")), _f32(first(ins, "Beta2Pow"))
+    lr = _f32(first(ins, "LearningRate"))
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1_hat = m1n / (1 - b1p)
+    m2_hat = m2n / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = p - lr * trust * r
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("lars_momentum")
+def _lars_momentum(ins, attrs):
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    v, lr = _f32(first(ins, "Velocity")), _f32(first(ins, "LearningRate"))
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + wd * p)
+    p_out = p - v_out
+    return {
+        "ParamOut": [p_out.astype(first(ins, "Param").dtype)],
+        "VelocityOut": [v_out],
+    }
+
+
+@register_op("dpsgd", stateful=True)
+def _dpsgd(ins, attrs):
+    """Differentially-private SGD (reference: paddle/fluid/operators/
+    optimizers/dpsgd_op.cc): clip per-batch grad, add gaussian noise."""
+    import jax
+
+    from paddle_tpu.ops.common import rng_key
+
+    p, g = _f32(first(ins, "Param")), _f32(first(ins, "Grad"))
+    lr = _f32(first(ins, "LearningRate"))
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = jnp.where(g_norm > clip, g * (clip / g_norm), g)
+    noise = sigma * clip * jax.random.normal(rng_key(ins), g.shape)
+    p_out = p - lr * (g + noise / batch_size)
+    return {"ParamOut": [p_out.astype(first(ins, "Param").dtype)]}

@@ -1,0 +1,622 @@
+"""Optimizers: append_backward + update ops, as program transforms.
+
+Same architecture as the reference (reference: python/paddle/fluid/
+optimizer.py:54 Optimizer — backward :608, apply_gradients :672, minimize
+:780): minimize() rewrites the program with grad ops then appends one update
+op per parameter, with accumulators as persistable vars initialized in the
+startup program. The update ops lower to fused fp32-master-arithmetic jnp
+rules (ops/optimizers.py) and compile into the same XLA step as the model.
+"""
+
+from paddle_tpu.core.backward import append_backward
+from paddle_tpu.core.ir import default_main_program, default_startup_program, Parameter
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.layers import tensor as tensor_layers
+from paddle_tpu.utils import unique_name
+from paddle_tpu.utils.enforce import enforce
+
+_OP_ROLE_OPTIMIZE = 2
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators = {}
+        self._lr_var = None
+        self.helper = None
+
+    # -- learning rate ------------------------------------------------
+    def _create_global_learning_rate(self):
+        if self._lr_var is not None:
+            return
+        from paddle_tpu.core.ir import Variable
+
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+        else:
+            self._lr_var = tensor_layers.create_global_var(
+                shape=[1],
+                value=float(self._learning_rate),
+                dtype="float32",
+                persistable=True,
+                name=unique_name.generate("learning_rate"),
+            )
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    @property
+    def learning_rate_var(self):
+        return self._lr_var
+
+    def current_step_lr(self, scope=None):
+        import numpy as np
+
+        from paddle_tpu.core.scope import global_scope
+
+        scope = scope or global_scope()
+        v = scope.find_var(self._lr_var.name)
+        return None if v is None else float(np.asarray(v).reshape(-1)[0])
+
+    def _param_lr(self, param):
+        plr = param.optimize_attr.get("learning_rate", 1.0)
+        if plr == 1.0:
+            return self._lr_var
+        from paddle_tpu import layers
+
+        return layers.scale(self._lr_var, scale=float(plr))
+
+    # -- accumulators -------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype="float32", shape=None):
+        acc = self._accumulators.setdefault(name, {})
+        if param.name in acc:
+            return acc[param.name]
+        var_name = unique_name.generate(f"{param.name}_{name}")
+        shape = shape if shape is not None else list(param.shape)
+        main_block = default_main_program().global_block()
+        var = main_block.create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True
+        )
+        var.stop_gradient = True
+        sblock = default_startup_program().global_block()
+        sblock.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True)
+        sblock.append_op(
+            "fill_constant",
+            {},
+            {"Out": [var_name]},
+            {"shape": shape, "dtype": dtype, "value": fill_value},
+        )
+        acc[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    # -- pipeline -----------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def _append_regularization(self, params_grads):
+        from paddle_tpu import layers
+
+        out = []
+        for p, g in params_grads:
+            reg = p.regularizer or self.regularization
+            if reg is None or g is None:
+                out.append((p, g))
+                continue
+            out.append((p, reg._append_regularization_op(p, g)))
+        return out
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        params_grads = self._append_regularization(params_grads)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            ops.append(self._append_optimize_op(block, (p, g)))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name]},
+            {"op_role": _OP_ROLE_OPTIMIZE},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Velocity": [velocity.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name], "VelocityOut": [velocity.name]},
+            {
+                "mu": self._momentum,
+                "use_nesterov": self._use_nesterov,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate,
+        momentum=0.9,
+        lars_coeff=0.001,
+        lars_weight_decay=0.0005,
+        epsilon=0.0,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Velocity": [velocity.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name], "VelocityOut": [velocity.name]},
+            {
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                "epsilon": self._epsilon,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name], "MomentOut": [moment.name]},
+            {"epsilon": self._epsilon, "op_role": _OP_ROLE_OPTIMIZE},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _op_type = "adam"
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        lazy_mode=False,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        attrs = {
+            "beta1": self._beta1,
+            "beta2": self._beta2,
+            "epsilon": self._epsilon,
+            "op_role": _OP_ROLE_OPTIMIZE,
+        }
+        attrs.update(self._extra_attrs())
+        return block.append_op(
+            self._op_type,
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            attrs,
+        )
+
+
+class AdamWOptimizer(AdamOptimizer):
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+
+class LambOptimizer(AdamOptimizer):
+    _op_type = "lamb"
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        lamb_weight_decay=0.01,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-6,
+        exclude_from_weight_decay_fn=None,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "lamb",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            {
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": wd,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [self._get_accumulator("moment", p).name],
+                "InfNorm": [self._get_accumulator("inf_norm", p).name],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p).name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "MomentOut": [self._get_accumulator("moment", p).name],
+                "InfNormOut": [self._get_accumulator("inf_norm", p).name],
+            },
+            {
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+    def _finish_update(self, block, params_grads):
+        """beta1_pow *= beta1 after all updates
+        (reference: python/paddle/fluid/optimizer.py Adamax._finish_update)."""
+        from paddle_tpu import layers
+
+        for p, g in params_grads:
+            if g is None:
+                continue
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                "scale",
+                {"X": [b1p.name]},
+                {"Out": [b1p.name]},
+                {"scale": self._beta1, "op_role": _OP_ROLE_OPTIMIZE},
+            )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("_avg_squared_grad", p)
+            self._add_accumulator("_avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("_avg_squared_grad", p)
+        asu = self._get_accumulator("_avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "AvgSquaredGrad": [asg.name],
+                "AvgSquaredUpdate": [asu.name],
+            },
+            {
+                "ParamOut": [p.name],
+                "AvgSquaredGradOut": [asg.name],
+                "AvgSquaredUpdateOut": [asu.name],
+            },
+            {"epsilon": self._epsilon, "rho": self._rho, "op_role": _OP_ROLE_OPTIMIZE},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name], "MomentOut": [moment.name]},
+            {"decay": self._decay, "epsilon": self._epsilon, "op_role": _OP_ROLE_OPTIMIZE},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate,
+        rho=0.95,
+        epsilon=1e-6,
+        momentum=0.0,
+        centered=False,
+        **kwargs,
+    ):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        mom = self._get_accumulator("momentum", p)
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [mom.name],
+                "MeanSquare": [ms.name],
+                "MeanGrad": [mg.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "MomentOut": [mom.name],
+                "MeanSquareOut": [ms.name],
+                "MeanGradOut": [mg.name],
+            },
+            {
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "SquaredAccumulator": [sq.name],
+                "LinearAccumulator": [lin.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {
+                "ParamOut": [p.name],
+                "SquaredAccumOut": [sq.name],
+                "LinearAccumOut": [lin.name],
+            },
+            {
+                "l1": self._l1,
+                "l2": self._l2,
+                "lr_power": self._lr_power,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            {
+                "Param": [p.name],
+                "Grad": [g.name],
+                "LearningRate": [self._param_lr(p).name],
+            },
+            {"ParamOut": [p.name]},
+            {
+                "clip": self._clip,
+                "batch_size": self._batch_size,
+                "sigma": self._sigma,
+                "op_role": _OP_ROLE_OPTIMIZE,
+            },
+        )
+
+
+# public aliases matching the reference API surface
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

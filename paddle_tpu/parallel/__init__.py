@@ -1,0 +1,6 @@
+from paddle_tpu.parallel.env import (
+    collective_context,
+    current_mesh_axis,
+    make_mesh,
+    ParallelEnv,
+)

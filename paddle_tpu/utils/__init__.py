@@ -1,0 +1,3 @@
+from paddle_tpu.utils.enforce import EnforceError, enforce
+from paddle_tpu.utils.flags import flags, define_flag
+from paddle_tpu.utils import unique_name

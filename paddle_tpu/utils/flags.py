@@ -1,0 +1,78 @@
+"""Global flag registry with environment-variable bridge.
+
+TPU-native analog of the reference's gflags registry
+(reference: paddle/fluid/platform/flags.cc:33-470) and the Python
+``__bootstrap__`` env bridge (reference: python/paddle/fluid/__init__.py:136).
+Flags may be set via ``FLAGS_<name>`` environment variables or at runtime via
+``flags.<name> = value`` / ``set_flags({...})``.
+"""
+
+import os
+
+
+class _FlagRegistry:
+    def __init__(self):
+        object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_values", {})
+
+    def define(self, name, default, help=""):
+        self._defs[name] = (type(default), default, help)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            self._values[name] = _parse(type(default), env)
+        else:
+            self._values[name] = default
+
+    def __getattr__(self, name):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"undefined flag FLAGS_{name}")
+
+    def __setattr__(self, name, value):
+        if name not in self._defs:
+            raise AttributeError(f"undefined flag FLAGS_{name}")
+        ty = self._defs[name][0]
+        self._values[name] = _parse(ty, value) if isinstance(value, str) else ty(value)
+
+    def get_all(self):
+        return dict(self._values)
+
+
+def _parse(ty, s):
+    if ty is bool:
+        return s if isinstance(s, bool) else str(s).lower() in ("1", "true", "yes")
+    return ty(s)
+
+
+flags = _FlagRegistry()
+
+
+def define_flag(name, default, help=""):
+    flags.define(name, default, help)
+
+
+def set_flags(d):
+    for k, v in d.items():
+        setattr(flags, k.replace("FLAGS_", ""), v)
+
+
+def get_flags(names=None):
+    all_flags = flags.get_all()
+    if names is None:
+        return all_flags
+    if isinstance(names, str):
+        names = [names]
+    return {n: all_flags[n.replace("FLAGS_", "")] for n in names}
+
+
+# Core flags, mirroring the categories in the reference's flags.cc.
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf")
+define_flag("benchmark", False, "block after each op for timing")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold (donation-based on TPU)")
+define_flag("use_donation", True, "donate parameter buffers into compiled steps")
+define_flag("executor_log_level", 0, "VLOG level for executor tracing")
+define_flag("rpc_deadline", 180000, "PS RPC deadline ms")
+define_flag("rpc_retry_times", 3, "PS RPC retry count")
+define_flag("amp_dtype", "bfloat16", "low-precision dtype for AMP on TPU")
+define_flag("allocator_strategy", "auto_growth", "host allocator strategy label")

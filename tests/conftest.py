@@ -1,0 +1,47 @@
+"""Test config: force an 8-virtual-device CPU platform BEFORE jax import so
+distributed/sharding tests run without TPU hardware (the strategy SURVEY.md §4
+maps from the reference's subprocess-on-localhost distributed tests)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores JAX_PLATFORMS; force CPU through the config API.
+jax.config.update("jax_platforms", "cpu")
+# Correctness tests compare against float64 numpy references.
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Give every test fresh default programs, scope, and name counter."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import ir
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.utils import unique_name
+
+    old_main, old_startup = ir._main_program, ir._startup_program
+    old_scope = scope_mod._global_scope
+    ir._main_program = ir.Program()
+    ir._startup_program = ir.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    gen = unique_name.switch()
+    yield
+    ir._main_program, ir._startup_program = old_main, old_startup
+    scope_mod._global_scope = old_scope
+    unique_name.switch(gen)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

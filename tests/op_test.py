@@ -1,0 +1,133 @@
+"""Op correctness harness, modeled on the reference's OpTest
+(reference: python/paddle/fluid/tests/unittests/op_test.py:170) — declare an
+op type, numpy inputs and expected outputs; check_output runs the single op
+through the real executor; check_grad compares the IR-level backward pass
+(append_backward + vjp-synthesized grad ops) against numeric finite
+differences (reference: op_test.py:57 get_numeric_gradient).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+class OpTest:
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    def _build_program(self):
+        prog = Program()
+        startup = Program()
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            input_desc = {}
+            for slot, arrs in self.inputs.items():
+                arrs = arrs if isinstance(arrs, list) else [(slot, arrs)]
+                names = []
+                for name, arr in arrs:
+                    block.create_var(
+                        name=name,
+                        shape=list(arr.shape),
+                        dtype=str(arr.dtype),
+                        is_data=True,
+                        stop_gradient=False,
+                    )
+                    names.append(name)
+                input_desc[slot] = names
+            output_desc = {}
+            for slot, outs in self.outputs.items():
+                outs = outs if isinstance(outs, list) else [(slot, outs)]
+                names = []
+                for name, _ in outs:
+                    block.create_var(name=name, shape=None, dtype="float32")
+                    names.append(name)
+                output_desc[slot] = names
+            block.append_op(self.op_type, input_desc, output_desc, dict(self.attrs))
+        return prog, startup
+
+    def _feed(self):
+        feed = {}
+        for slot, arrs in self.inputs.items():
+            arrs = arrs if isinstance(arrs, list) else [(slot, arrs)]
+            for name, arr in arrs:
+                feed[name] = arr
+        return feed
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        prog, _ = self._build_program()
+        fetch = []
+        expected = []
+        for slot, outs in self.outputs.items():
+            outs = outs if isinstance(outs, list) else [(slot, outs)]
+            for name, exp in outs:
+                if exp is None:
+                    continue
+                fetch.append(name)
+                expected.append(exp)
+        exe = fluid.Executor(fluid.CPUPlace())
+        results = exe.run(prog, feed=self._feed(), fetch_list=fetch)
+        for name, got, exp in zip(fetch, results, expected):
+            np.testing.assert_allclose(
+                got,
+                exp,
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"{self.op_type} output {name} mismatch",
+            )
+
+    def check_grad(
+        self,
+        inputs_to_check,
+        output_name,
+        max_relative_error=0.005,
+        delta=5e-3,
+        no_grad_set=None,
+    ):
+        """Analytic (IR backward) vs numeric finite-difference gradients of
+        mean(output) w.r.t. each input."""
+        prog, _ = self._build_program()
+        block = prog.global_block()
+        from paddle_tpu.core.ir import program_guard as pg
+
+        with pg(prog, Program()):
+            out_var = block.vars[output_name]
+            loss = fluid.layers.mean(out_var)
+            grads = fluid.gradients(
+                loss, [block.vars[n] for n in inputs_to_check], no_grad_set=no_grad_set
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = self._feed()
+        analytic = exe.run(
+            prog, feed=feed, fetch_list=[g.name for g in grads]
+        )
+
+        def eval_loss(feed_override):
+            r = exe.run(prog, feed=feed_override, fetch_list=[loss.name])
+            return float(np.asarray(r[0]).reshape(()))
+
+        for name, a_grad in zip(inputs_to_check, analytic):
+            base = feed[name].astype(np.float64)
+            numeric = np.zeros_like(base)
+            flat = base.reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for i in range(flat.size):
+                plus = flat.copy()
+                plus[i] += delta
+                minus = flat.copy()
+                minus[i] -= delta
+                f2 = dict(feed)
+                f2[name] = plus.reshape(base.shape).astype(feed[name].dtype)
+                lp = eval_loss(f2)
+                f2[name] = minus.reshape(base.shape).astype(feed[name].dtype)
+                lm = eval_loss(f2)
+                num_flat[i] = (lp - lm) / (2 * delta)
+            a = np.asarray(a_grad, dtype=np.float64)
+            denom = np.maximum(np.abs(numeric), np.maximum(np.abs(a), 1e-3))
+            rel = np.abs(a - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {name}: max rel err {rel.max():.5f} "
+                f"(analytic {a.reshape(-1)[:5]}, numeric {numeric.reshape(-1)[:5]})"
+            )

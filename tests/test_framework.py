@@ -1,0 +1,158 @@
+"""Program/Block/Variable IR + Executor behavior tests
+(reference analogs: test_program.py, test_executor_and_mul.py, scope_test)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.utils.enforce import EnforceError
+
+
+def test_program_structure():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=3)
+    assert prog.num_blocks() == 1
+    types = [op.type for op in prog.global_block().ops]
+    assert "mul" in types and "elementwise_add" in types
+    params = prog.all_parameters()
+    assert len(params) == 2  # weight + bias
+    w = [p for p in params if p.shape == (4, 3)]
+    assert len(w) == 1
+
+
+def test_program_serialization_roundtrip():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[4])
+        fluid.layers.fc(x, size=3)
+    data = prog.to_bytes()
+    prog2 = Program.from_bytes(data)
+    assert [op.type for op in prog2.global_block().ops] == [
+        op.type for op in prog.global_block().ops
+    ]
+    assert set(prog2.global_block().vars) == set(prog.global_block().vars)
+    # parameters survive the round trip as parameters
+    assert len(prog2.all_parameters()) == len(prog.all_parameters())
+
+
+def test_executor_feed_fetch():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[3])
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.arange(6, dtype="float32").reshape(2, 3)
+    (out,) = exe.run(prog, feed={"x": arr}, fetch_list=[y])
+    np.testing.assert_allclose(out, arr * 2 + 1)
+
+
+def test_executor_uninitialized_var_raises():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[4])
+        fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(EnforceError, match="not\\s+initialized"):
+        exe.run(
+            prog,
+            feed={"x": np.zeros((2, 4), "float32")},
+            fetch_list=[prog.global_block().ops[-1].output("Out")[0]],
+        )
+
+
+def test_persistable_state_updates():
+    """Optimizer writes must land back in the scope (functional in-place)."""
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=3, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        w_name = prog.all_parameters()[0].name
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    w0 = np.asarray(scope.find_var(w_name)).copy()
+    exe.run(prog, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[loss])
+    w1 = np.asarray(scope.find_var(w_name))
+    assert not np.allclose(w0, w1), "parameter did not update"
+
+
+def test_program_clone_for_test_strips_backward():
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=3)
+        d = fluid.layers.dropout(y, dropout_prob=0.5)
+        loss = fluid.layers.mean(d)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = prog.clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert not any(t.endswith("_grad") for t in types)
+    assert "sgd" not in types
+    drop_ops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attrs["is_test"] is True
+    # original program untouched
+    assert any(t == "sgd" for t in [op.type for op in prog.global_block().ops])
+
+
+def test_scope_parent_chain():
+    s = Scope()
+    s.set("a", 1)
+    kid = s.new_scope()
+    kid.set("b", 2)
+    assert kid.find_var("a") == 1
+    assert kid.find_var("b") == 2
+    assert s.find_var("b") is None
+
+
+def test_rng_determinism_per_seed():
+    def run_once(seed):
+        prog = Program()
+        startup = Program()
+        with program_guard(prog, startup):
+            x = fluid.layers.tensor.gaussian_random([4, 4], seed=0)
+        startup.random_seed = seed
+        prog.random_seed = seed
+        exe = fluid.Executor(fluid.CPUPlace())
+        (out,) = exe.run(prog, fetch_list=[x])
+        return out
+
+    a = run_once(7)
+    b = run_once(9)
+    assert not np.allclose(a, b)
+
+
+def test_variable_operator_overloads():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[3])
+        y = x * 2.0 + 1.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.ones((2, 3), "float32")
+    (out,) = exe.run(prog, feed={"x": arr}, fetch_list=[y])
+    np.testing.assert_allclose(out, arr * 2 + 1)
+
+
+def test_nan_check_mode():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[3])
+        y = fluid.layers.log(x)  # log of negative = nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(EnforceError, match="NaN/Inf"):
+            exe.run(
+                prog,
+                feed={"x": -np.ones((2, 3), "float32")},
+                fetch_list=[y],
+            )
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})

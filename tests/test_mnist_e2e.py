@@ -1,0 +1,116 @@
+"""End-to-end convergence smoke tests — the analog of the reference's "book"
+tests (reference: python/paddle/fluid/tests/book/test_recognize_digits.py):
+train a small model on synthetic data and assert the loss actually drops.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def _synthetic_mnist(rng, n=256):
+    """Linearly separable-ish synthetic digits."""
+    x = rng.rand(n, 784).astype("float32")
+    w_true = rng.rand(784, 10).astype("float32")
+    y = (x @ w_true).argmax(axis=1).astype("int64").reshape(n, 1)
+    return x, y
+
+
+def test_mnist_mlp_converges(rng):
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        img = fluid.data("img", shape=[784])
+        label = fluid.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, size=64, act="relu")
+        logits = fluid.layers.fc(h, size=10)
+        loss_all = fluid.layers.softmax_with_cross_entropy(logits, label)
+        loss = fluid.layers.mean(loss_all)
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x, y = _synthetic_mnist(rng)
+    losses = []
+    for epoch in range(30):
+        (l, a) = exe.run(
+            prog, feed={"img": x, "label": y}, fetch_list=[loss, acc]
+        )
+        losses.append(float(l[0]))
+    assert losses[-1] < losses[0] * 0.5, f"no convergence: {losses[:3]} -> {losses[-3:]}"
+    assert float(a[0]) > 0.5
+
+
+def test_regression_sgd_converges(rng):
+    """fit-a-line analog."""
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[13])
+        y = fluid.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.rand(128, 13).astype("float32")
+    w_true = rng.rand(13, 1).astype("float32")
+    yv = xv @ w_true + 0.1
+    first = last = None
+    for i in range(100):
+        (l,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        if first is None:
+            first = float(l[0])
+        last = float(l[0])
+    assert last < first * 0.1
+
+
+def test_momentum_and_weight_decay(rng):
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[8])
+        y = fluid.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.Momentum(
+            learning_rate=0.01,
+            momentum=0.9,
+            regularization=fluid.regularizer.L2Decay(1e-4),
+        )
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.rand(64, 8).astype("float32")
+    yv = (xv.sum(axis=1, keepdims=True)).astype("float32")
+    first = last = None
+    for i in range(60):
+        (l,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        if first is None:
+            first = float(l[0])
+        last = float(l[0])
+    assert last < first * 0.2
+
+
+def test_lr_scheduler_noam(rng):
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(pred)
+        lr = fluid.layers.learning_rate_scheduler.noam_decay(64, warmup_steps=10)
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    lrs = []
+    for i in range(20):
+        out = exe.run(
+            prog, feed={"x": rng.rand(4, 4).astype("float32")}, fetch_list=[lr]
+        )
+        lrs.append(float(out[0][0]))
+    # noam: rises during warmup then decays
+    assert lrs[5] > lrs[0]
+    assert lrs[-1] < max(lrs)

@@ -1,0 +1,166 @@
+"""Forward + gradient checks for math ops via the OpTest harness."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self, rng):
+        x = rng.rand(3, 4).astype("float32")
+        y = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self, rng):
+        self.setup(rng)
+        self.check_output()
+
+    def test_grad(self, rng):
+        self.setup(rng)
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def test_axis_broadcast(self, rng):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+
+    def setup(self, rng):
+        x = rng.rand(3, 4).astype("float32") + 0.5
+        y = rng.rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self, rng):
+        self.setup(rng)
+        self.check_output()
+
+    def test_grad(self, rng):
+        self.setup(rng)
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def setup(self, rng):
+        x = rng.rand(4, 5).astype("float32")
+        y = rng.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self, rng):
+        self.setup(rng)
+        self.check_output()
+
+    def test_grad(self, rng):
+        self.setup(rng)
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def test_output(self, rng):
+        x = rng.rand(5, 4).astype("float32")
+        y = rng.rand(3, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+        self.check_output()
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_output_and_grad(self, rng):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y).reshape(2, 5)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test_output_and_grad(self, rng):
+        x = rng.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = "reduce_mean"
+
+    def test_output(self, rng):
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.array([x.mean()], dtype="float32")}
+        self.check_output()
+
+
+class TestSqrtGrad(OpTest):
+    op_type = "sqrt"
+
+    def test_grad(self, rng):
+        x = (rng.rand(3, 4) + 0.5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sqrt(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test_output(self, rng):
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.check_output()
+
+
+class TestSumOp(OpTest):
+    op_type = "sum"
+
+    def test_output(self, rng):
+        a = rng.rand(3, 4).astype("float32")
+        b = rng.rand(3, 4).astype("float32")
+        c = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def test_output(self, rng):
+        x = rng.rand(4, 10).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 3}
+        vals = np.sort(x, axis=1)[:, ::-1][:, :3]
+        self.outputs = {"Out": vals, "Indices": None}
+        self.check_output()

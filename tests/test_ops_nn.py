@@ -1,0 +1,200 @@
+"""Forward + gradient checks for nn ops."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test_output_and_grad(self, rng):
+        x = rng.rand(3, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _softmax_np(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def test_output_and_grad(self, rng):
+        x = (rng.rand(3, 4) - 0.5).astype("float32")
+        x[np.abs(x) < 0.05] = 0.1  # keep away from the kink
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoid(OpTest):
+    op_type = "sigmoid"
+
+    def test_output_and_grad(self, rng):
+        x = (rng.rand(3, 4) - 0.5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def test_output(self, rng):
+        probs = _softmax_np(rng.rand(4, 5).astype("float32"))
+        label = rng.randint(0, 5, (4, 1)).astype("int64")
+        expected = -np.log(probs[np.arange(4), label[:, 0]] + 1e-8).reshape(4, 1)
+        self.inputs = {"X": probs, "Label": label}
+        self.outputs = {"Y": expected}
+        self.check_output(atol=1e-4)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test_output(self, rng):
+        logits = rng.rand(4, 5).astype("float32") * 3
+        label = rng.randint(0, 5, (4, 1)).astype("int64")
+        sm = _softmax_np(logits)
+        loss = -np.log(sm[np.arange(4), label[:, 0]]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-4)
+
+
+class TestConv2D(OpTest):
+    op_type = "conv2d"
+
+    def _conv_ref(self, x, w, stride=1, pad=0):
+        n, c, h, wd = x.shape
+        oc, ic, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        oh = (h + 2 * pad - kh) // stride + 1
+        ow = (wd + 2 * pad - kw) // stride + 1
+        out = np.zeros((n, oc, oh, ow), dtype=x.dtype)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, i * stride : i * stride + kh, j * stride : j * stride + kw]
+                out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+        return out
+
+    def test_output(self, rng):
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        w = rng.rand(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.outputs = {"Output": self._conv_ref(x, w, 1, 1)}
+        self.check_output(atol=1e-3, rtol=1e-3)
+
+    def test_grad(self, rng):
+        x = rng.rand(1, 2, 5, 5).astype("float32")
+        w = rng.rand(2, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [0, 0]}
+        self.outputs = {"Output": self._conv_ref(x, w)}
+        self.check_grad(["Input", "Filter"], "Output", max_relative_error=0.02)
+
+
+class TestPool2DMax(OpTest):
+    op_type = "pool2d"
+
+    def test_output(self, rng):
+        x = rng.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]}
+        expected = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.outputs = {"Out": expected}
+        self.check_output()
+
+
+class TestPool2DAvg(OpTest):
+    op_type = "pool2d"
+
+    def test_output_and_grad(self, rng):
+        x = rng.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]}
+        expected = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": expected}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def test_output(self, rng):
+        x = rng.rand(4, 3, 2, 2).astype("float32")
+        scale = rng.rand(3).astype("float32")
+        bias = rng.rand(3).astype("float32")
+        mean = np.zeros(3, dtype="float32")
+        var = np.ones(3, dtype="float32")
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {
+            "X": x,
+            "Scale": scale,
+            "Bias": bias,
+            "Mean": mean,
+            "Variance": var,
+        }
+        self.attrs = {"momentum": 0.9, "epsilon": 1e-5}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": 0.9 * mean + 0.1 * bm,
+            "VarianceOut": 0.9 * var + 0.1 * bv,
+        }
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test_output_and_grad(self, rng):
+        x = rng.rand(3, 8).astype("float32")
+        scale = rng.rand(8).astype("float32")
+        bias = rng.rand(8).astype("float32")
+        m = x.mean(axis=1, keepdims=True)
+        v = x.var(axis=1, keepdims=True)
+        y = (x - m) / np.sqrt(v + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+        self.check_output(atol=1e-4, rtol=1e-3)
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test_output_and_grad(self, rng):
+        w = rng.rand(10, 4).astype("float32")
+        ids = rng.randint(0, 10, (3, 5)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+        self.check_output()
+        self.check_grad(["W"], "Out", max_relative_error=0.01)
+
+
+class TestAccuracyOp(OpTest):
+    op_type = "accuracy"
+
+    def test_output(self, rng):
+        idx = np.array([[0, 1], [2, 3], [1, 0]]).astype("int64")
+        label = np.array([[1], [0], [2]]).astype("int64")
+        self.inputs = {
+            "Out": rng.rand(3, 2).astype("float32"),
+            "Indices": idx,
+            "Label": label,
+        }
+        self.outputs = {"Accuracy": np.array([1.0 / 3], dtype="float32")}
+        self.check_output()
