@@ -1,0 +1,89 @@
+"""Headline benchmark: BERT-base pretraining throughput on one chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+The reference publishes no in-repo numbers (see BASELINE.md), so vs_baseline
+is reported against the BASELINE.json north-star MFU target (value/target).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    seq_len = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    cfg = bert.BertConfig.base()
+
+    main_prog, startup, feeds, fetches = bert.build_bert_pretrain(
+        cfg, seq_len=seq_len, lr=1e-4
+    )
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    data = bert.synthetic_batch(rng, batch, seq_len, cfg)
+
+    # warmup (compile)
+    for _ in range(2):
+        exe.run(main_prog, feed=data, fetch_list=[fetches[0]])
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=data, fetch_list=[fetches[0]])
+    dt = time.perf_counter() - t0
+    tokens_per_sec = steps * batch * seq_len / dt
+
+    # MFU estimate: ~6 * params * tokens FLOPs for fwd+bwd
+    n_params = sum(
+        int(np.prod(p.shape)) for p in main_prog.all_parameters()
+    )
+    flops_per_token = 6 * n_params
+    achieved = tokens_per_sec * flops_per_token
+    peak = _chip_peak_flops()
+    mfu = achieved / peak if peak else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.5, 4),  # vs the >=50% MFU north star
+                "extra": {
+                    "batch": batch,
+                    "seq_len": seq_len,
+                    "params": n_params,
+                    "mfu_est": round(mfu, 4),
+                    "final_loss": float(np.asarray(out[0]).reshape(-1)[0]),
+                },
+            }
+        )
+    )
+
+
+def _chip_peak_flops():
+    """Peak bf16 FLOP/s for the local chip (v5e ~= 394 TFLOP/s bf16)."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 394e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 0.0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+from paddle_tpu.amp.decorator import (
+    AutoMixedPrecisionLists,
+    OptimizerWithMixedPrecision,
+    decorate,
+    rewrite_program_amp,
+)

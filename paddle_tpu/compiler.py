@@ -142,9 +142,9 @@ class CompiledProgram:
                 in_shardings=in_shardings,
                 donate_argnums=((1,) if donated else ()),
             )
-            entry = (compiled, donated, readonly, written)
+            entry = (compiled, donated, readonly, written, repl)
             self._cache[key] = entry
-        compiled, donated, readonly, written = entry
+        compiled, donated, readonly, written, repl = entry
         missing = [n for n in donated + readonly if not scope.has_var(n)]
         if missing:
             raise EnforceError(
@@ -152,8 +152,14 @@ class CompiledProgram:
                 f"(run the startup program first?)"
             )
         feed_vals = tuple(feed_arrays[n] for n in feed_names)
-        donated_vals = tuple(scope.find_var(n) for n in donated)
-        readonly_vals = tuple(scope.find_var(n) for n in readonly)
+        # commit scope inputs to the mesh (replicated) so first-step vs
+        # steady-state layouts match — same fix as Executor._run_compiled
+        donated_vals = tuple(
+            jax.device_put(scope.find_var(n), repl) for n in donated
+        )
+        readonly_vals = tuple(
+            jax.device_put(scope.find_var(n), repl) for n in readonly
+        )
         rng_key = exe._next_rng_key(self._program)
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")

@@ -115,10 +115,12 @@ def resolve_op_def(op_type):
 # ---------------------------------------------------------------------------
 
 
-def _requires_grad_vars(block, ops, no_grad_set):
-    """Forward propagation of the requires-grad property."""
+def _requires_grad_vars(block, ops, no_grad_set, extra_seeds=()):
+    """Forward propagation of the requires-grad property. `extra_seeds` are
+    vars the caller wants gradients for even if they are not leafs (the
+    gradients() API on intermediate activations)."""
     produced = {n for op in ops for n in op.output_names()}
-    requires = set()
+    requires = set(extra_seeds)
     for v in block.vars.values():
         if v.name in no_grad_set:
             continue
@@ -150,7 +152,9 @@ def _create_grad_var(block, fwd_name, grad_name):
     )
 
 
-def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+def append_backward(
+    loss, parameter_list=None, no_grad_set=None, callbacks=None, extra_seeds=()
+):
     """Append grad ops for `loss` to its program; returns [(param, grad)].
 
     reference: python/paddle/fluid/backward.py:1139.
@@ -175,7 +179,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
     if fwd_ops:
         fwd_ops[-1].attrs["op_role"] = _OP_ROLE_LOSS
 
-    requires = _requires_grad_vars(block, fwd_ops, no_grad_set)
+    requires = _requires_grad_vars(block, fwd_ops, no_grad_set, extra_seeds)
 
     # relevance: ops on a path from requires-grad vars to the loss
     pending = {loss.name}
@@ -322,6 +326,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
             attrs=grad_attrs,
         )
 
+    # multi-consumer extra seeds (gradients() on intermediates) may still
+    # hold unsummed partials — their producer op need not be relevant
+    for name in extra_seeds:
+        finalize(name)
+
     # finalize any leaf grads never finalized (params consumed once)
     params_and_grads = []
     if parameter_list is not None:
@@ -352,11 +361,17 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     block = target.block
     for v in inputs:
         v.stop_gradient = False
-    pg = append_backward(
-        target, parameter_list=None, no_grad_set=no_grad_set
+    append_backward(
+        target,
+        parameter_list=None,
+        no_grad_set=no_grad_set,
+        extra_seeds=[v.name for v in inputs],
     )
     out = []
     for v in inputs:
+        # intermediate (non-leaf) targets never hit the param finalize loop;
+        # collapse their partial grads explicitly
         gname = v.name + "@GRAD"
-        out.append(block.vars.get(gname))
+        grad_var = block.vars.get(gname)
+        out.append(grad_var)
     return out

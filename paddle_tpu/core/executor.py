@@ -205,16 +205,7 @@ class Executor:
     def _to_device(self, value, block, name):
         if isinstance(value, jax.Array):
             return value
-        var = block.vars.get(name)
-        arr = np.asarray(value)
-        if var is not None and var.dtype is not None:
-            want = to_numpy_dtype(var.dtype)
-            if arr.dtype != want and not (
-                np.issubdtype(arr.dtype, np.floating)
-                and str(want) in ("float32", "bfloat16")
-            ):
-                pass  # keep caller dtype; lowering casts where it matters
-        return jax.device_put(arr, self.place.jax_device())
+        return jax.device_put(np.asarray(value), self.place.jax_device())
 
     def _next_rng_key(self, program):
         seed = program.random_seed or 0
@@ -257,9 +248,17 @@ class Executor:
                 f"variables {missing} are read by the program but not "
                 f"initialized in scope (run the startup program first?)"
             )
+        # Commit every input to the executor's device: mixing committed and
+        # uncommitted arrays makes XLA compile one executable per layout
+        # combination (first step vs steady state), doubling compile time.
+        dev = self.place.jax_device()
         feed_vals = tuple(feed_arrays[n] for n in sorted(feed_arrays))
-        donated_vals = tuple(scope.find_var(n) for n in donated)
-        readonly_vals = tuple(scope.find_var(n) for n in readonly)
+        donated_vals = tuple(
+            jax.device_put(scope.find_var(n), dev) for n in donated
+        )
+        readonly_vals = tuple(
+            jax.device_put(scope.find_var(n), dev) for n in readonly
+        )
         rng_key = self._next_rng_key(program)
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")  # donation warnings on CPU backend

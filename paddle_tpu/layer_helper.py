@@ -34,12 +34,14 @@ def infer_op_shapes(op_type, block, inputs, attrs):
         return None
     op_def = OpRegistry.get(op_type)
     specs = {}
+    had_dynamic = False
     for slot, names in inputs.items():
         slot_specs = []
         for n in names:
             v = block._find_var_recursive(n)
             if v is None or v.shape is None:
                 return None
+            had_dynamic = had_dynamic or any(d < 0 for d in v.shape)
             shape = tuple(_DYN_SENTINEL if d < 0 else d for d in v.shape)
             slot_specs.append(jax.ShapeDtypeStruct(shape, to_numpy_dtype(v.dtype)))
         specs[slot] = slot_specs
@@ -58,7 +60,10 @@ def infer_op_shapes(op_type, block, inputs, attrs):
             vals = [vals]
         result[slot] = [
             (
-                tuple(-1 if d % _DYN_SENTINEL == 0 and d > 0 else d for d in v.shape),
+                tuple(
+                    -1 if had_dynamic and d > 0 and d % _DYN_SENTINEL == 0 else d
+                    for d in v.shape
+                ),
                 str(v.dtype),
             )
             for v in vals

@@ -70,6 +70,14 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
         return list(o) if isinstance(o, (list, tuple)) else [o]
 
     t_outs, f_outs = _norm(true_out), _norm(false_out)
+    if t_outs and (false_fn is None or len(f_outs) != len(t_outs)):
+        from paddle_tpu.utils.enforce import EnforceError
+
+        raise EnforceError(
+            f"cond: true_fn returns {len(t_outs)} value(s) but false_fn "
+            f"returns {len(f_outs)} — both branches must produce the same "
+            f"output structure"
+        )
     parent = program.block(parent_idx)
     outs = []
     # unify branch outputs through fresh vars written by both branches

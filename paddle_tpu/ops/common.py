@@ -37,6 +37,23 @@ def rng_key(ins):
     return key[0]
 
 
+def seeded_rng_key(ins, attrs):
+    """Key honoring a fixed per-op `seed` attr while still advancing between
+    executor runs (the reference's seeded generator semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    seed = attrs.get("seed", 0)
+    if not seed:
+        return rng_key(ins)
+    base = jax.random.PRNGKey(seed)
+    injected = ins.get("__rng_key__")
+    if injected is None:
+        return base
+    raw = jnp.asarray(injected[0]).astype(jnp.uint32)
+    return jax.random.fold_in(base, raw[0] ^ raw[1])
+
+
 def reduce_axes(attrs, ndim):
     if attrs.get("reduce_all", False):
         return tuple(range(ndim))

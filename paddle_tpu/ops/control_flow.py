@@ -88,6 +88,13 @@ def _run_cond(op, block, env, rng_key, interpret):
         return fn
 
     def fallthrough(_):
+        missing = [n for n in out_names if n not in outer]
+        if missing:
+            raise EnforceError(
+                f"conditional_block outputs {missing} have no value when the "
+                f"condition is false — provide a false branch (false_fn) that "
+                f"produces them, or initialize the vars before the cond"
+            )
         return tuple(outer[n] for n in out_names)
 
     false_fn = (

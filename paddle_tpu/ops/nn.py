@@ -327,7 +327,9 @@ def _dropout(ins, attrs):
     if attrs.get("is_test", False):
         out = x if impl == "upscale_in_train" else x * (1.0 - p)
         return {"Out": [out], "Mask": [jnp.ones_like(x)]}
-    key = rng_key(ins)
+    from paddle_tpu.ops.common import seeded_rng_key
+
+    key = seeded_rng_key(ins, attrs)
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     mask = keep.astype(x.dtype)
     if impl == "upscale_in_train":
@@ -414,18 +416,21 @@ def _softmax_with_ce(ins, attrs):
     fused, numerically stable via log-sum-exp."""
     logits, label = first(ins, "Logits"), first(ins, "Label")
     axis = attrs.get("axis", -1)
+    axis = axis % logits.ndim
     log_probs = jax.nn.log_softmax(logits, axis=axis)
     softmax = jnp.exp(log_probs)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
     else:
-        squeezed = label[..., 0] if label.ndim == logits.ndim else label
-        picked = jnp.take_along_axis(
-            log_probs, squeezed[..., None].astype(jnp.int32), axis=axis
+        # label has a size-1 class axis when its rank matches the logits
+        squeezed = (
+            jnp.squeeze(label, axis=axis) if label.ndim == logits.ndim else label
         )
+        idx = jnp.expand_dims(squeezed.astype(jnp.int32), axis)
+        picked = jnp.take_along_axis(log_probs, idx, axis=axis)
         loss = -picked
         ignore = attrs.get("ignore_index", -100)
-        loss = jnp.where(squeezed[..., None] == ignore, 0.0, loss)
+        loss = jnp.where(jnp.expand_dims(squeezed, axis) == ignore, 0.0, loss)
     return {"Softmax": [softmax], "Loss": [loss]}
 
 

@@ -359,19 +359,7 @@ def _isfinite_v2(ins, attrs):
 # -- random (stateful) ------------------------------------------------------
 
 
-def _key_for(ins, attrs):
-    seed = attrs.get("seed", 0)
-    if not seed:
-        return rng_key(ins)
-    # A fixed per-op seed pins the stream's identity, but the stream must
-    # still advance between executor runs (the reference's seeded generator
-    # does) — fold the run-varying key material into the seeded key.
-    base = jax.random.PRNGKey(seed)
-    injected = ins.get("__rng_key__")
-    if injected is None:
-        return base
-    raw = jnp.asarray(injected[0]).astype(jnp.uint32)
-    return jax.random.fold_in(base, raw[0] ^ raw[1])
+from paddle_tpu.ops.common import seeded_rng_key as _key_for
 
 
 @register_op("gaussian_random", stateful=True)

@@ -1,0 +1,88 @@
+"""AMP (bf16/fp16 mixed precision) rewrite tests
+(reference analog: python/paddle/fluid/contrib/tests/test_fp16_utils.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def _build(with_amp, dest_dtype="bfloat16", loss_scaling=1.0):
+    main = Program()
+    startup = Program()
+    main.random_seed = startup.random_seed = 5
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[16])
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if with_amp:
+            opt = fluid.amp.decorate(
+                opt, init_loss_scaling=loss_scaling, dest_dtype=dest_dtype
+            )
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_amp_inserts_casts():
+    main, _, _ = _build(with_amp=True)
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    # the mul (fc matmul) inputs must now be bf16 cast outputs
+    mul_ops = [op for op in main.global_block().ops if op.type == "mul"]
+    assert all(
+        any(n.endswith(".cast_bfloat16") for n in op.input("X") + op.input("Y"))
+        for op in mul_ops
+    )
+
+
+def test_amp_trains_to_similar_loss(rng):
+    x = rng.rand(64, 16).astype("float32")
+    # learnable task — memorizing random labels is precision-bound, which
+    # would test bf16's mantissa rather than the AMP rewrite
+    w_true = rng.rand(16, 4)
+    y = (x @ w_true).argmax(axis=1).astype("int64")[:, None]
+
+    def train(with_amp):
+        main, startup, loss = _build(with_amp)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out = [
+                float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+                for _ in range(20)
+            ]
+        return out
+
+    ref = train(False)
+    amp = train(True)
+    assert amp[-1] < amp[0] * 0.8, "amp run did not converge"
+    # bf16 matmuls shift numerics slightly but the curves must stay close
+    assert abs(ref[-1] - amp[-1]) < 0.25 * max(ref[0], 1e-3)
+
+
+def test_fp16_loss_scaling_unscales(rng):
+    """With float16 + static loss scaling, gradient magnitudes (hence the
+    training trajectory) must match the unscaled run."""
+    x = rng.rand(32, 16).astype("float32")
+    y = rng.randint(0, 4, (32, 1)).astype("int64")
+
+    def train(scaling):
+        main, startup, loss = _build(
+            with_amp=True, dest_dtype="float16", loss_scaling=scaling
+        )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            return [
+                float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+                for _ in range(10)
+            ]
+
+    a = train(1.0)
+    b = train(128.0)
+    np.testing.assert_allclose(a, b, rtol=0.05, atol=0.02)
