@@ -33,9 +33,12 @@ from paddle_tpu import optimizer
 from paddle_tpu import regularizer
 from paddle_tpu import clip
 from paddle_tpu.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph.base import in_dygraph_mode
+from paddle_tpu import io
 from paddle_tpu import amp
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
-from paddle_tpu.layers.tensor import data
+from paddle_tpu.layers.tensor import data_v2 as data
 from paddle_tpu.utils.flags import set_flags, get_flags
 
 # Alias namespace matching the reference's `fluid` surface

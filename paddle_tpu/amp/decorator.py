@@ -70,14 +70,13 @@ def _insert_cast(block, index, src_name, dst_dtype, cache):
             dtype=dst_dtype,
             stop_gradient=src.stop_gradient if src is not None else False,
         )
-    op = Operator(
-        block,
+    block._insert_op(
+        index,
         "cast",
         {"X": [src_name]},
         {"Out": [cast_name]},
         {"out_dtype": dst_dtype, "op_role": 0},
     )
-    block.ops.insert(index, op)
     cache[key] = cast_name
     return cast_name, index + 1
 
@@ -147,20 +146,86 @@ class OptimizerWithMixedPrecision:
         return getattr(self._optimizer, name)
 
     def _needs_scaling(self):
-        return self._dest_dtype == "float16" and self._loss_scaling != 1.0
+        return self._dest_dtype == "float16" and (
+            self._use_dynamic or self._loss_scaling != 1.0
+        )
 
     def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         from paddle_tpu import layers
         from paddle_tpu.core.backward import append_backward
 
         rewrite_program_amp(loss.block.program, self._amp_lists, self._dest_dtype)
-        if self._needs_scaling():
+        if not self._needs_scaling():
+            return append_backward(loss, parameter_list, no_grad_set)
+        if not self._use_dynamic:
             scaled = layers.scale(loss, scale=self._loss_scaling)
             pg = append_backward(scaled, parameter_list, no_grad_set)
             inv = 1.0 / self._loss_scaling
-            pg = [(p, layers.scale(g, scale=inv)) for p, g in pg if g is not None]
-            return pg
-        return append_backward(loss, parameter_list, no_grad_set)
+            return [(p, layers.scale(g, scale=inv)) for p, g in pg if g is not None]
+        return self._dynamic_backward(loss, parameter_list, no_grad_set)
+
+    def _dynamic_backward(self, loss, parameter_list, no_grad_set):
+        """Dynamic loss scaling (reference: contrib/mixed_precision/
+        decorator.py + fp16_utils.py update_loss_scaling): scale the loss by a
+        persistable scale var, unscale grads, zero them on overflow, and adapt
+        the scale — all as graph ops compiled into the training step."""
+        from paddle_tpu import layers
+        from paddle_tpu.core.backward import append_backward
+        from paddle_tpu.layers import tensor as tensor_layers
+        from paddle_tpu.utils import unique_name
+
+        block = loss.block
+        self._scale_var = tensor_layers.create_global_var(
+            shape=[1],
+            value=float(self._loss_scaling),
+            dtype="float32",
+            persistable=True,
+            name=unique_name.generate("loss_scaling"),
+        )
+        good = tensor_layers.create_global_var(
+            shape=[1], value=0, dtype="int32", persistable=True,
+            name=unique_name.generate("loss_scaling_good_steps"),
+        )
+        bad = tensor_layers.create_global_var(
+            shape=[1], value=0, dtype="int32", persistable=True,
+            name=unique_name.generate("loss_scaling_bad_steps"),
+        )
+        scaled = layers.elementwise_mul(loss, self._scale_var)
+        pg = [(p, g) for p, g in append_backward(scaled, parameter_list, no_grad_set) if g is not None]
+        grad_names = [g.name for _, g in pg]
+        found_inf = block.create_var(
+            name=unique_name.generate("found_infinite"), shape=[1], dtype="bool"
+        )
+        block.append_op(
+            "check_finite_and_unscale",
+            {"X": grad_names, "Scale": [self._scale_var.name]},
+            {"Out": grad_names, "FoundInfinite": [found_inf.name]},
+            {"op_role": 1},
+        )
+        block.append_op(
+            "update_loss_scaling",
+            {
+                "X": grad_names,
+                "FoundInfinite": [found_inf.name],
+                "PrevLossScaling": [self._scale_var.name],
+                "InGoodSteps": [good.name],
+                "InBadSteps": [bad.name],
+            },
+            {
+                "Out": grad_names,
+                "LossScaling": [self._scale_var.name],
+                "OutGoodSteps": [good.name],
+                "OutBadSteps": [bad.name],
+            },
+            {
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": 2,
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "op_role": 1,
+            },
+        )
+        return pg
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         self._optimizer.helper = None
@@ -177,6 +242,10 @@ def decorate(
     amp_lists=None,
     init_loss_scaling=1.0,
     use_dynamic_loss_scaling=False,
+    incr_every_n_steps=1000,
+    decr_every_n_nan_or_inf=2,
+    incr_ratio=2.0,
+    decr_ratio=0.5,
     dest_dtype=None,
 ):
     """reference: python/paddle/fluid/contrib/mixed_precision/decorator.py:218."""
@@ -185,5 +254,8 @@ def decorate(
         amp_lists=amp_lists,
         init_loss_scaling=init_loss_scaling,
         use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_ratio=decr_ratio,
+        incr_ratio=incr_ratio,
         dest_dtype=dest_dtype,
     )

@@ -118,7 +118,7 @@ class CompiledProgram:
         key = (id(self._program), self._program._version, feed_sig, tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
-            donated, readonly, written = plan_step(
+            donated, readonly, written, live = plan_step(
                 block, feed_names, fetch_names, scope, flags.use_donation
             )
 
@@ -126,7 +126,7 @@ class CompiledProgram:
                 env = dict(zip(feed_names, feed_vals))
                 env.update(zip(donated, donated_vals))
                 env.update(zip(readonly, readonly_vals))
-                _interpret_block(block, env, rng_key)
+                _interpret_block(block, env, rng_key, ops=live)
                 return [env[n] for n in fetch_names], [env.get(n) for n in written]
 
             data_sharding = NamedSharding(mesh, P("data"))

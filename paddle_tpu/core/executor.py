@@ -41,7 +41,47 @@ CONTROL_FLOW_OPS = {"while", "conditional_block", "recurrent"}
 ELIDED_OPS = {"feed", "fetch"}
 
 
-def _interpret_block(block, env, rng_key, use_pallas=True):
+def _subtree_io(program, op, reads, writes):
+    """All names read/written by `op` including nested sub-blocks."""
+    reads.update(op.input_names())
+    writes.update(op.output_names())
+    for attr in ("sub_block", "sub_block_false"):
+        idx = op.attrs.get(attr)
+        if idx is None:
+            continue
+        sub = program.block(idx)
+        for sop in sub.ops:
+            _subtree_io(program, sop, reads, writes)
+
+
+def live_ops(block, fetch_names):
+    """Dead-op elimination before planning (reference: paddle/fluid/framework/
+    prune.cc): keep ops that (transitively) feed a fetch, write persistable
+    state (optimizer/metric updates), or have side effects. Dropping dead ops
+    here — not in XLA DCE — matters because a dead op's inputs would otherwise
+    become mandatory feeds. Control-flow ops write loop-carried state through
+    their sub-blocks, so keep/needed decisions use the whole sub-tree's
+    reads+writes (nested blocks included)."""
+    needed = set(fetch_names)
+    keep = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if op.type in ELIDED_OPS:
+            continue
+        reads, writes = set(), set()
+        _subtree_io(block.program, op, reads, writes)
+        writes_persistable = any(
+            (v := block._find_var_recursive(n)) is not None and v.persistable
+            for n in writes
+        )
+        stateful_side_effect = op.type in ("print",)
+        if writes_persistable or stateful_side_effect or (writes & needed):
+            keep[i] = True
+            needed.update(reads)
+    return [op for op, k in zip(block.ops, keep) if k]
+
+
+def _interpret_block(block, env, rng_key, use_pallas=True, ops=None):
     """Trace every op in `block` through its lowering rule, mutating `env`.
 
     Called under jax tracing for the compiled path, or with concrete arrays
@@ -49,7 +89,7 @@ def _interpret_block(block, env, rng_key, use_pallas=True):
     """
     from paddle_tpu.ops import control_flow as cf  # late import, avoids cycle
 
-    for op_index, op in enumerate(block.ops):
+    for op_index, op in enumerate(block.ops if ops is None else ops):
         if op.type in ELIDED_OPS:
             continue
         if op.type in CONTROL_FLOW_OPS:
@@ -88,7 +128,8 @@ def _interpret_block(block, env, rng_key, use_pallas=True):
 def plan_step(block, feed_names, fetch_names, scope, use_donation):
     """Classify step I/O: validate fetches, split scope-resident inputs into
     donated (rewritten by the step — donation makes the update in-place at
-    the XLA level) and read-only. Shared by Executor and CompiledProgram."""
+    the XLA level) and read-only. Dead ops are pruned first (live_ops).
+    Shared by Executor and CompiledProgram."""
     produced = set(feed_names)
     for op in block.ops:
         produced.update(op.output_names())
@@ -100,7 +141,8 @@ def plan_step(block, feed_names, fetch_names, scope, use_donation):
             f"fetch variables {bad_fetch} are not produced by the program, "
             f"fed, or present in scope"
         )
-    scope_inputs, written_persistable = _block_io(block, feed_names)
+    ops = live_ops(block, fetch_names)
+    scope_inputs, written_persistable = _block_io(block, feed_names, ops)
     # fetching a scope-resident var the block never reads (e.g. a parameter)
     # still needs that var as a step input
     for n in fetch_names:
@@ -117,15 +159,17 @@ def plan_step(block, feed_names, fetch_names, scope, use_donation):
         [n for n in scope_inputs if n in overwritten] if use_donation else []
     )
     readonly = [n for n in scope_inputs if n not in set(donated)]
-    return donated, readonly, written_persistable
+    return donated, readonly, written_persistable, ops
 
 
-def _block_io(block, feed_names):
+def _block_io(block, feed_names, ops=None):
     """Statically classify variables: which must come from the scope, which
     persistables get written back."""
+    if ops is None:
+        ops = block.ops
     produced = set(feed_names)
     scope_inputs = []
-    for op in block.ops:
+    for op in ops:
         if op.type in ELIDED_OPS:
             continue
         for name in op.input_names():
@@ -147,7 +191,7 @@ def _block_io(block, feed_names):
                 sub_produced.update(sop.output_names())
         produced.update(op.output_names())
     written_persistable = []
-    for op in block.ops:
+    for op in ops:
         for name in op.output_names():
             v = block._find_var_recursive(name)
             if v is not None and v.persistable and name not in written_persistable:
@@ -222,7 +266,7 @@ class Executor:
         key = (id(program), program._version, feed_sig, tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
-            donated, readonly, written_persistable = plan_step(
+            donated, readonly, written_persistable, ops = plan_step(
                 block, feed_names, fetch_names, scope, flags.use_donation
             )
 
@@ -230,7 +274,7 @@ class Executor:
                 env = dict(zip(feed_names, feed_vals))
                 env.update(zip(donated, donated_vals))
                 env.update(zip(readonly, readonly_vals))
-                _interpret_block(block, env, rng_key)
+                _interpret_block(block, env, rng_key, ops=ops)
                 fetches = [env[n] for n in fetch_names]
                 updates = [env.get(n) for n in written_persistable]
                 return fetches, updates

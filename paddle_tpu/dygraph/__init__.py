@@ -1,0 +1,36 @@
+"""Imperative (dygraph) mode — TPU-native eager execution.
+
+reference: python/paddle/fluid/dygraph/ and paddle/fluid/imperative/.
+See base.py for the tracer/tape design."""
+
+from paddle_tpu.dygraph.base import (
+    enable_dygraph,
+    disable_dygraph,
+    guard,
+    in_dygraph_mode,
+    no_grad,
+    to_variable,
+    trace_op,
+    _dygraph_tracer,
+)
+from paddle_tpu.dygraph.varbase import ParamBase, VarBase
+from paddle_tpu.dygraph.layers import Layer
+from paddle_tpu.dygraph.container import LayerList, ParameterList, Sequential
+from paddle_tpu.dygraph import nn
+from paddle_tpu.dygraph.nn import (
+    BatchNorm,
+    Conv2D,
+    Conv2DTranspose,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    GRUUnit,
+    InstanceNorm,
+    LayerNorm,
+    Linear,
+    Pool2D,
+    PRelu,
+)
+from paddle_tpu.dygraph.checkpoint import load_dygraph, save_dygraph
+from paddle_tpu.dygraph.parallel import DataParallel, ParallelEnv, prepare_context
+from paddle_tpu.dygraph.jit import TracedLayer, declarative, to_static

@@ -1,0 +1,138 @@
+"""Dygraph-to-static: TracedLayer + declarative.
+
+reference: python/paddle/fluid/dygraph/jit.py (TracedLayer traces a dygraph
+Layer into a static Program) and dygraph_to_static/ast_transformer.py. The
+reference rewrites Python ASTs to turn imperative code into ProgramDesc; here
+the SAME forward code traces into a Program via the capture mode in
+dygraph/base.py — no AST surgery, mirroring how jax.jit replaces
+torch.jit.script on TPU. The captured Program then runs on the whole-block
+XLA executor (fast path) and exports via save_inference_model."""
+
+import numpy as np
+
+from paddle_tpu.core.executor import Executor
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.core.places import TPUPlace
+from paddle_tpu.core.scope import Scope, scope_guard
+from paddle_tpu.dygraph.base import no_grad_ctx, static_capture, to_variable
+from paddle_tpu.dygraph.varbase import VarBase
+from paddle_tpu.utils import unique_name
+from paddle_tpu.utils.enforce import enforce
+
+
+class TracedLayer:
+    """Static program captured from a dygraph Layer
+    (reference: python/paddle/fluid/dygraph/jit.py TracedLayer)."""
+
+    def __init__(self, main_program, startup_program, feed_vars, fetch_vars):
+        self._main = main_program
+        self._startup = startup_program
+        self._feed = feed_vars
+        self._fetch = fetch_vars
+        self._scope = Scope()
+        self._exe = Executor(TPUPlace(0))
+        with scope_guard(self._scope):
+            self._exe.run(self._startup)
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Run `layer` once under capture; returns (dygraph_outputs,
+        traced_layer)."""
+        inputs = [inputs] if isinstance(inputs, VarBase) else list(inputs)
+        # run once eagerly for the dygraph outputs
+        dy_outs = layer(*inputs)
+
+        main, startup = Program(), Program()
+        with program_guard(main, startup), static_capture(main, startup) as cap:
+            feed_vars = []
+            proxies = []
+            for vb in inputs:
+                value = np.asarray(vb.value)
+                sv = main.global_block().create_var(
+                    name=unique_name.generate("traced_feed"),
+                    shape=list(value.shape),
+                    dtype=str(value.dtype),
+                    is_data=True,
+                )
+                proxy = VarBase.__new__(VarBase)
+                proxy.value = None
+                proxy.name = sv.name
+                proxy.stop_gradient = True
+                proxy.persistable = False
+                proxy.grad_value = None
+                proxy.static_var = sv
+                cap.var_map[id(proxy)] = sv
+                feed_vars.append(sv)
+                proxies.append(proxy)
+            with no_grad_ctx():
+                outs = layer(*proxies)
+            outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+            fetch_vars = [o.static_var for o in outs_list]
+        return dy_outs, TracedLayer(main, startup, feed_vars, fetch_vars)
+
+    def __call__(self, inputs):
+        inputs = [inputs] if isinstance(inputs, VarBase) else list(inputs)
+        feed = {
+            v.name: np.asarray(vb.value) for v, vb in zip(self._feed, inputs)
+        }
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._main, feed=feed, fetch_list=[f.name for f in self._fetch])
+        return [to_variable(o) for o in outs]
+
+    @property
+    def program(self):
+        return self._main
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from paddle_tpu import io
+
+        feed_vars = self._feed if feed is None else [self._feed[i] for i in feed]
+        fetch_vars = self._fetch if fetch is None else [self._fetch[i] for i in fetch]
+        with scope_guard(self._scope):
+            io.save_inference_model(
+                dirname,
+                [v.name for v in feed_vars],
+                fetch_vars,
+                self._exe,
+                main_program=self._main,
+            )
+
+
+def _signature(args):
+    sig = []
+    for a in args:
+        if isinstance(a, VarBase):
+            sig.append(("var", tuple(a.shape), str(a.dtype)))
+        elif isinstance(a, np.ndarray):
+            sig.append(("np", a.shape, str(a.dtype)))
+        else:
+            sig.append(("const", a))
+    return tuple(sig)
+
+
+def declarative(fn):
+    """Decorator: compile a dygraph function to a static program per input
+    signature (reference: dygraph_to_static @declarative)."""
+    cache = {}
+
+    def wrapper(*args):
+        vb_args = [
+            a if isinstance(a, VarBase) else to_variable(np.asarray(a)) for a in args
+        ]
+        key = _signature(vb_args)
+        if key not in cache:
+
+            class _FnLayer:
+                def __call__(self, *xs):
+                    return fn(*xs)
+
+            _, traced = TracedLayer.trace(_FnLayer(), vb_args)
+            cache[key] = traced
+        outs = cache[key](vb_args)
+        return outs[0] if len(outs) == 1 else outs
+
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+to_static = declarative

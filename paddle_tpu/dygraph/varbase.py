@@ -1,0 +1,172 @@
+"""VarBase: the eager tensor (reference: paddle/fluid/imperative/layer.h:61
+VarBase — a refcounted wrapper of framework::Variable with a grad var and
+autograd hooks; python surface python/paddle/fluid/dygraph/
+varbase_patch_methods.py). Here the payload is a jax.Array; the grad var is
+`grad_value`, populated by the tape walk in base.run_backward."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.utils import unique_name
+from paddle_tpu.utils.enforce import enforce
+
+
+class VarBase:
+    def __init__(self, value, name=None, stop_gradient=False, persistable=False):
+        self.value = value
+        self.name = name or unique_name.generate("generated_tensor")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.grad_value = None
+        self.static_var = None  # set when this is a capture-mode proxy
+
+    # -- autograd ------------------------------------------------------
+    def backward(self, retain_graph=False):
+        from paddle_tpu.dygraph.base import run_backward
+
+        run_backward(self, retain_graph=retain_graph)
+
+    def _accumulate_grad(self, g):
+        self.grad_value = g
+
+    def gradient(self):
+        return None if self.grad_value is None else np.asarray(self.grad_value)
+
+    @property
+    def grad(self):
+        return self.grad_value
+
+    def clear_gradient(self):
+        self.grad_value = None
+
+    # -- data access ---------------------------------------------------
+    def numpy(self):
+        enforce(self.value is not None, f"{self.name} has no value (capture proxy)")
+        return np.asarray(self.value)
+
+    def detach(self):
+        out = VarBase(self.value, name=self.name + ".detach", stop_gradient=True)
+        return out
+
+    def item(self):
+        return self.numpy().item()
+
+    @property
+    def shape(self):
+        if self.value is not None:
+            return list(self.value.shape)
+        return list(self.static_var.shape) if self.static_var is not None else None
+
+    @property
+    def dtype(self):
+        if self.value is not None:
+            return str(self.value.dtype)
+        return self.static_var.dtype if self.static_var is not None else None
+
+    def astype(self, dtype):
+        from paddle_tpu.dygraph.base import trace_op
+
+        return trace_op("cast", {"X": [self]}, {"out_dtype": str(dtype)})["Out"][0]
+
+    def numel(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def set_value(self, value):
+        arr = np.asarray(value.numpy() if isinstance(value, VarBase) else value)
+        enforce(
+            tuple(arr.shape) == tuple(self.shape),
+            f"set_value shape mismatch: {arr.shape} vs {self.shape}",
+        )
+        self.value = jnp.asarray(arr.astype(np.asarray(self.value).dtype))
+
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __repr__(self):
+        tag = "ParamBase" if getattr(self, "trainable", None) is not None else "VarBase"
+        return f"{tag}(name={self.name}, shape={self.shape}, dtype={self.dtype})"
+
+    # -- math sugar (reference: math_op_patch applied to VarBase) ------
+    def _binary(self, other, op_type, reverse=False):
+        from paddle_tpu.dygraph.base import to_variable, trace_op
+
+        if not isinstance(other, VarBase):
+            other = to_variable(
+                np.full((1,), other, dtype=np.asarray(self.value).dtype)
+            )
+        x, y = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": [x], "Y": [y]}, {"axis": -1})["Out"][0]
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __matmul__(self, o):
+        from paddle_tpu.dygraph.base import trace_op
+
+        return trace_op("matmul", {"X": [self], "Y": [o]}, {})["Out"][0]
+
+    def __neg__(self):
+        from paddle_tpu.dygraph.base import trace_op
+
+        return trace_op("scale", {"X": [self]}, {"scale": -1.0})["Out"][0]
+
+    def __getitem__(self, idx):
+        from paddle_tpu.dygraph.base import trace_op
+
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        axes, starts, ends, squeeze_axes = [], [], [], []
+        for ax, s in enumerate(idx):
+            if isinstance(s, slice):
+                if s.start is None and s.stop is None:
+                    continue
+                axes.append(ax)
+                starts.append(s.start or 0)
+                ends.append(s.stop if s.stop is not None else int(1e9))
+            else:
+                axes.append(ax)
+                starts.append(int(s))
+                ends.append(int(s) + 1)
+                squeeze_axes.append(ax)
+        out = trace_op(
+            "slice",
+            {"Input": [self]},
+            {"axes": axes, "starts": starts, "ends": ends},
+        )["Out"][0]
+        if squeeze_axes:
+            out = trace_op("squeeze2", {"X": [out]}, {"axes": squeeze_axes})["Out"][0]
+        return out
+
+
+class ParamBase(VarBase):
+    """Eager parameter (reference: VarBase with persistable=True +
+    python/paddle/fluid/framework.py ParamBase semantics)."""
+
+    def __init__(self, value, name=None, trainable=True, **kwargs):
+        super().__init__(value, name=name, persistable=True)
+        self.trainable = trainable
+        self.stop_gradient = not trainable
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        self.is_distributed = False

@@ -1,0 +1,281 @@
+"""Model IO: save/load params, persistables, inference models, program state.
+
+reference: python/paddle/fluid/io.py — save_params :336, save_persistables
+:556, save_inference_model :1022, load_inference_model :1229, program-state
+save/load :1507,1565,1731. The reference implements checkpointing as graph
+execution (save/load ops appended to a save program, io.py:208-335); here
+persistence is host-side array serialization — on TPU the device→host gather
+is a jax.device_get, and making it graph ops would only force an XLA
+round-trip. The on-disk layout mirrors the reference: a `__model__` program
+file plus per-variable files (separate-files mode) or one combined params
+file (save_combine mode, reference: operators/save_combine_op.cc).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from paddle_tpu.core.ir import Parameter, Program
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.utils.enforce import EnforceError, enforce
+
+MODEL_FORMAT_VERSION = 1
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _gather_vars(program, predicate, scope):
+    out = {}
+    for var in program.global_block().vars.values():
+        if not predicate(var):
+            continue
+        val = scope.find_var(var.name)
+        if val is None:
+            raise EnforceError(
+                f"variable {var.name} is not initialized in scope; run the "
+                f"startup program before saving"
+            )
+        out[var.name] = np.asarray(val)
+    return out
+
+
+def _write_combined(path, arrays):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    names = sorted(arrays)
+    np.savez(
+        path,
+        __names__=np.array(names, dtype=object),
+        **{f"arr_{i}": arrays[n] for i, n in enumerate(names)},
+    )
+
+
+def _read_combined(path):
+    real = path if os.path.exists(path) else path + ".npz"
+    enforce(os.path.exists(real), f"params file {path} not found")
+    with np.load(real, allow_pickle=True) as data:
+        names = [str(n) for n in data["__names__"]]
+        return {n: data[f"arr_{i}"] for i, n in enumerate(names)}
+
+
+# ---------------------------------------------------------------------------
+# save/load params + persistables (reference: io.py:336,556,744,802)
+# ---------------------------------------------------------------------------
+
+
+def save_vars(executor, dirname, main_program=None, predicate=None, filename=None, vars=None):
+    from paddle_tpu.core.ir import default_main_program
+
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        arrays = {}
+        for v in vars:
+            name = v if isinstance(v, str) else v.name
+            val = scope.find_var(name)
+            enforce(val is not None, f"variable {name} not in scope")
+            arrays[name] = np.asarray(val)
+    else:
+        arrays = _gather_vars(program, predicate or _is_persistable, scope)
+    if filename is None:
+        os.makedirs(dirname, exist_ok=True)
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "_")) + ".npy", arr)
+        manifest = {"format_version": MODEL_FORMAT_VERSION, "vars": sorted(arrays)}
+        with open(os.path.join(dirname, "__manifest__.json"), "w") as f:
+            json.dump(manifest, f)
+    else:
+        _write_combined(os.path.join(dirname, filename), arrays)
+    return sorted(arrays)
+
+
+def load_vars(executor, dirname, main_program=None, predicate=None, filename=None, vars=None):
+    from paddle_tpu.core.ir import default_main_program
+
+    import jax.numpy as jnp
+
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+    else:
+        names = [
+            v.name
+            for v in program.global_block().vars.values()
+            if (predicate or _is_persistable)(v)
+        ]
+    if filename is None:
+        for name in names:
+            path = os.path.join(dirname, name.replace("/", "_")) + ".npy"
+            enforce(os.path.exists(path), f"no saved file for variable {name}")
+            scope.set(name, jnp.asarray(np.load(path)))
+    else:
+        arrays = _read_combined(os.path.join(dirname, filename))
+        missing = [n for n in names if n not in arrays]
+        enforce(not missing, f"saved file is missing variables {missing[:5]}")
+        for name in names:
+            scope.set(name, jnp.asarray(arrays[name]))
+    return names
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference: python/paddle/fluid/io.py:336."""
+    return save_vars(executor, dirname, main_program, _is_parameter, filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, _is_parameter, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Includes optimizer accumulators — they are persistable vars
+    (reference: python/paddle/fluid/io.py:556)."""
+    return save_vars(executor, dirname, main_program, _is_persistable, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, _is_persistable, filename)
+
+
+# ---------------------------------------------------------------------------
+# unified save/load (reference: io.py:1507 save, :1565 load)
+# ---------------------------------------------------------------------------
+
+
+def save(program, model_path):
+    scope = global_scope()
+    params = _gather_vars(program, _is_parameter, scope)
+    _write_combined(model_path + ".pdparams", params)
+    others = {
+        n: a
+        for n, a in _gather_vars(program, _is_persistable, scope).items()
+        if n not in params
+    }
+    _write_combined(model_path + ".pdopt", others)
+
+
+def load(program, model_path, executor=None):
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    arrays = _read_combined(model_path + ".pdparams")
+    arrays.update(_read_combined(model_path + ".pdopt"))
+    for var in program.global_block().vars.values():
+        if _is_persistable(var) and var.name in arrays:
+            scope.set(var.name, jnp.asarray(arrays[var.name]))
+
+
+def load_program_state(model_path):
+    """reference: io.py:1731 — returns name->ndarray for partial/transfer
+    loading."""
+    state = _read_combined(model_path + ".pdparams")
+    opt_path = model_path + ".pdopt"
+    if os.path.exists(opt_path) or os.path.exists(opt_path + ".npz"):
+        state.update(_read_combined(opt_path))
+    return state
+
+
+def set_program_state(program, state):
+    import jax.numpy as jnp
+
+    scope = global_scope()
+    used = set()
+    for var in program.global_block().vars.values():
+        if var.name in state:
+            scope.set(var.name, jnp.asarray(state[var.name]))
+            used.add(var.name)
+    return sorted(used)
+
+
+# ---------------------------------------------------------------------------
+# inference model export (reference: io.py:1022,1229)
+# ---------------------------------------------------------------------------
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names,
+    target_vars,
+    executor,
+    main_program=None,
+    model_filename=None,
+    params_filename=None,
+):
+    """Prune to the feed→fetch subgraph, strip train-only behavior, save
+    program + params (reference: python/paddle/fluid/io.py:1022)."""
+    from paddle_tpu.core.ir import default_main_program
+
+    program = main_program or default_main_program()
+    infer = program.clone(for_test=True)
+    target_names = [t if isinstance(t, str) else t.name for t in target_vars]
+    infer._prune(target_names)
+
+    # verify feeds suffice for targets
+    needed = set()
+    produced = set(feeded_var_names)
+    for op in infer.global_block().ops:
+        for n in op.input_names():
+            if n not in produced:
+                needed.add(n)
+        produced.update(op.output_names())
+    block = infer.global_block()
+    for n in needed:
+        v = block._find_var_recursive(n)
+        enforce(
+            v is not None and (v.persistable or v.is_data or n in feeded_var_names),
+            f"inference program reads {n} which is neither fed nor persistable",
+        )
+
+    infer._attrs["feed_var_names"] = list(feeded_var_names)
+    infer._attrs["fetch_var_names"] = target_names
+
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    desc = infer.desc()
+    desc["feed_var_names"] = list(feeded_var_names)
+    desc["fetch_var_names"] = target_names
+    with open(model_path, "wb") as f:
+        f.write(json.dumps(desc, sort_keys=True).encode("utf-8"))
+
+    scope = global_scope()
+    arrays = {}
+    for var in infer.global_block().vars.values():
+        if var.persistable and not var.is_data:
+            val = scope.find_var(var.name)
+            if val is not None:
+                arrays[var.name] = np.asarray(val)
+    _write_combined(
+        os.path.join(dirname, params_filename or "__params__"), arrays
+    )
+    return target_names
+
+
+def load_inference_model(
+    dirname, executor, model_filename=None, params_filename=None
+):
+    """Returns (program, feed_names, fetch_vars)
+    (reference: python/paddle/fluid/io.py:1229)."""
+    import jax.numpy as jnp
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    enforce(os.path.exists(model_path), f"{model_path} not found")
+    with open(model_path, "rb") as f:
+        desc = json.loads(f.read().decode("utf-8"))
+    program = Program.from_bytes(
+        json.dumps({k: v for k, v in desc.items() if k not in ("feed_var_names", "fetch_var_names")}).encode()
+    )
+    feed_names = desc.get("feed_var_names", [])
+    fetch_names = desc.get("fetch_var_names", [])
+    arrays = _read_combined(os.path.join(dirname, params_filename or "__params__"))
+    scope = global_scope()
+    for name, arr in arrays.items():
+        scope.set(name, jnp.asarray(arr))
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars

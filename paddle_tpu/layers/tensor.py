@@ -57,6 +57,7 @@ def data(name, shape, dtype="float32", append_batch_size=True, lod_level=0):
     block = default_main_program().global_block()
     if append_batch_size:
         shape = [-1] + list(shape)
+    shape = [-1 if d is None else d for d in shape]
     return block.create_var(
         name=name,
         shape=shape,
@@ -65,6 +66,12 @@ def data(name, shape, dtype="float32", append_batch_size=True, lod_level=0):
         stop_gradient=True,
         lod_level=lod_level,
     )
+
+
+def data_v2(name, shape, dtype="float32", lod_level=0):
+    """The reference's top-level `fluid.data` (python/paddle/fluid/data.py):
+    shape taken verbatim, None/-1 marks dynamic dims, NO batch prepend."""
+    return data(name, shape, dtype, append_batch_size=False, lod_level=lod_level)
 
 
 def fill_constant(shape, dtype, value, name=None, out=None):
@@ -402,9 +409,14 @@ def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
 
 
 def _make_compare(op_type):
-    def fn(x, y, name=None):
+    def fn(x, y, cond=None, name=None):
+        # `cond` names an existing output var — the reference uses this to
+        # rewrite the loop condition inside While blocks
+        # (reference: python/paddle/fluid/layers/control_flow.py less_than)
         helper = LayerHelper(op_type, name=name)
-        out = helper.create_variable_for_type_inference("bool", stop_gradient=True)
+        out = cond if cond is not None else helper.create_variable_for_type_inference(
+            "bool", stop_gradient=True
+        )
         helper.append_op(
             op_type, {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]}
         )

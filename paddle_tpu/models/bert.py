@@ -194,11 +194,11 @@ def build_bert_pretrain(cfg=None, seq_len=128, lr=1e-4, use_amp=False):
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        input_ids = fluid.data("input_ids", shape=[seq_len], dtype="int64")
-        token_type_ids = fluid.data("token_type_ids", shape=[seq_len], dtype="int64")
-        input_mask = fluid.data("input_mask", shape=[seq_len], dtype="int64")
-        mlm_labels = fluid.data("mlm_labels", shape=[seq_len], dtype="int64")
-        nsp_labels = fluid.data("nsp_labels", shape=[1], dtype="int64")
+        input_ids = fluid.data("input_ids", shape=[-1, seq_len], dtype="int64")
+        token_type_ids = fluid.data("token_type_ids", shape=[-1, seq_len], dtype="int64")
+        input_mask = fluid.data("input_mask", shape=[-1, seq_len], dtype="int64")
+        mlm_labels = fluid.data("mlm_labels", shape=[-1, seq_len], dtype="int64")
+        nsp_labels = fluid.data("nsp_labels", shape=[-1, 1], dtype="int64")
 
         seq_out, pooled = bert_encoder(input_ids, token_type_ids, input_mask, cfg, seq_len)
 

@@ -36,10 +36,10 @@ def build_mnist_train(use_conv=False):
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
         if use_conv:
-            img = fluid.data("img", shape=[1, 28, 28])
+            img = fluid.data("img", shape=[-1, 1, 28, 28])
         else:
-            img = fluid.data("img", shape=[784])
-        label = fluid.data("label", shape=[1], dtype="int64")
+            img = fluid.data("img", shape=[-1, 784])
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
         build = conv_net if use_conv else mlp
         loss, acc, logits = build(img, label)
         opt = fluid.optimizer.Adam(learning_rate=1e-3)

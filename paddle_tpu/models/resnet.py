@@ -95,8 +95,8 @@ def build_resnet_train(depth=50, class_dim=1000, image_shape=(3, 224, 224), lr=0
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        img = fluid.data("img", shape=list(image_shape))
-        label = fluid.data("label", shape=[1], dtype="int64")
+        img = fluid.data("img", shape=[-1] + list(image_shape))
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
         logits = resnet(img, class_dim, depth)
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, label)
@@ -115,7 +115,7 @@ def build_resnet_infer(depth=50, class_dim=1000, image_shape=(3, 224, 224)):
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        img = fluid.data("img", shape=list(image_shape))
+        img = fluid.data("img", shape=[-1] + list(image_shape))
         logits = resnet(img, class_dim, depth)
         prob = fluid.layers.softmax(logits)
     return main.clone(for_test=True), startup, [img], [prob]

@@ -264,3 +264,52 @@ def _dpsgd(ins, attrs):
     noise = sigma * clip * jax.random.normal(rng_key(ins), g.shape)
     p_out = p - lr * (g + noise / batch_size)
     return {"ParamOut": [p_out.astype(first(ins, "Param").dtype)]}
+
+
+@register_op("check_finite_and_unscale", nondiff_inputs=("Scale",))
+def _check_finite_and_unscale(ins, attrs):
+    """reference: paddle/fluid/operators/amp/check_finite_and_unscale_op.cc —
+    unscale every gradient by 1/Scale and report whether any is non-finite."""
+    xs = ins.get("X", [])
+    scale = _f32(first(ins, "Scale")).reshape(())
+    inv = 1.0 / scale
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for x in xs:
+        found = jnp.logical_or(found, jnp.logical_not(jnp.all(jnp.isfinite(x))))
+        outs.append((_f32(x) * inv).astype(x.dtype))
+    return {"Out": outs, "FoundInfinite": [found.reshape(1)]}
+
+
+@register_op("update_loss_scaling", nondiff_inputs=("FoundInfinite", "PrevLossScaling", "InGoodSteps", "InBadSteps"))
+def _update_loss_scaling(ins, attrs):
+    """reference: paddle/fluid/operators/amp/update_loss_scaling_op.cc.
+    On overflow: zero the gradients (skipping the update) and after
+    decr_every_n_nan_or_inf consecutive overflows halve the scale; after
+    incr_every_n_steps clean steps, grow it."""
+    xs = ins.get("X", [])
+    found = first(ins, "FoundInfinite").reshape(()).astype(jnp.bool_)
+    scale = _f32(first(ins, "PrevLossScaling")).reshape(())
+    good = first(ins, "InGoodSteps").reshape(()).astype(jnp.int32)
+    bad = first(ins, "InBadSteps").reshape(()).astype(jnp.int32)
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+    new_bad = jnp.where(found, bad + 1, 0)
+    new_good = jnp.where(found, 0, good + 1)
+    should_decr = new_bad >= decr_every
+    should_incr = new_good >= incr_every
+    new_scale = jnp.where(should_decr, scale * decr_ratio, scale)
+    new_scale = jnp.where(should_incr, scale * incr_ratio, new_scale)
+    new_scale = jnp.maximum(new_scale, 1e-8)
+    new_bad = jnp.where(should_decr, 0, new_bad)
+    new_good = jnp.where(should_incr, 0, new_good)
+    prev = first(ins, "PrevLossScaling")
+    outs = [jnp.where(found, jnp.zeros_like(x), x) for x in xs]
+    return {
+        "Out": outs,
+        "LossScaling": [new_scale.reshape(1).astype(prev.dtype)],
+        "OutGoodSteps": [new_good.reshape(1)],
+        "OutBadSteps": [new_bad.reshape(1)],
+    }

@@ -135,6 +135,10 @@ class Optimizer:
         return self.apply_gradients(params_grads)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from paddle_tpu.dygraph.base import in_dygraph_mode
+
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         self.helper = LayerHelper(self.__class__.__name__)
         self._create_global_learning_rate()
         params_grads = self.backward(
@@ -142,6 +146,103 @@ class Optimizer:
         )
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph path --------------------------------------------------
+    # The reference's dygraph optimizers run one eager update kernel per
+    # parameter (python/paddle/fluid/optimizer.py minimize under
+    # in_dygraph_mode). TPU-native: the SAME _append_optimize_op machinery
+    # builds a static "apply program" once (all updates + lr + clip +
+    # regularization), which compiles to ONE XLA computation; accumulators
+    # live in a private Scope. Eager per-param dispatch would bottleneck on
+    # host launches.
+    def _dygraph_minimize(self, loss, parameter_list):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.executor import Executor
+        from paddle_tpu.core.ir import Program, program_guard
+        from paddle_tpu.core.places import TPUPlace
+        from paddle_tpu.core.scope import Scope, scope_guard
+
+        enforce(
+            parameter_list is not None,
+            "parameter_list is required for minimize() in dygraph mode "
+            "(pass layer.parameters())",
+        )
+        params = [
+            p
+            for p in parameter_list
+            if getattr(p, "trainable", True) and p.grad_value is not None
+        ]
+        if not params:
+            return [], []
+        key = tuple((p.name, tuple(p.shape), str(p.dtype)) for p in params)
+        if getattr(self, "_dy_key", None) != key:
+            self._dy_scope = Scope()
+            self._dy_exe = Executor(TPUPlace(0))
+            main, startup = Program(), Program()
+            self._lr_var = None
+            self._accumulators = {}
+            self.helper = LayerHelper(self.__class__.__name__)
+            with program_guard(main, startup):
+                self._create_global_learning_rate()
+                block = main.global_block()
+                params_grads = []
+                for p in params:
+                    sp = block.create_parameter(
+                        shape=list(p.shape), dtype=p.dtype, name=p.name
+                    )
+                    sp.optimize_attr = dict(p.optimize_attr)
+                    sp.regularizer = p.regularizer
+                    g = block.create_var(
+                        name=p.name + "@GRAD", shape=list(p.shape), dtype=p.dtype
+                    )
+                    params_grads.append((sp, g))
+                self.apply_gradients(params_grads)
+            with scope_guard(self._dy_scope):
+                self._dy_exe.run(startup)
+            self._dy_prog = main
+            self._dy_key = key
+        feed = {p.name: p.value for p in params}
+        for p in params:
+            feed[p.name + "@GRAD"] = jnp.asarray(p.grad_value)
+        with scope_guard(self._dy_scope):
+            outs = self._dy_exe.run(
+                self._dy_prog,
+                feed=feed,
+                fetch_list=[p.name for p in params],
+                return_numpy=False,
+            )
+        for p, v in zip(params, outs):
+            p.value = v
+        return [], [(p, p.grad_value) for p in params]
+
+    def state_dict(self):
+        """Dygraph accumulator state (reference: dygraph optimizer
+        state_dict)."""
+        import numpy as np
+
+        out = {}
+        scope = getattr(self, "_dy_scope", None)
+        if scope is None:
+            return out
+        for name, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                val = scope.find_var(var.name)
+                if val is not None:
+                    out[var.name] = np.asarray(val)
+        if self._lr_var is not None:
+            val = scope.find_var(self._lr_var.name)
+            if val is not None:
+                out[self._lr_var.name] = np.asarray(val)
+        return out
+
+    def set_state_dict(self, state_dict):
+        scope = getattr(self, "_dy_scope", None)
+        enforce(scope is not None, "optimizer has no state yet (run a step first)")
+        for name, val in state_dict.items():
+            scope.set(name, __import__("jax").numpy.asarray(val))
+
+    set_dict = set_state_dict
 
     def _append_optimize_op(self, block, param_and_grad):
         raise NotImplementedError

@@ -12,8 +12,8 @@ def _build(with_amp, dest_dtype="bfloat16", loss_scaling=1.0):
     startup = Program()
     main.random_seed = startup.random_seed = 5
     with program_guard(main, startup):
-        x = fluid.data("x", shape=[16])
-        y = fluid.data("y", shape=[1], dtype="int64")
+        x = fluid.data("x", shape=[-1, 16])
+        y = fluid.data("y", shape=[-1, 1], dtype="int64")
         h = fluid.layers.fc(x, size=32, act="relu")
         logits = fluid.layers.fc(h, size=4)
         loss = fluid.layers.mean(
@@ -86,3 +86,41 @@ def test_fp16_loss_scaling_unscales(rng):
     a = train(1.0)
     b = train(128.0)
     np.testing.assert_allclose(a, b, rtol=0.05, atol=0.02)
+
+
+def test_dynamic_loss_scaling_recovers_from_overflow(rng):
+    """fp16 + dynamic scaling: scale must shrink after induced overflow and
+    training must continue with finite params (reference:
+    contrib/mixed_precision update_loss_scaling semantics)."""
+    from paddle_tpu.core.ir import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 8])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.amp.decorate(
+            fluid.optimizer.SGD(0.01),
+            init_loss_scaling=2.0**15,
+            use_dynamic_loss_scaling=True,
+            dest_dtype="float16",
+        )
+        opt.minimize(loss)
+    scale_name = opt._scale_var.name
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = rng.rand(16, 8).astype("float32")
+    ys = rng.rand(16, 1).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        scale0 = float(np.asarray(fluid.global_scope().find_var(scale_name))[0])
+        # overflow: huge feed values blow up fp16 grads for 2 consecutive steps
+        bad = np.full_like(xs, 1e4)
+        for _ in range(2):
+            exe.run(main, feed={"x": bad, "y": ys}, fetch_list=[loss])
+        scale1 = float(np.asarray(fluid.global_scope().find_var(scale_name))[0])
+        assert scale1 < scale0, (scale0, scale1)
+        # params survived: update was skipped on overflow steps
+        out = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[0]))

@@ -7,7 +7,7 @@ from paddle_tpu.core.ir import Program, program_guard
 
 
 def _build_mlp():
-    x = fluid.data("x", shape=[4])
+    x = fluid.data("x", shape=[-1, 4])
     h = fluid.layers.fc(x, size=8, act="relu")
     y = fluid.layers.fc(h, size=1)
     loss = fluid.layers.mean(y)
@@ -36,7 +36,7 @@ def test_grad_aggregation_multi_consumer():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[3])
+        x = fluid.data("x", shape=[-1, 3])
         w = prog.global_block().create_parameter([3], "float32", name="w")
         sblock = startup.global_block()
         sblock.create_var(name="w", shape=[3], dtype="float32", persistable=True)
@@ -65,7 +65,7 @@ def test_stop_gradient_blocks_grad():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         h = fluid.layers.fc(x, size=4, bias_attr=False)
         h.stop_gradient = True
         y = fluid.layers.fc(h, size=1, bias_attr=False)
@@ -84,7 +84,7 @@ def test_gradients_api():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[3])
+        x = fluid.data("x", shape=[-1, 3])
         x.stop_gradient = False
         y = fluid.layers.scale(fluid.layers.square(x), scale=3.0)
         loss = fluid.layers.mean(y)
@@ -101,7 +101,7 @@ def test_dropout_grad_uses_saved_mask():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[64])
+        x = fluid.data("x", shape=[-1, 64])
         x.stop_gradient = False
         d = fluid.layers.dropout(x, dropout_prob=0.5)
         loss = fluid.layers.mean(d)

@@ -19,8 +19,8 @@ def _build(lr=0.1, seed=0):
     main.random_seed = seed
     startup.random_seed = seed
     with program_guard(main, startup):
-        x = fluid.data("x", shape=[8])
-        y = fluid.data("y", shape=[1])
+        x = fluid.data("x", shape=[-1, 8])
+        y = fluid.data("y", shape=[-1, 1])
         h = fluid.layers.fc(
             x,
             size=16,
@@ -95,7 +95,7 @@ def test_collective_ops_identity_outside_mesh(rng):
     (reference semantics: ring of size 1)."""
     main = Program()
     with program_guard(main, Program()):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         out = fluid.layers.collective._allreduce(x)
     exe = fluid.Executor(fluid.CPUPlace())
     arr = rng.rand(2, 4).astype("float32")

@@ -1,0 +1,234 @@
+"""Dygraph mode tests (modeled on the reference's test_imperative_* suite:
+python/paddle/fluid/tests/unittests/test_imperative_basic.py,
+test_imperative_resnet.py static/dygraph parity pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import Linear, to_variable
+
+
+def test_basic_eager_math_and_backward():
+    with dygraph.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32))
+        x.stop_gradient = False
+        y = x * x + x
+        loss = dygraph.trace_op("mean", {"X": [y]}, {})["Out"][0]
+        loss.backward()
+        g = x.gradient()
+        expected = (2 * np.array([[1.0, 2.0], [3.0, 4.0]]) + 1) / 4.0
+        np.testing.assert_allclose(g, expected, rtol=1e-5)
+
+
+def test_gradient_accumulation_across_two_uses():
+    with dygraph.guard():
+        x = to_variable(np.ones((3,), dtype=np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        z = x * 3.0
+        s = y + z
+        loss = dygraph.trace_op("reduce_sum", {"X": [s]}, {"reduce_all": True})[
+            "Out"
+        ][0]
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), np.full((3,), 5.0), rtol=1e-5)
+
+
+def test_stop_gradient_blocks_flow():
+    with dygraph.guard():
+        x = to_variable(np.ones((2, 2), dtype=np.float32))
+        x.stop_gradient = False
+        y = (x * 2.0).detach()
+        z = y * 3.0
+        loss = dygraph.trace_op("mean", {"X": [z]}, {})["Out"][0]
+        loss.backward()
+        assert x.gradient() is None
+
+
+def test_linear_layer_trains_with_adam():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 4).astype(np.float32)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    ys = xs @ w_true
+
+    with dygraph.guard(seed=0):
+        model = Linear(4, 1)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=0.1)
+        losses = []
+        for step in range(60):
+            x = to_variable(xs)
+            y = to_variable(ys)
+            pred = model(x)
+            diff = pred - y
+            sq = diff * diff
+            loss = dygraph.trace_op("mean", {"X": [sq]}, {})["Out"][0]
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.05, losses[::10]
+
+
+def test_mlp_static_dygraph_parity():
+    """Same init values + same data -> same losses in both modes (the
+    reference's test_imperative_mnist pattern)."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = (rng.rand(32, 1) > 0.5).astype(np.float32)
+    w0 = rng.randn(8, 16).astype(np.float32) * 0.1
+    w1 = rng.randn(16, 1).astype(np.float32) * 0.1
+
+    # -- static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [32, 8], "float32")
+        y = fluid.data("y", [32, 1], "float32")
+        h = fluid.layers.fc(
+            x,
+            16,
+            act="relu",
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w0)
+            ),
+            bias_attr=False,
+        )
+        p = fluid.layers.fc(
+            h,
+            1,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w1)
+            ),
+            bias_attr=False,
+        )
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(p, y)
+        )
+        fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+    scope = fluid.Scope()
+    from paddle_tpu.core.scope import scope_guard
+
+    static_losses = []
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        for _ in range(5):
+            static_losses.append(
+                float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+            )
+
+    # -- dygraph
+    from paddle_tpu.initializer import NumpyArrayInitializer
+
+    with dygraph.guard():
+        fc0 = Linear(
+            8,
+            16,
+            param_attr=fluid.ParamAttr(initializer=NumpyArrayInitializer(w0)),
+            bias_attr=False,
+            act="relu",
+        )
+        fc1 = Linear(
+            16,
+            1,
+            param_attr=fluid.ParamAttr(initializer=NumpyArrayInitializer(w1)),
+            bias_attr=False,
+        )
+        opt = fluid.optimizer.SGDOptimizer(0.5)
+        dy_losses = []
+        params = fc0.parameters() + fc1.parameters()
+        for _ in range(5):
+            xv, yv = to_variable(xs), to_variable(ys)
+            logits = fc1(fc0(xv))
+            ce = dygraph.trace_op(
+                "sigmoid_cross_entropy_with_logits",
+                {"X": [logits], "Label": [yv]},
+                {},
+            )["Out"][0]
+            l = dygraph.trace_op("mean", {"X": [ce]}, {})["Out"][0]
+            l.backward()
+            opt.minimize(l, parameter_list=params)
+            for p_ in params:
+                p_.clear_gradient()
+            dy_losses.append(float(l.numpy()))
+
+    np.testing.assert_allclose(static_losses, dy_losses, rtol=2e-4, atol=1e-6)
+
+
+def test_sequential_and_state_dict_roundtrip(tmp_path):
+    with dygraph.guard():
+        model = dygraph.Sequential(Linear(4, 8, act="relu"), Linear(8, 2))
+        x = to_variable(np.ones((2, 4), dtype=np.float32))
+        out0 = model(x).numpy()
+        state = model.state_dict()
+        assert len(state) == 4  # 2 weights + 2 biases
+        path = str(tmp_path / "model")
+        dygraph.save_dygraph(state, path)
+        params, _ = dygraph.load_dygraph(path)
+
+        model2 = dygraph.Sequential(Linear(4, 8, act="relu"), Linear(8, 2))
+        # names differ between instances; map by order
+        remapped = dict(zip([p.name for p in model2.parameters()], params.values()))
+        # load_dygraph preserves insertion order of state_dict
+        model2.set_dict(remapped)
+        out1 = model2(x).numpy()
+        np.testing.assert_allclose(out0, out1, rtol=1e-6)
+
+
+def test_batchnorm_updates_running_stats():
+    with dygraph.guard():
+        bn = dygraph.BatchNorm(3)
+        x = to_variable(
+            np.random.RandomState(0).randn(4, 3, 2, 2).astype(np.float32) * 5 + 2
+        )
+        bn.train()
+        _ = bn(x)
+        mean_after = bn._mean.numpy()
+        assert not np.allclose(mean_after, np.zeros(3))
+        bn.eval()
+        y_eval = bn(x).numpy()
+        assert np.isfinite(y_eval).all()
+
+
+def test_embedding_and_conv_forward_backward():
+    with dygraph.guard():
+        emb = dygraph.Embedding([10, 6])
+        ids = to_variable(np.array([[1, 2], [3, 4]], dtype=np.int32))
+        out = emb(ids)
+        assert out.shape == [2, 2, 6]
+        loss = dygraph.trace_op("mean", {"X": [out]}, {})["Out"][0]
+        loss.backward()
+        assert emb.weight.gradient() is not None
+
+        conv = dygraph.Conv2D(3, 4, 3, padding=1)
+        img = to_variable(np.ones((2, 3, 8, 8), dtype=np.float32))
+        y = conv(img)
+        assert y.shape == [2, 4, 8, 8]
+
+
+def test_traced_layer_matches_eager_and_saves(tmp_path):
+    with dygraph.guard():
+        model = dygraph.Sequential(Linear(4, 8, act="relu"), Linear(8, 2))
+        model.eval()
+        x = to_variable(np.random.RandomState(3).randn(5, 4).astype(np.float32))
+        dy_out, traced = dygraph.TracedLayer.trace(model, [x])
+        st_out = traced([x])[0]
+        np.testing.assert_allclose(dy_out.numpy(), st_out.numpy(), rtol=1e-5)
+
+        d = str(tmp_path / "traced_model")
+        traced.save_inference_model(d)
+        import os
+
+        assert os.path.exists(os.path.join(d, "__model__"))
+
+
+def test_no_grad_context():
+    with dygraph.guard():
+        x = to_variable(np.ones((2,), dtype=np.float32))
+        x.stop_gradient = False
+        with dygraph.no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+        tracer = dygraph._dygraph_tracer()
+        assert len(tracer._tape) == 0

@@ -13,7 +13,7 @@ from paddle_tpu.utils.enforce import EnforceError
 def test_program_structure():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         y = fluid.layers.fc(x, size=3)
     assert prog.num_blocks() == 1
     types = [op.type for op in prog.global_block().ops]
@@ -27,7 +27,7 @@ def test_program_structure():
 def test_program_serialization_roundtrip():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         fluid.layers.fc(x, size=3)
     data = prog.to_bytes()
     prog2 = Program.from_bytes(data)
@@ -42,7 +42,7 @@ def test_program_serialization_roundtrip():
 def test_executor_feed_fetch():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[3])
+        x = fluid.data("x", shape=[-1, 3])
         y = fluid.layers.scale(x, scale=2.0, bias=1.0)
     exe = fluid.Executor(fluid.CPUPlace())
     arr = np.arange(6, dtype="float32").reshape(2, 3)
@@ -53,7 +53,7 @@ def test_executor_feed_fetch():
 def test_executor_uninitialized_var_raises():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         fluid.layers.fc(x, size=3)
     exe = fluid.Executor(fluid.CPUPlace())
     with pytest.raises(EnforceError, match="not\\s+initialized"):
@@ -69,7 +69,7 @@ def test_persistable_state_updates():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         y = fluid.layers.fc(x, size=3, bias_attr=False)
         loss = fluid.layers.mean(y)
         fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
@@ -87,7 +87,7 @@ def test_program_clone_for_test_strips_backward():
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         y = fluid.layers.fc(x, size=3)
         d = fluid.layers.dropout(y, dropout_prob=0.5)
         loss = fluid.layers.mean(d)
@@ -132,7 +132,7 @@ def test_rng_determinism_per_seed():
 def test_variable_operator_overloads():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[3])
+        x = fluid.data("x", shape=[-1, 3])
         y = x * 2.0 + 1.0
     exe = fluid.Executor(fluid.CPUPlace())
     arr = np.ones((2, 3), "float32")
@@ -143,7 +143,7 @@ def test_variable_operator_overloads():
 def test_nan_check_mode():
     prog = Program()
     with program_guard(prog):
-        x = fluid.data("x", shape=[3])
+        x = fluid.data("x", shape=[-1, 3])
         y = fluid.layers.log(x)  # log of negative = nan
     exe = fluid.Executor(fluid.CPUPlace())
     fluid.set_flags({"FLAGS_check_nan_inf": True})
@@ -156,3 +156,25 @@ def test_nan_check_mode():
             )
     finally:
         fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_while_loop_survives_dead_op_pruning():
+    """live_ops must keep control-flow ops whose sub-blocks write the fetch
+    target (regression: while ops have outputs={} at the op level)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            ni = fluid.layers.increment(i, value=1.0, in_place=False)
+            na = fluid.layers.elementwise_add(acc, ni)
+            fluid.layers.assign(ni, i)
+            fluid.layers.assign(na, acc)
+            fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        out = exe.run(main, fetch_list=[acc])
+    assert float(np.asarray(out[0]).reshape(-1)[0]) == 15.0

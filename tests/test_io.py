@@ -1,0 +1,121 @@
+"""IO tests (reference test model: python/paddle/fluid/tests/unittests/
+test_inference_model_io.py, test_static_save_load.py)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import io
+from paddle_tpu.core.scope import scope_guard
+
+
+def _build_net():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 8], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.AdamOptimizer(1e-2)
+        opt.minimize(loss)
+    return main, startup, x, y, pred, loss
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    main, startup, x, y, pred, loss = _build_net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    ys = np.ones((4, 1), dtype=np.float32)
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        before = exe.run(main.clone(for_test=True), feed={"x": xs}, fetch_list=[pred])[0]
+        io.save_params(exe, str(tmp_path / "params"), main)
+
+    scope2 = fluid.Scope()
+    with scope_guard(scope2):
+        exe.run(startup)
+        io.load_params(exe, str(tmp_path / "params"), main)
+        after = exe.run(main.clone(for_test=True), feed={"x": xs}, fetch_list=[pred])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_save_persistables_includes_optimizer_state(tmp_path):
+    main, startup, x, y, pred, loss = _build_net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    xs = np.zeros((4, 8), dtype=np.float32)
+    ys = np.zeros((4, 1), dtype=np.float32)
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        names = io.save_persistables(exe, str(tmp_path / "ckpt"), main, filename="all")
+    # adam moments are persistable accumulators
+    assert any("moment" in n for n in names), names
+    n_params = len(main.all_parameters())
+    assert len(names) > n_params
+
+
+def test_save_load_combined_single_file(tmp_path):
+    main, startup, x, y, pred, loss = _build_net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with scope_guard(scope):
+        exe.run(startup)
+        io.save_params(exe, str(tmp_path), main, filename="weights")
+        io.load_params(exe, str(tmp_path), main, filename="weights")
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, x, y, pred, loss = _build_net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    xs = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    with scope_guard(scope):
+        exe.run(startup)
+        expected = exe.run(
+            main.clone(for_test=True), feed={"x": xs}, fetch_list=[pred]
+        )[0]
+        io.save_inference_model(
+            str(tmp_path / "model"), ["x"], [pred], exe, main_program=main
+        )
+    assert os.path.exists(tmp_path / "model" / "__model__")
+
+    scope2 = fluid.Scope()
+    with scope_guard(scope2):
+        prog, feed_names, fetch_vars = io.load_inference_model(
+            str(tmp_path / "model"), exe
+        )
+        assert feed_names == ["x"]
+        out = exe.run(
+            prog, feed={"x": xs}, fetch_list=[fetch_vars[0].name]
+        )[0]
+    np.testing.assert_allclose(expected, out, rtol=1e-6)
+    # grad/optimizer ops must be stripped
+    types = {op.type for op in prog.global_block().ops}
+    assert not any(t.endswith("_grad") or t == "adam" for t in types), types
+
+
+def test_unified_save_load_and_program_state(tmp_path):
+    main, startup, x, y, pred, loss = _build_net()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    xs = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    ys = np.zeros((4, 1), dtype=np.float32)
+    path = str(tmp_path / "unified")
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        io.save(main, path)
+        before = exe.run(main.clone(for_test=True), feed={"x": xs}, fetch_list=[pred])[0]
+
+    state = io.load_program_state(path)
+    scope2 = fluid.Scope()
+    with scope_guard(scope2):
+        exe.run(startup)
+        io.set_program_state(main, state)
+        after = exe.run(main.clone(for_test=True), feed={"x": xs}, fetch_list=[pred])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6)

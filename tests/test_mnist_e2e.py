@@ -21,8 +21,8 @@ def test_mnist_mlp_converges(rng):
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        img = fluid.data("img", shape=[784])
-        label = fluid.data("label", shape=[1], dtype="int64")
+        img = fluid.data("img", shape=[-1, 784])
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
         h = fluid.layers.fc(img, size=64, act="relu")
         logits = fluid.layers.fc(h, size=10)
         loss_all = fluid.layers.softmax_with_cross_entropy(logits, label)
@@ -47,8 +47,8 @@ def test_regression_sgd_converges(rng):
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[13])
-        y = fluid.data("y", shape=[1])
+        x = fluid.data("x", shape=[-1, 13])
+        y = fluid.data("y", shape=[-1, 1])
         pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
         fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
@@ -70,8 +70,8 @@ def test_momentum_and_weight_decay(rng):
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[8])
-        y = fluid.data("y", shape=[1])
+        x = fluid.data("x", shape=[-1, 8])
+        y = fluid.data("y", shape=[-1, 1])
         pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
         opt = fluid.optimizer.Momentum(
@@ -97,7 +97,7 @@ def test_lr_scheduler_noam(rng):
     prog = Program()
     startup = Program()
     with program_guard(prog, startup):
-        x = fluid.data("x", shape=[4])
+        x = fluid.data("x", shape=[-1, 4])
         pred = fluid.layers.fc(x, size=1)
         loss = fluid.layers.mean(pred)
         lr = fluid.layers.learning_rate_scheduler.noam_decay(64, warmup_steps=10)
