@@ -1,0 +1,83 @@
+"""Sharding rules: name-pattern -> PartitionSpec derivation for parameters.
+
+TPU-native replacement for Megatron-style tensor parallelism, which the
+reference lacks (SURVEY §2.7: TP absent, only a DistFCConfig stub at
+reference: python/paddle/fluid/incubate/fleet/collective/__init__.py:40).
+Instead of writing column/row-parallel op variants with hand-placed
+collectives, parameters are annotated with `jax.sharding.PartitionSpec`s
+derived from name patterns; GSPMD partitions every matmul touching a sharded
+operand and inserts the all-reduces/all-gathers over ICI itself.
+
+A rule table is an ordered list of (regex, spec) pairs; first match wins —
+the same shape as the reference's AMP white/black lists
+(reference: python/paddle/fluid/contrib/mixed_precision/fp16_lists.py).
+"""
+
+import re
+
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+#: Megatron-style rules for the transformer naming convention used by
+#: paddle_tpu.models.bert / .gpt: attention q/k/v and ffn-in weights are
+#: column-parallel (output dim sharded on 'model'), attention-out and ffn-out
+#: weights are row-parallel (input dim sharded), their biases replicated so
+#: the psum epilogue stays correct; embeddings shard the vocab dim.
+MEGATRON_RULES = [
+    (r"\.(q|k|v|ffn1)\.w$", P(None, "model")),
+    (r"\.(q|k|v|ffn1)\.b$", P("model")),
+    (r"\.(out|ffn2)\.w$", P("model", None)),
+    (r"\.(out|ffn2)\.b$", P()),
+    (r"word_emb|tok_emb", P("model", None)),
+    (r".*", P()),
+]
+
+
+def match_spec(name, rules):
+    for pat, spec in rules:
+        if re.search(pat, name):
+            return spec
+    return P()
+
+
+def _axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def check_spec(shape, spec, mesh):
+    """A spec is usable only if every named axis exists in the mesh and
+    divides the corresponding dim; otherwise fall back to replicated
+    (mirrors the reference's kernel-fallback behavior when a fused kernel's
+    preconditions fail, reference: paddle/fluid/framework/operator.cc:1041)."""
+    sizes = _axis_sizes(mesh)
+    if spec is None:
+        return P()
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            continue
+        axes = axes if isinstance(axes, tuple) else (axes,)
+        total = 1
+        for ax in axes:
+            if ax not in sizes:
+                return P()
+            total *= sizes[ax]
+        if dim % total != 0:
+            return P()
+    return spec
+
+
+def derive_shardings(names, shapes, mesh, rules=None, overrides=None):
+    """names -> NamedSharding using overrides (exact name -> spec) first,
+    then pattern rules, validated against the mesh."""
+    rules = rules if rules is not None else MEGATRON_RULES
+    overrides = overrides or {}
+    out = {}
+    for name, shape in zip(names, shapes):
+        spec = overrides.get(name)
+        if spec is None:
+            spec = match_spec(name, rules)
+        spec = check_spec(tuple(shape), spec, mesh)
+        out[name] = NamedSharding(mesh, spec)
+    return out
