@@ -40,6 +40,7 @@ from paddle_tpu import amp
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
 from paddle_tpu.layers.tensor import data_v2 as data
 from paddle_tpu.utils.flags import set_flags, get_flags
+from paddle_tpu.utils.enforce import EnforceError
 
 # Alias namespace matching the reference's `fluid` surface
 CUDAPlace = TPUPlace  # source compatibility: device index semantics match

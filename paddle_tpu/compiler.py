@@ -127,18 +127,23 @@ class CompiledProgram:
         mesh = self._mesh
         n_dev = int(np.prod(mesh.devices.shape))
 
-        data_size = (
-            dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
-            if "data" in mesh.axis_names
-            else n_dev
-        )
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        batch_axis = "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+        input_specs = self._input_specs or {}
         feed_arrays = {}
         for name, value in feed.items():
             arr = np.asarray(value) if not isinstance(value, jax.Array) else value
+            # validate divisibility against the axes the feed's dim 0 is
+            # actually sharded over (default: the batch axis)
+            spec = input_specs.get(name, P(batch_axis))
+            dim0_axes = ()
+            if len(spec) > 0 and spec[0] is not None:
+                dim0_axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            shard = int(np.prod([axis_sizes.get(a, 1) for a in dim0_axes] or [1]))
             enforce(
-                arr.shape[0] % max(data_size, 1) == 0,
-                f"feed '{name}' batch dim {arr.shape[0]} must divide the "
-                f"data-axis size {data_size}",
+                arr.ndim == 0 or arr.shape[0] % shard == 0,
+                f"feed '{name}' dim 0 ({arr.shape[0] if arr.ndim else 1}) must "
+                f"divide its sharding {dim0_axes} (total {shard})",
             )
             feed_arrays[name] = arr
 
@@ -153,6 +158,14 @@ class CompiledProgram:
             donated, readonly, written, live = plan_step(
                 block, feed_names, fetch_names, scope, flags.use_donation
             )
+            # shapes below come from scope vars — all of them must exist
+            # BEFORE the entry is built, or a poisoned entry gets cached
+            absent = [n for n in donated + readonly if not scope.has_var(n)]
+            if absent:
+                raise EnforceError(
+                    f"variables {absent} not initialized in scope "
+                    f"(run the startup program first?)"
+                )
 
             def step(feed_vals, donated_vals, readonly_vals, rng_key):
                 env = dict(zip(feed_names, feed_vals))
@@ -164,8 +177,6 @@ class CompiledProgram:
             from paddle_tpu.parallel.sharding import check_spec, derive_shardings
 
             repl = NamedSharding(mesh, P())
-            batch_axis = "data" if "data" in mesh.axis_names else mesh.axis_names[0]
-            input_specs = self._input_specs or {}
             feed_shardings = []
             for n in feed_names:
                 spec = input_specs.get(n, P(batch_axis))

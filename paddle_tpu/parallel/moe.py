@@ -1,0 +1,83 @@
+"""Mixture-of-Experts layer with expert parallelism over a mesh axis.
+
+New first-class work absent from the 2020 reference (SURVEY §2.7: expert
+parallel ✖). Dense Mesh-TensorFlow-style formulation: top-k gating builds
+one-hot dispatch/combine tensors so routing is einsums (MXU work, static
+shapes — no data-dependent gather XLA can't schedule), and tokens travel to
+their expert's device via one `lax.all_to_all` each way over ICI.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top2_gating(logits, capacity):
+    """logits: [T, E]. Returns (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float, aux_loss scalar) — top-2 routing with
+    per-expert capacity C and load-balancing auxiliary loss."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)                       # [T]
+    mask1 = jax.nn.one_hot(g1_idx, e, dtype=probs.dtype)      # [T,E]
+    probs2 = probs * (1.0 - mask1)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(g2_idx, e, dtype=probs.dtype)
+
+    # load-balance loss (Shazeer et al.): mean gate prob * mean assignment
+    density = mask1.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux_loss = (density * density_proxy).sum() * (e * e) / e
+
+    # positions within each expert's buffer (running count over tokens)
+    pos1 = (jnp.cumsum(mask1, axis=0) - mask1)                # [T,E]
+    pos1 = (pos1 * mask1).sum(axis=-1)                        # [T]
+    within1 = pos1 < capacity
+    pos2_base = jnp.cumsum(mask2, axis=0) - mask2 + mask1.sum(axis=0, keepdims=True)
+    pos2 = (pos2_base * mask2).sum(axis=-1)
+    within2 = pos2 < capacity
+
+    w1 = (probs * mask1).sum(axis=-1) * within1               # [T]
+    w2 = (probs * mask2).sum(axis=-1) * within2
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    oh_pos1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity, dtype=probs.dtype)
+    oh_pos2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity, dtype=probs.dtype)
+    combine = (
+        w1[:, None, None] * mask1[:, :, None] * oh_pos1[:, None, :]
+        + w2[:, None, None] * mask2[:, :, None] * oh_pos2[:, None, :]
+    )                                                          # [T,E,C]
+    dispatch = (combine > 0.0).astype(probs.dtype)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn_local(x, gate_w, expert_params, expert_fn, expert_axis,
+                  capacity_factor=2.0):
+    """Runs INSIDE shard_map. x: [T_local, H] tokens; gate_w: [H, E_total];
+    expert_params: pytree with leading dim E_local (this device's experts).
+    Tokens are dispatched to experts with two all_to_alls over `expert_axis`.
+    Returns ([T_local, H], aux_loss)."""
+    n_dev = lax.psum(1, expert_axis)
+    t_loc, h = x.shape
+    e_total = gate_w.shape[1]
+    e_local = e_total // n_dev
+    capacity = max(int(capacity_factor * t_loc * 2 / e_total), 4)
+
+    logits = x @ gate_w                                       # [T,E]
+    dispatch, combine, aux = top2_gating(logits, capacity)
+
+    # [T,E,C] x [T,H] -> [E,C,H]: expert-major token buffers
+    buf = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+    # expert g lives on device g // e_local: splitting axis 0 into n_dev
+    # chunks routes each expert block to its owner; received chunks stack
+    # along the token axis -> [E_local, n_dev*C, H]
+    buf = lax.all_to_all(buf, expert_axis, split_axis=0, concat_axis=1, tiled=True)
+
+    out = jax.vmap(expert_fn)(expert_params, buf)             # [E_local, n_dev*C, H]
+
+    # inverse shuffle: tokens go back to their source device
+    out = lax.all_to_all(out, expert_axis, split_axis=1, concat_axis=0, tiled=True)
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), out)
+    return y, aux

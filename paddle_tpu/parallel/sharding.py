@@ -54,6 +54,8 @@ def check_spec(shape, spec, mesh):
     sizes = _axis_sizes(mesh)
     if spec is None:
         return P()
+    if len(spec) > len(shape):
+        return P()  # over-long spec can't apply to this rank
     for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
         if axes is None:
             continue

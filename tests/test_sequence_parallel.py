@@ -1,0 +1,69 @@
+"""Ring / Ulysses attention parity vs exact full attention (8-CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.env import make_mesh
+from paddle_tpu.parallel.ring import ring_attention
+from paddle_tpu.parallel.ulysses import ulysses_attention, _full_attention
+
+
+def _qkv(rng, b=2, h=4, s=32, d=8):
+    mk = lambda: rng.randn(b, h, s, d).astype("float32")
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full(rng, causal):
+    q, k, v = _qkv(rng)
+    ref = _full_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                          1.0 / np.sqrt(q.shape[-1]), causal)
+    mesh = make_mesh(shape=(8,), axis_names=("seq",))
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(rng, causal):
+    q, k, v = _qkv(rng, h=8)
+    ref = _full_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                          1.0 / np.sqrt(q.shape[-1]), causal)
+    mesh = make_mesh(shape=(4,), axis_names=("seq",))
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match(rng):
+    """Reverse-mode through the ring (scan transpose) must equal full-attn
+    gradients — the property that makes ring attention usable for training."""
+    q, k, v = _qkv(rng, b=1, h=2, s=16, d=4)
+    mesh = make_mesh(shape=(4,), axis_names=("seq",))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_ring(q, k, v):
+        return ring_attention(q, k, v, mesh, causal=True).sum()
+
+    def loss_full(q, k, v):
+        return _full_attention(q, k, v, scale, True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(
+        jnp.array(q), jnp.array(k), jnp.array(v)
+    )
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(
+        jnp.array(q), jnp.array(k), jnp.array(v)
+    )
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), rtol=1e-4, atol=1e-5)
+
+
+def test_ring_with_batch_axis(rng):
+    """Ring composed with data parallelism on a 2-D mesh."""
+    q, k, v = _qkv(rng, b=4, s=16)
+    mesh = make_mesh(shape=(2, 4), axis_names=("data", "seq"))
+    ref = _full_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                          1.0 / np.sqrt(q.shape[-1]), False)
+    out = ring_attention(q, k, v, mesh, batch_axis="data")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
