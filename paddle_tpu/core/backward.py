@@ -139,6 +139,100 @@ def _requires_grad_vars(block, ops, no_grad_set, extra_seeds=()):
     return requires
 
 
+def _is_float_var(block, name, default=True):
+    v = block._find_var_recursive(name)
+    if v is None or v.dtype is None:
+        return default
+    return "float" in str(v.dtype)
+
+
+#: op types that must not be folded into a recompute segment (they run
+#: sub-blocks or have host side effects)
+_NO_SEGMENT_OPS = {"while", "conditional_block", "recurrent", "print", "py_func"}
+
+
+def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires):
+    """Collapse `seg_ops` (consecutive forward ops) into one pseudo
+    recompute_segment op; its grad op replays the segment at backward time
+    (ops/recompute.py). Only the segment's boundary values stay live across
+    fwd->bwd — the remat analog of the reference's checkpoint re-emission
+    (reference: python/paddle/fluid/backward.py:618)."""
+    from paddle_tpu.core.ir import Operator
+
+    seg_ids = {id(o) for o in seg_ops}
+    in_names, inner_produced = [], set()
+    for o in seg_ops:
+        for n in o.input_names():
+            if n not in inner_produced and n not in in_names:
+                in_names.append(n)
+        inner_produced.update(o.output_names())
+    outside_reads = set()
+    for o in block.ops:
+        if id(o) not in seg_ids:
+            outside_reads.update(o.input_names())
+    out_names = []
+    for o in seg_ops:
+        for n in o.output_names():
+            if n in out_names:
+                continue
+            v = block._find_var_recursive(n)
+            if (
+                n in outside_reads
+                or n in ckpt_set
+                or n == loss_name
+                or (v is not None and v.persistable)
+            ):
+                out_names.append(n)
+    segment = [
+        (
+            o.type,
+            {k: list(v) for k, v in o.inputs.items()},
+            {k: list(v) for k, v in o.outputs.items()},
+            {k: v for k, v in o.attrs.items() if k != "op_callstack"},
+        )
+        for o in seg_ops
+    ]
+    attrs = {
+        "__segment__": segment,
+        "__in_names__": list(in_names),
+        "__out_names__": list(out_names),
+        "__diff_ins__": [
+            n for n in in_names if n in requires and _is_float_var(block, n)
+        ],
+        "__diff_outs__": [n for n in out_names if _is_float_var(block, n)],
+    }
+    return Operator(
+        block, "recompute_segment", {"X": in_names}, {"Out": out_names}, attrs
+    )
+
+
+def _collapse_segments(block, ops, checkpoints, loss_name, requires):
+    """Greedy segmentation of the relevant forward ops: a segment closes at
+    each op producing a checkpoint var; control-flow/side-effect ops stay
+    outside segments; 1-op segments aren't worth a replay."""
+    ckpt_set = set(checkpoints)
+    walk, cur = [], []
+
+    def flush():
+        nonlocal cur
+        if len(cur) >= 2:
+            walk.append(_make_segment_op(block, cur, ckpt_set, loss_name, requires))
+        else:
+            walk.extend(cur)
+        cur = []
+
+    for op in ops:
+        if op.type in _NO_SEGMENT_OPS:
+            flush()
+            walk.append(op)
+            continue
+        cur.append(op)
+        if any(n in ckpt_set for n in op.output_names()):
+            flush()
+    flush()
+    return walk
+
+
 def _create_grad_var(block, fwd_name, grad_name):
     if grad_name in block.vars:
         return block.vars[grad_name]
@@ -250,9 +344,16 @@ def append_backward(
     )
     partials[loss.name] = [loss_grad_name]
 
-    for op in reversed(fwd_ops):
-        if id(op) not in relevant_set:
-            continue
+    ordered_relevant = [op for op in fwd_ops if id(op) in relevant_set]
+    checkpoints = getattr(program, "_recompute_checkpoints", None)
+    if checkpoints:
+        walk_ops = _collapse_segments(
+            block, ordered_relevant, checkpoints, loss.name, requires
+        )
+    else:
+        walk_ops = ordered_relevant
+
+    for op in reversed(walk_ops):
         # outputs' grads must be finalized before this op's grad runs
         out_grad_slots = {}
         has_any = False

@@ -102,7 +102,11 @@ def _interpret_block(block, env, rng_key, use_pallas=True, ops=None):
             if names and all(n in env for n in names)
         }
         if op_def.stateful:
-            ins["__rng_key__"] = [jax.random.fold_in(rng_key, op_index)]
+            ins["__rng_key__"] = [
+                jax.random.fold_in(rng_key, op.attrs.get("__rng_id__", op_index))
+            ]
+        if op_def.needs_base_rng:
+            ins["__base_rng__"] = [rng_key]
         try:
             outs = op_def.lowering(use_pallas)(ins, op.attrs)
         except EnforceError:
@@ -342,7 +346,11 @@ class Executor:
                 if names and all(n in env for n in names)
             }
             if op_def.stateful:
-                ins["__rng_key__"] = [jax.random.fold_in(rng_key, op_index)]
+                ins["__rng_key__"] = [
+                    jax.random.fold_in(rng_key, op.attrs.get("__rng_id__", op_index))
+                ]
+            if op_def.needs_base_rng:
+                ins["__base_rng__"] = [rng_key]
             outs = op_def.lowering()(ins, op.attrs)
             for slot, names in op.outputs.items():
                 if slot not in outs:

@@ -180,6 +180,10 @@ class Operator:
         if _current_name_scope():
             self.attrs.setdefault("op_namescope", _current_name_scope())
         self.attrs.setdefault("op_callstack", user_callstack())
+        # stable per-op rng id: stateful ops fold the step key with this id,
+        # so dropout masks are reproducible across pruning/replay (recompute)
+        if "__rng_id__" not in self.attrs:
+            self.attrs["__rng_id__"] = block.program._next_rng_id()
 
     def input_names(self):
         return [n for names in self.inputs.values() for n in names]
@@ -322,6 +326,7 @@ class Program:
     reference: python/paddle/fluid/framework.py:3602)."""
 
     def __init__(self):
+        self._rng_op_counter = 0
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self._version = 0
@@ -356,6 +361,10 @@ class Program:
 
     def _bump_version(self):
         self._version += 1
+
+    def _next_rng_id(self):
+        self._rng_op_counter += 1
+        return self._rng_op_counter
 
     def all_parameters(self):
         return self.global_block().all_parameters()

@@ -36,6 +36,7 @@ class OpDef:
         pallas=None,
         nondiff_inputs=(),
         stateful=False,
+        needs_base_rng=False,
     ):
         self.type = type
         self.lower = lower
@@ -46,6 +47,9 @@ class OpDef:
         self.nondiff_inputs = frozenset(nondiff_inputs)
         # stateful ops (random, print, ...) must not be CSE'd away
         self.stateful = stateful
+        # ops replaying other ops (recompute_segment_grad) get the step's
+        # UNFOLDED rng key so they can reproduce per-op folds exactly
+        self.needs_base_rng = needs_base_rng
 
     def lowering(self, use_pallas=True):
         if use_pallas and self.pallas is not None:
@@ -78,7 +82,7 @@ class OpRegistry:
         return sorted(cls._ops)
 
 
-def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False):
+def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False):
     """Decorator form:  @register_op("relu")  def _(ins, attrs): ..."""
 
     def deco(fn):
@@ -91,6 +95,7 @@ def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(
                 pallas=pallas,
                 nondiff_inputs=nondiff_inputs,
                 stateful=stateful,
+                needs_base_rng=needs_base_rng,
             )
         )
         return fn

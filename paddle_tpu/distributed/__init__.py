@@ -1,0 +1,3 @@
+"""Distributed launch utilities (reference: python/paddle/distributed/)."""
+
+from paddle_tpu.distributed.launch import launch_procs  # noqa: F401

@@ -1,0 +1,115 @@
+"""Multi-process launcher.
+
+Reference: python/paddle/distributed/launch.py — parses cluster env and
+spawns one worker process per device (start_procs :175), injecting
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS
+(:105-109). The TPU-native difference: JAX is multi-controller SPMD, so the
+unit of launch is one process per HOST (each host drives all its local
+chips), and rendezvous is the JAX coordinator (PADDLE_DIST_COORDINATOR)
+instead of NCCL-id RPC. For CPU-based testing, --nproc emulates multiple
+hosts on localhost with virtual devices.
+
+Usage:  python -m paddle_tpu.distributed.launch --nproc 2 train.py [args...]
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+__all__ = ["launch_procs", "main"]
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_procs(
+    script_args,
+    nproc=1,
+    started_port=None,
+    coordinator=None,
+    extra_env=None,
+    devices_per_proc=None,
+):
+    """Spawn `nproc` worker processes running `script_args`, with the fleet
+    env contract injected. Returns the list of exit codes."""
+    started_port = started_port or _free_port()
+    endpoints = ",".join(
+        f"127.0.0.1:{started_port + i}" for i in range(nproc)
+    )
+    coordinator = coordinator or (
+        f"127.0.0.1:{_free_port()}" if nproc > 1 else ""
+    )
+    # make the framework importable in workers even when not pip-installed
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH")) if p
+        )
+        env.update(extra_env or {})
+        env.update(
+            {
+                "TRAINING_ROLE": "TRAINER",
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nproc),
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{started_port + rank}",
+            }
+        )
+        if coordinator:
+            env["PADDLE_DIST_COORDINATOR"] = coordinator
+        if devices_per_proc:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={devices_per_proc}"
+            ).strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        procs.append(
+            subprocess.Popen([sys.executable] + list(script_args), env=env)
+        )
+
+    def _terminate(signum, frame):
+        for p in procs:
+            p.terminate()
+
+    old = signal.signal(signal.SIGTERM, _terminate)
+    try:
+        codes = [p.wait() for p in procs]
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    return codes
+
+
+def main():
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc", type=int, default=1,
+                        help="processes (hosts) to launch on this machine")
+    parser.add_argument("--started_port", type=int, default=None)
+    parser.add_argument("--devices_per_proc", type=int, default=None,
+                        help="virtual CPU devices per process (testing)")
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    codes = launch_procs(
+        [args.script] + args.script_args,
+        nproc=args.nproc,
+        started_port=args.started_port,
+        devices_per_proc=args.devices_per_proc,
+    )
+    bad = [i for i, c in enumerate(codes) if c != 0]
+    if bad:
+        sys.exit(f"workers {bad} exited nonzero: {[codes[i] for i in bad]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Fleet: unified distributed-training API.
+
+Reference: python/paddle/fluid/incubate/fleet/ — the Fleet facade
+(base/fleet_base.py:38), role makers (base/role_maker.py), collective mode
+(collective/__init__.py) and parameter-server mode. Usage shape matches the
+reference:
+
+    from paddle_tpu.fleet import fleet, DistributedStrategy
+    fleet.init(role_maker)
+    opt = fleet.distributed_optimizer(fluid.optimizer.Adam(1e-4), strategy)
+    opt.minimize(loss)
+    exe.run(fleet.main_program, feed=..., fetch_list=...)
+"""
+
+from paddle_tpu.fleet.base import DistributedOptimizer, Fleet
+from paddle_tpu.fleet.collective import (
+    CollectiveOptimizer,
+    DistributedStrategy,
+    fleet,
+)
+from paddle_tpu.fleet import role_maker
+from paddle_tpu.fleet.role_maker import (
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedCollectiveRoleMaker,
+    UserDefinedRoleMaker,
+)
+
+__all__ = [
+    "fleet",
+    "Fleet",
+    "DistributedOptimizer",
+    "CollectiveOptimizer",
+    "DistributedStrategy",
+    "role_maker",
+    "Role",
+    "RoleMakerBase",
+    "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker",
+    "UserDefinedCollectiveRoleMaker",
+]

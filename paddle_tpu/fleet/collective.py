@@ -1,0 +1,118 @@
+"""Collective fleet mode: SPMD data/hybrid parallelism over a device mesh.
+
+Reference: python/paddle/fluid/incubate/fleet/collective/__init__.py —
+`CollectiveOptimizer` :378 transpiles the program (inserting c_allreduce ops,
+python/paddle/fluid/transpiler/collective.py:178) and compiles with
+ParallelExecutor (:312-376). Here `minimize` runs the plain optimizer pass,
+then hands back a CompiledProgram whose step is pjit-partitioned over a mesh
+built from the DistributedStrategy — GSPMD inserts the gradient all-reduces
+over ICI/DCN, so there is no transpiler inserting collective ops.
+"""
+
+from paddle_tpu.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.core.ir import default_startup_program
+from paddle_tpu.fleet.base import DistributedOptimizer, Fleet
+from paddle_tpu.parallel.env import make_mesh
+
+__all__ = ["DistributedStrategy", "CollectiveOptimizer", "fleet"]
+
+
+class DistributedStrategy(BuildStrategy):
+    """Extends BuildStrategy the way the reference's collective
+    DistributedStrategy does (reference: incubate/fleet/collective/
+    __init__.py:134). The meaningful TPU knobs are the mesh factorization and
+    feature toggles; NCCL tuning knobs are accepted and ignored (XLA owns
+    collective scheduling)."""
+
+    def __init__(self):
+        super().__init__()
+        # mesh factorization: None → 1-D 'data' mesh over all devices.
+        # 2-D (dcn, ici) shapes express hierarchical allreduce
+        # (reference: paddle/fluid/framework/parallel_executor.cc:196).
+        self.mesh_shape = None
+        self.mesh_axis_names = None
+        self.param_rules = None      # Megatron-style TP rule table
+        self.param_specs = None      # exact name -> PartitionSpec
+        self.input_specs = None      # feed name -> PartitionSpec
+        # feature toggles, applied as program rewrites in minimize()
+        self.use_amp = False
+        self.amp_lists = None
+        self.init_loss_scaling = 2.0 ** 15
+        self.use_dynamic_loss_scaling = True
+        self.recompute = False
+        self.recompute_checkpoints = None
+        # accepted-for-parity NCCL knobs (no-ops under XLA)
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 1
+        self.forward_recompute = False  # alias some configs use
+
+    def build_mesh(self, devices=None):
+        return make_mesh(
+            shape=self.mesh_shape, axis_names=self.mesh_axis_names, devices=devices
+        )
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+
+    def minimize(
+        self, loss, startup_program=None, parameter_list=None, no_grad_set=None
+    ):
+        strategy = self._strategy
+        opt = self._optimizer
+        if strategy.recompute:
+            from paddle_tpu.optimizer import RecomputeOptimizer
+
+            opt = RecomputeOptimizer(opt)
+            if strategy.recompute_checkpoints:
+                opt._set_checkpoints(strategy.recompute_checkpoints)
+        if strategy.use_amp:
+            from paddle_tpu import amp
+
+            opt = amp.decorate(
+                opt,
+                amp_lists=strategy.amp_lists,
+                init_loss_scaling=strategy.init_loss_scaling,
+                use_dynamic_loss_scaling=strategy.use_dynamic_loss_scaling,
+            )
+        optimize_ops, params_grads = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+        main = loss.block.program
+        fleet._origin_program = main
+        fleet._startup_program = startup_program or default_startup_program()
+        compiled = CompiledProgram(main, build_strategy=strategy).with_parallel(
+            mesh=strategy.build_mesh(),
+            loss_name=loss.name,
+            param_rules=strategy.param_rules,
+            param_specs=strategy.param_specs,
+            input_specs=strategy.input_specs,
+        )
+        fleet._main_program = compiled
+        return optimize_ops, params_grads
+
+
+class _CollectiveFleet(Fleet):
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        raise RuntimeError("collective fleet has no servers")
+
+    def run_server(self):
+        raise RuntimeError("collective fleet has no servers")
+
+    def stop_worker(self):
+        pass
+
+
+#: module-level singleton, same usage shape as the reference's
+#: `from paddle.fluid.incubate.fleet.collective import fleet`
+fleet = _CollectiveFleet()

@@ -1,0 +1,105 @@
+"""Recompute (activation checkpointing) segment ops.
+
+Reference mechanism: RecomputeOptimizer re-emits forward ops between user
+checkpoints inside the backward region so inter-checkpoint activations are
+never stored (reference: python/paddle/fluid/optimizer.py:3714,
+python/paddle/fluid/backward.py:618 _append_backward_ops_with_checkpoints_).
+
+TPU-native mechanism: append_backward collapses each inter-checkpoint forward
+segment into ONE `recompute_segment_grad` op whose lowering replays the
+segment under `jax.vjp(jax.checkpoint(f))` — the replay happens at backward
+time inside the same XLA computation, and `prevent_cse=True` stops XLA from
+de-duplicating it against the stored forward pass (which would silently pin
+the activations and defeat the remat). Stateful ops (dropout) replay with the
+exact per-op rng folds of the forward pass via the stable `__rng_id__` ids.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op_def, register_op
+from paddle_tpu.utils.enforce import EnforceError
+
+
+def replay_segment(segment, env, base_rng):
+    """Run a serialized op list against `env` (name -> array), mutating it.
+    `segment` entries are (type, inputs, outputs, attrs) tuples captured by
+    append_backward; rng folds reproduce the forward pass exactly."""
+    for op_type, inputs, outputs, attrs in segment:
+        op_def = get_op_def(op_type)
+        ins = {
+            slot: [env[n] for n in names]
+            for slot, names in inputs.items()
+            if names and all(n in env for n in names)
+        }
+        if op_def.stateful:
+            if base_rng is None:
+                raise EnforceError(
+                    f"stateful op {op_type} in recompute segment but no base "
+                    f"rng key available"
+                )
+            ins["__rng_key__"] = [
+                jax.random.fold_in(base_rng, attrs["__rng_id__"])
+            ]
+        outs = op_def.lowering(True)(ins, attrs)
+        for slot, names in outputs.items():
+            if slot not in outs:
+                continue
+            vals = outs[slot]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for name, val in zip(names, vals):
+                if val is not None:
+                    env[name] = val
+    return env
+
+
+@register_op("recompute_segment", needs_base_rng=True)
+def _recompute_segment(ins, attrs):
+    """Forward replay of a segment (used if a segment pseudo-op is ever
+    materialized in a program; normally only the grad op below executes)."""
+    env = dict(zip(attrs["__in_names__"], ins["X"]))
+    base_rng = ins.get("__base_rng__", [None])[0]
+    replay_segment(attrs["__segment__"], env, base_rng)
+    return {"Out": [env[n] for n in attrs["__out_names__"]]}
+
+
+@register_op("recompute_segment_grad", needs_base_rng=True)
+def _recompute_segment_grad(ins, attrs):
+    in_names = attrs["__in_names__"]
+    out_names = attrs["__out_names__"]
+    diff_ins = [n for n in attrs["__diff_ins__"] if n in in_names]
+    diff_outs = [n for n in attrs["__diff_outs__"] if n in out_names]
+    segment = attrs["__segment__"]
+    xs = ins["X"]
+    base_rng = ins.get("__base_rng__", [None])[0]
+    if not diff_ins:
+        return {}
+    diff_idx = [in_names.index(n) for n in diff_ins]
+
+    def f(diff_vals):
+        env = dict(zip(in_names, xs))
+        env.update(zip(diff_ins, diff_vals))
+        replay_segment(segment, env, base_rng)
+        return [env[n] for n in diff_outs]
+
+    # prevent_cse: without it XLA CSEs the replay against the live forward
+    # pass, keeping every intermediate activation alive to the backward —
+    # exactly the memory profile recompute exists to avoid
+    f_ck = jax.checkpoint(f, prevent_cse=True)
+    primal_in = [xs[i] for i in diff_idx]
+    primal_out, vjp = jax.vjp(f_ck, primal_in)
+    gouts = ins.get("Out@GRAD", [])
+    cotangents = []
+    for j, n in enumerate(diff_outs):
+        pos = out_names.index(n)
+        g = gouts[pos] if pos < len(gouts) and gouts[pos] is not None else None
+        p = primal_out[j]
+        cotangents.append(
+            g.astype(p.dtype) if g is not None else jnp.zeros_like(p)
+        )
+    (gxs,) = vjp(cotangents)
+    grads = [None] * len(xs)
+    for k, i in enumerate(diff_idx):
+        grads[i] = gxs[k]
+    return {"X@GRAD": grads}

@@ -716,6 +716,53 @@ AdamW = AdamWOptimizer
 Adamax = AdamaxOptimizer
 Adadelta = AdadeltaOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
+class RecomputeOptimizer(Optimizer):
+    """Activation recomputation (reference: python/paddle/fluid/
+    optimizer.py:3714 RecomputeOptimizer + backward.py:618
+    _append_backward_ops_with_checkpoints_).
+
+    The reference re-emits forward ops between user checkpoints inside the
+    backward section so activations need not be stored. Here checkpoints are
+    recorded on the program and append_backward collapses each
+    inter-checkpoint forward segment into one recompute_segment_grad op that
+    replays the segment under jax.vjp(jax.checkpoint(...)) at backward time
+    (core/backward.py _collapse_segments, ops/recompute.py) — only segment
+    boundaries stay live across fwd->bwd. Gradients are mathematically
+    identical with or without recompute.
+    """
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [
+            c if isinstance(c, str) else c.name for c in checkpoints
+        ]
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if self._checkpoints:
+            loss.block.program._recompute_checkpoints = list(self._checkpoints)
+        return self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._checkpoints:
+            loss.block.program._recompute_checkpoints = list(self._checkpoints)
+        return self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer

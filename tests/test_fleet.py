@@ -1,0 +1,152 @@
+"""Fleet API tests (reference pattern: python/paddle/fluid/tests/unittests/
+test_fleet_base.py + test_dist_base.py loss-parity methodology, on the
+virtual 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.fleet import (
+    DistributedStrategy,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    fleet,
+)
+
+
+def test_paddle_cloud_role_maker_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv(
+        "PADDLE_TRAINER_ENDPOINTS", "10.0.0.1:6170,10.0.0.2:6170,10.0.0.3:6170"
+    )
+    rm = PaddleCloudRoleMaker()
+    assert rm.is_worker()
+    assert not rm.is_server()
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 3
+    assert not rm.is_first_worker()
+    assert rm.get_trainer_endpoints()[1] == "10.0.0.2:6170"
+
+
+def test_paddle_cloud_role_maker_pserver(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:7000,127.0.0.1:7001")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:7001")
+    rm = PaddleCloudRoleMaker(is_collective=False)
+    assert rm.is_server()
+    assert rm.server_index() == 1
+    assert rm.server_num() == 2
+
+
+def test_user_defined_role_maker():
+    rm = UserDefinedRoleMaker(
+        current_id=0,
+        role=Role.WORKER,
+        worker_num=4,
+        server_endpoints=["127.0.0.1:7164"],
+    )
+    assert rm.is_first_worker()
+    assert rm.worker_num() == 4
+    assert rm.server_num() == 1
+
+
+def _build_model(seed=0):
+    x = fluid.data("x", shape=[-1, 8])
+    y = fluid.data("y", shape=[-1, 1])
+    h = fluid.layers.fc(
+        x, size=16, act="relu",
+        param_attr=fluid.ParamAttr(initializer=fluid.initializer.Constant(0.05)),
+    )
+    pred = fluid.layers.fc(
+        h, size=1,
+        param_attr=fluid.ParamAttr(initializer=fluid.initializer.Constant(0.1)),
+    )
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return loss
+
+
+def test_collective_fleet_loss_parity(rng, monkeypatch):
+    """fleet-compiled distributed run must track the single-device run
+    (the reference's TestDistBase assertion, test_dist_base.py:506)."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    x = rng.rand(64, 8).astype("float32")
+    y = x.sum(axis=1, keepdims=True).astype("float32")
+
+    # single-device reference
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss = _build_model()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ref = [
+            float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+            for _ in range(3)
+        ]
+
+    # fleet collective run over the 8-device mesh
+    main2, startup2 = Program(), Program()
+    with program_guard(main2, startup2):
+        loss2 = _build_model()
+        fleet.init(PaddleCloudRoleMaker())
+        strategy = DistributedStrategy()
+        dist_opt = fleet.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), strategy
+        )
+        dist_opt.minimize(loss2)
+    assert fleet.worker_num() == 1
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fleet.startup_program)
+        got = [
+            float(
+                exe.run(
+                    fleet.main_program, feed={"x": x, "y": y}, fetch_list=[loss2]
+                )[0][0]
+            )
+            for _ in range(3)
+        ]
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+
+
+def test_collective_fleet_amp_recompute(rng, monkeypatch):
+    """Strategy toggles compose: AMP + recompute still train and converge."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    x = rng.rand(32, 8).astype("float32")
+    y = x.sum(axis=1, keepdims=True).astype("float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", shape=[-1, 8])
+        yv = fluid.data("y", shape=[-1, 1])
+        h = fluid.layers.fc(xv, size=16, act="relu")
+        h2 = fluid.layers.fc(h, size=16, act="relu")
+        pred = fluid.layers.fc(h2, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yv))
+        fleet.init(PaddleCloudRoleMaker())
+        strategy = DistributedStrategy()
+        strategy.recompute = True
+        strategy.recompute_checkpoints = [h.name, h2.name]
+        dist_opt = fleet.distributed_optimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), strategy
+        )
+        dist_opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fleet.startup_program)
+        losses = [
+            float(
+                exe.run(
+                    fleet.main_program, feed={"x": x, "y": y}, fetch_list=[loss]
+                )[0][0]
+            )
+            for _ in range(10)
+        ]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()

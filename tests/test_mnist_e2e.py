@@ -33,7 +33,7 @@ def test_mnist_mlp_converges(rng):
     exe.run(startup)
     x, y = _synthetic_mnist(rng)
     losses = []
-    for epoch in range(30):
+    for epoch in range(60):
         (l, a) = exe.run(
             prog, feed={"img": x, "label": y}, fetch_list=[loss, acc]
         )
