@@ -38,6 +38,11 @@ from paddle_tpu.dygraph.base import in_dygraph_mode
 from paddle_tpu import io
 from paddle_tpu import amp
 from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+from paddle_tpu import reader
+from paddle_tpu.reader import DataLoader, PyReader
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu import dataset
+from paddle_tpu.dataset import DatasetFactory
 from paddle_tpu.layers.tensor import data_v2 as data
 from paddle_tpu.utils.flags import set_flags, get_flags
 from paddle_tpu.utils.enforce import EnforceError

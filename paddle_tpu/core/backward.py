@@ -151,12 +151,14 @@ def _is_float_var(block, name, default=True):
 _NO_SEGMENT_OPS = {"while", "conditional_block", "recurrent", "print", "py_func"}
 
 
-def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires):
+def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires, readers):
     """Collapse `seg_ops` (consecutive forward ops) into one pseudo
     recompute_segment op; its grad op replays the segment at backward time
     (ops/recompute.py). Only the segment's boundary values stay live across
     fwd->bwd — the remat analog of the reference's checkpoint re-emission
-    (reference: python/paddle/fluid/backward.py:618)."""
+    (reference: python/paddle/fluid/backward.py:618). `readers` maps
+    name -> set of reader op ids over the whole block (precomputed once so
+    segmentation stays linear in block size)."""
     from paddle_tpu.core.ir import Operator
 
     seg_ids = {id(o) for o in seg_ops}
@@ -166,10 +168,9 @@ def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires):
             if n not in inner_produced and n not in in_names:
                 in_names.append(n)
         inner_produced.update(o.output_names())
-    outside_reads = set()
-    for o in block.ops:
-        if id(o) not in seg_ids:
-            outside_reads.update(o.input_names())
+
+    def read_outside(n):
+        return bool(readers.get(n, set()) - seg_ids)
     out_names = []
     for o in seg_ops:
         for n in o.output_names():
@@ -177,7 +178,7 @@ def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires):
                 continue
             v = block._find_var_recursive(n)
             if (
-                n in outside_reads
+                read_outside(n)
                 or n in ckpt_set
                 or n == loss_name
                 or (v is not None and v.persistable)
@@ -212,11 +213,17 @@ def _collapse_segments(block, ops, checkpoints, loss_name, requires):
     outside segments; 1-op segments aren't worth a replay."""
     ckpt_set = set(checkpoints)
     walk, cur = [], []
+    readers = {}
+    for o in block.ops:
+        for n in o.input_names():
+            readers.setdefault(n, set()).add(id(o))
 
     def flush():
         nonlocal cur
         if len(cur) >= 2:
-            walk.append(_make_segment_op(block, cur, ckpt_set, loss_name, requires))
+            walk.append(
+                _make_segment_op(block, cur, ckpt_set, loss_name, requires, readers)
+            )
         else:
             walk.extend(cur)
         cur = []

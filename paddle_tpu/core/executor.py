@@ -250,6 +250,53 @@ class Executor:
         )
 
     # ------------------------------------------------------------------
+    def train_from_dataset(
+        self,
+        program=None,
+        dataset=None,
+        scope=None,
+        thread=0,
+        debug=False,
+        fetch_list=None,
+        fetch_info=None,
+        print_period=100,
+    ):
+        """Dataset-mode training loop (reference: python/paddle/fluid/
+        executor.py:1124 train_from_dataset -> C++ Executor::RunFromDataset
+        with thread-per-core DeviceWorkers). TPU-native: the whole step is
+        one XLA computation, so the worker-thread pool collapses into the
+        native data-feed producing batches (csrc/datafeed) while the chip
+        runs the compiled step; thread/debug are accepted for parity."""
+        from paddle_tpu.utils.enforce import enforce as _enforce
+
+        _enforce(dataset is not None, "dataset is required")
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [str(f) for f in fetch_list]
+        step = 0
+        last = None
+        for feed in dataset._iter_batches():
+            out = self.run(
+                program, feed=feed, fetch_list=fetch_list, scope=scope
+            )
+            last = out
+            if fetch_list and (debug or (step % print_period == 0)):
+                msgs = [
+                    f"{info}={np.asarray(v).reshape(-1)[:1][0]:.6f}"
+                    for info, v in zip(fetch_info, out)
+                ]
+                print(f"step {step}: " + ", ".join(msgs))
+            step += 1
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info,
+            print_period,
+        )
+
+    # ------------------------------------------------------------------
     def _to_device(self, value, block, name):
         if isinstance(value, jax.Array):
             return value

@@ -507,6 +507,12 @@ class Program:
                     Operator(b, odesc["type"], odesc["inputs"], odesc["outputs"], odesc["attrs"])
                 )
             p.blocks.append(b)
+        # ops appended after deserialization must not collide with restored
+        # __rng_id__s (correlated dropout masks/initializer streams)
+        p._rng_op_counter = max(
+            (op.attrs.get("__rng_id__", 0) for b in p.blocks for op in b.ops),
+            default=0,
+        )
         return p
 
     def to_string(self, throw_on_error=False):

@@ -1,0 +1,66 @@
+"""DataFeeder: convert user samples into feed dicts of batched numpy arrays.
+
+Reference: python/paddle/fluid/data_feeder.py — DataFeeder.feed converts a
+list of samples (one tuple per sample, one entry per feed var) into
+LoDTensors. Here the target is dense numpy arrays (the executor device-puts
+them); ragged sequence data should be pre-padded or fed with segment ids
+(SURVEY §5.7: LoD is subsumed by padding + segment-ids on TPU).
+"""
+
+import numpy as np
+
+from paddle_tpu.core.dtypes import to_numpy_dtype
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = ["DataFeeder", "convert_sample"]
+
+
+def convert_sample(value, dtype):
+    arr = np.asarray(value, dtype=to_numpy_dtype(dtype))
+    return arr
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        from paddle_tpu.core.ir import Variable, default_main_program
+
+        program = program or default_main_program()
+        self.feed_names = []
+        self.feed_dtypes = []
+        self.feed_shapes = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            enforce(isinstance(v, Variable), f"feed_list entry {v!r} invalid")
+            self.feed_names.append(v.name)
+            self.feed_dtypes.append(v.dtype)
+            self.feed_shapes.append(v.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples; each sample is a tuple/list with one
+        entry per feed var. Returns {name: batched ndarray}."""
+        columns = [[] for _ in self.feed_names]
+        for sample in iterable:
+            enforce(
+                len(sample) == len(self.feed_names),
+                f"sample has {len(sample)} fields, expected "
+                f"{len(self.feed_names)} ({self.feed_names})",
+            )
+            for c, v in zip(columns, sample):
+                c.append(v)
+        out = {}
+        for name, dtype, shape, col in zip(
+            self.feed_names, self.feed_dtypes, self.feed_shapes, columns
+        ):
+            arr = np.stack([convert_sample(v, dtype) for v in col])
+            # reshape flat samples to the declared trailing shape if needed
+            if shape is not None:
+                trailing = [d for d in shape[1:]]
+                if all(isinstance(d, int) and d > 0 for d in trailing):
+                    want = int(np.prod(trailing)) if trailing else 1
+                    got = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+                    if got == want and list(arr.shape[1:]) != trailing:
+                        arr = arr.reshape([arr.shape[0]] + trailing)
+            out[name] = arr
+        return out

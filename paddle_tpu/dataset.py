@@ -1,0 +1,310 @@
+"""Datasets: high-throughput file-based training input.
+
+Reference: python/paddle/fluid/dataset.py — DatasetFactory :22,
+InMemoryDataset :292 (load_into_memory + local/global shuffle),
+QueueDataset :672 (streaming); backed by the C++ data-feed layer
+(reference: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed,
+data_set.cc DatasetImpl). Here the native backend is csrc/datafeed —
+threaded MultiSlot parsing, shuffle, and padded batch assembly in C++ —
+bound via ctypes with a pure-Python fallback. Variable-length slots come
+back as padded [B, maxlen] arrays plus a `<name>.lens` int64 vector
+(TPU-friendly padding + lengths instead of LoD, SURVEY §5.7).
+"""
+
+import ctypes
+
+import numpy as np
+
+from paddle_tpu.utils.enforce import enforce
+from paddle_tpu.utils.native import NativeBuildError, load_native
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetFactory:
+    """reference: dataset.py:22."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")
+
+
+class _SlotSpec:
+    def __init__(self, name, dtype, length):
+        self.name = name
+        self.dtype = dtype  # "float32" | "int64"
+        self.length = length  # >0 fixed, -1 variable
+
+
+class _PyFeed:
+    """Pure-Python fallback backend mirroring the native C ABI semantics."""
+
+    def __init__(self, slots):
+        self.slots = slots
+        self.records = []
+        self.order = None
+        self._cursor = 0
+        self._batch = []
+
+    def load_buffer(self, text):
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            toks = line.split()
+            pos = 0
+            rec = []
+            for s in self.slots:
+                cnt = int(toks[pos]); pos += 1
+                vals = toks[pos:pos + cnt]; pos += cnt
+                conv = float if s.dtype == "float32" else int
+                rec.append([conv(v) for v in vals])
+            self.records.append(rec)
+
+    def load_files(self, paths, nthreads):
+        for p in paths:
+            with open(p) as f:
+                self.load_buffer(f.read())
+
+    def size(self):
+        return len(self.records)
+
+    def shuffle(self, seed):
+        rng = np.random.RandomState(seed)
+        self.order = np.arange(len(self.records))
+        rng.shuffle(self.order)
+
+    def begin_pass(self, batch_size, drop_last):
+        if self.order is None or len(self.order) != len(self.records):
+            self.order = np.arange(len(self.records))
+        self._cursor = 0
+        self._bs = batch_size
+        self._drop = drop_last
+
+    def next_batch(self):
+        rem = len(self.records) - self._cursor
+        take = min(self._bs, rem)
+        if take == 0 or (self._drop and take < self._bs):
+            return 0
+        self._batch = self.order[self._cursor:self._cursor + take]
+        self._cursor += take
+        return take
+
+    def batch_arrays(self, slot_idx):
+        s = self.slots[slot_idx]
+        rows = [self.records[r][slot_idx] for r in self._batch]
+        lens = np.array([len(r) for r in rows], dtype=np.int64)
+        maxlen = s.length if s.length > 0 else max((len(r) for r in rows), default=0)
+        dt = np.float32 if s.dtype == "float32" else np.int64
+        out = np.zeros((len(rows), max(maxlen, 1)), dtype=dt)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r[:maxlen] if maxlen else r
+        return out, lens
+
+
+class _NativeFeed:
+    """ctypes binding over csrc/datafeed (threaded C++ parse/shuffle/batch)."""
+
+    def __init__(self, slots):
+        self.slots = slots
+        self.lib = load_native("datafeed")
+        lib = self.lib
+        lib.paddle_ds_create.restype = ctypes.c_void_p
+        lib.paddle_ds_create.argtypes = [ctypes.c_char_p]
+        lib.paddle_ds_error.restype = ctypes.c_char_p
+        lib.paddle_ds_error.argtypes = [ctypes.c_void_p]
+        lib.paddle_ds_load_files.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.paddle_ds_load_buffer.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.paddle_ds_size.restype = ctypes.c_long
+        lib.paddle_ds_size.argtypes = [ctypes.c_void_p]
+        lib.paddle_ds_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint]
+        lib.paddle_ds_begin_pass.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.paddle_ds_next_batch.restype = ctypes.c_int
+        lib.paddle_ds_next_batch.argtypes = [ctypes.c_void_p]
+        lib.paddle_ds_batch_maxlen.restype = ctypes.c_int
+        lib.paddle_ds_batch_maxlen.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.paddle_ds_batch_copy.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ]
+        lib.paddle_ds_destroy.argtypes = [ctypes.c_void_p]
+        spec = ",".join(
+            f"{s.name}:{'f' if s.dtype == 'float32' else 'i'}:{s.length}"
+            for s in slots
+        )
+        self.h = lib.paddle_ds_create(spec.encode())
+        enforce(self.h, f"bad slot spec {spec}")
+        self._cur_bs = 0
+
+    def _check(self, rc):
+        if rc != 0:
+            raise RuntimeError(self.lib.paddle_ds_error(self.h).decode())
+
+    def load_buffer(self, text):
+        data = text.encode()
+        self._check(self.lib.paddle_ds_load_buffer(self.h, data, len(data)))
+
+    def load_files(self, paths, nthreads):
+        arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._check(
+            self.lib.paddle_ds_load_files(self.h, arr, len(paths), nthreads)
+        )
+
+    def size(self):
+        return self.lib.paddle_ds_size(self.h)
+
+    def shuffle(self, seed):
+        self.lib.paddle_ds_shuffle(self.h, seed & 0xFFFFFFFF)
+
+    def begin_pass(self, batch_size, drop_last):
+        self.lib.paddle_ds_begin_pass(self.h, batch_size, int(drop_last))
+
+    def next_batch(self):
+        self._cur_bs = self.lib.paddle_ds_next_batch(self.h)
+        return self._cur_bs
+
+    def batch_arrays(self, slot_idx):
+        s = self.slots[slot_idx]
+        maxlen = (
+            s.length
+            if s.length > 0
+            else max(self.lib.paddle_ds_batch_maxlen(self.h, slot_idx), 1)
+        )
+        dt = np.float32 if s.dtype == "float32" else np.int64
+        out = np.zeros((self._cur_bs, maxlen), dtype=dt)
+        lens = np.zeros(self._cur_bs, dtype=np.int64)
+        self.lib.paddle_ds_batch_copy(
+            self.h, slot_idx,
+            out.ctypes.data_as(ctypes.c_void_p),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            maxlen,
+        )
+        return out, lens
+
+    def __del__(self):
+        try:
+            self.lib.paddle_ds_destroy(self.h)
+        except Exception:
+            pass
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._slots = []
+        self._feed = None
+        self._use_native = True
+        self._drop_last = False
+        self._emit_lengths = False
+        self._loaded = False
+
+    # -- configuration (reference: dataset.py DatasetBase) -----------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+        self._loaded = False
+
+    def set_use_var(self, var_list):
+        """Declare the feed vars, in slot order. Variable-length slots are
+        vars whose non-batch shape is unknown (any -1 beyond dim 0)."""
+        self._slots = []
+        for v in var_list:
+            dtype = "int64" if "int" in str(v.dtype) else "float32"
+            trailing = list(v.shape[1:]) if v.shape else []
+            if trailing and all(isinstance(d, int) and d > 0 for d in trailing):
+                length = int(np.prod(trailing))
+            else:
+                length = -1
+            self._slots.append(_SlotSpec(v.name, dtype, length))
+
+    def set_emit_lengths(self, emit=True):
+        """Also yield `<name>.lens` int64 arrays for variable-length slots."""
+        self._emit_lengths = emit
+
+    def _make_feed(self):
+        if self._feed is not None:
+            return self._feed
+        enforce(self._slots, "call set_use_var before loading data")
+        if self._use_native:
+            try:
+                self._feed = _NativeFeed(self._slots)
+            except NativeBuildError:
+                self._feed = _PyFeed(self._slots)
+        else:
+            self._feed = _PyFeed(self._slots)
+        return self._feed
+
+    def _load(self):
+        feed = self._make_feed()
+        if self._filelist and not self._loaded:
+            feed.load_files(self._filelist, self._thread_num)
+            self._loaded = True
+
+    # -- iteration ---------------------------------------------------------
+    def _iter_batches(self, drop_last=None):
+        self._load()
+        feed = self._feed
+        drop = self._drop_last if drop_last is None else drop_last
+        feed.begin_pass(self._batch_size, drop)
+        while feed.next_batch() > 0:
+            out = {}
+            for i, s in enumerate(self._slots):
+                arr, lens = feed.batch_arrays(i)
+                out[s.name] = arr
+                if self._emit_lengths and s.length < 0:
+                    out[s.name + ".lens"] = lens
+            yield out
+
+    def get_memory_data_size(self):
+        return self._feed.size() if self._feed else 0
+
+
+class InMemoryDataset(DatasetBase):
+    """reference: dataset.py:292."""
+
+    def load_into_memory(self):
+        self._load()
+
+    def local_shuffle(self, seed=0):
+        enforce(self._feed is not None, "load_into_memory first")
+        self._feed.shuffle(seed)
+
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
+        """Single-host: equivalent to local_shuffle. Multi-host SPMD jobs
+        shard the *file list* per worker up front (each JAX process reads a
+        disjoint shard), so a cross-host record exchange — the reference's
+        PS-RPC global shuffle (reference: paddle/fluid/framework/
+        data_set.cc GlobalShuffle) — is unnecessary; per-shard shuffle plus
+        per-epoch file-list reshuffle gives the same mixing."""
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._feed = None
+        self._loaded = False
+
+
+class QueueDataset(DatasetBase):
+    """Streaming flavor (reference: dataset.py:672). Batches stream out of
+    the native store pass-by-pass without shuffling."""
+
+    def local_shuffle(self, seed=0):
+        raise RuntimeError("QueueDataset does not support shuffle")
+
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
+        raise RuntimeError("QueueDataset does not support shuffle")

@@ -62,7 +62,7 @@ class CollectiveOptimizer(DistributedOptimizer):
     ):
         strategy = self._strategy
         opt = self._optimizer
-        if strategy.recompute:
+        if strategy.recompute or strategy.forward_recompute:
             from paddle_tpu.optimizer import RecomputeOptimizer
 
             opt = RecomputeOptimizer(opt)

@@ -1,0 +1,32 @@
+"""Reader stack: decorators + device-prefetching DataLoader.
+
+Reference: python/paddle/reader/ (decorators) and
+python/paddle/fluid/reader.py (DataLoader/PyReader).
+"""
+
+from paddle_tpu.reader.dataloader import DataLoader, PyReader
+from paddle_tpu.reader.decorator import (
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+
+__all__ = [
+    "DataLoader",
+    "PyReader",
+    "batch",
+    "buffered",
+    "cache",
+    "chain",
+    "compose",
+    "firstn",
+    "map_readers",
+    "shuffle",
+    "xmap_readers",
+]

@@ -1,0 +1,177 @@
+"""DataLoader: background-prefetching iterator feeding device memory.
+
+Reference: python/paddle/fluid/reader.py — DataLoader.from_generator :168
+backed by a C++ blocking queue (reader/lod_tensor_blocking_queue.h) with
+double-buffer prefetch to GPU (reader/buffered_reader.cc). TPU-native
+equivalent: a bounded host queue drained by the training loop, with each
+batch asynchronously `jax.device_put` ahead of use — device transfer overlaps
+the current step's compute (XLA dispatch is async), which is the
+double-buffer effect without explicit CUDA streams.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+import jax
+
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = ["DataLoader", "PyReader"]
+
+_END = object()
+
+
+class _GeneratorLoader:
+    def __init__(self, feed_list, capacity, return_list):
+        self._feed_list = feed_list
+        self._capacity = capacity
+        self._return_list = return_list
+        self._reader = None
+        self._places = None
+        self._feeder = None
+        self._batch_reader = None
+
+    # -- configuration (reference: reader.py set_sample_generator etc.) ----
+    def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
+        from paddle_tpu.reader import decorator
+
+        self.set_sample_list_generator(
+            decorator.batch(reader, batch_size, drop_last=drop_last), places
+        )
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        feeder = DataFeeder(self._feed_list)
+
+        def batch_reader():
+            for samples in reader():
+                yield feeder.feed(samples)
+
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        names = [
+            v if isinstance(v, str) else v.name for v in self._feed_list
+        ]
+
+        def batch_reader():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield dict(zip(names, batch))
+
+        self._batch_reader = batch_reader
+        self._places = places
+        return self
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        enforce(self._batch_reader is not None, "no generator set on DataLoader")
+        q = queue.Queue(maxsize=self._capacity)
+        err = []
+        stop = threading.Event()
+
+        def _put(item):
+            # bounded put that aborts when the consumer abandoned iteration —
+            # otherwise the producer blocks forever holding `capacity`
+            # device-resident batches
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for feed in self._batch_reader():
+                    # async H2D: device transfer of batch N overlaps step N-1
+                    dev = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+                    if not _put(dev):
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                _put(_END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():  # unblock producer, drop device buffers
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(
+        feed_list=None,
+        capacity=16,
+        use_double_buffer=True,
+        iterable=True,
+        return_list=False,
+        use_multiprocess=False,
+    ):
+        """Reference: python/paddle/fluid/reader.py:168. use_double_buffer /
+        use_multiprocess are accepted for parity: prefetch is always on (the
+        producer thread device-puts ahead), and multiprocessing is
+        unnecessary for numpy-producing readers under the GIL-releasing
+        device transfer."""
+        return _GeneratorLoader(feed_list or [], capacity, return_list)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Iterate a Dataset (paddle_tpu/dataset.py) as feed dicts."""
+
+        class _DatasetLoader:
+            def __iter__(self):
+                return dataset._iter_batches(drop_last=drop_last)
+
+        return _DatasetLoader()
+
+
+class PyReader(_GeneratorLoader):
+    """Non-iterable start/reset flavor (reference: reader.py:971 PyReader)."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list or [], capacity, return_list)
+        self._iter = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)
+
+    def start(self):
+        self._iter = iter(self)
+
+    def reset(self):
+        self._iter = None
+
+    def next(self):
+        enforce(self._iter is not None, "call start() first")
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = None
+            raise

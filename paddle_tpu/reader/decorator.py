@@ -1,0 +1,225 @@
+"""Reader decorators: composable generator transforms.
+
+Reference: python/paddle/reader/decorator.py — shuffle, batch (creator),
+buffered (background thread), cache, chain, compose, map_readers,
+xmap_readers (parallel map), firstn. A "reader" is a zero-arg callable
+returning an iterator of samples; decorators wrap readers into new readers.
+These are host-side and framework-agnostic, so the design carries over
+unchanged — the TPU-specific work (device prefetch) lives in
+paddle_tpu/reader/dataloader.py.
+"""
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = [
+    "cache",
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "firstn",
+    "xmap_readers",
+    "batch",
+]
+
+
+def cache(reader):
+    """Materialize the reader once; replay from memory afterwards."""
+    all_data = []
+    filled = []
+
+    def cached_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Sample-wise map over zipped readers."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Pool-based shuffling (reference: decorator.py shuffle)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples; check_alignment verifies equal
+    lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise RuntimeError("readers have different lengths")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer with a background thread + bounded queue
+    (reference: decorator.py buffered)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def produce():
+            try:
+                for d in r:
+                    q.put(d)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                if err:
+                    raise err[0]
+                return
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        return itertools.islice(reader(), n)
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map with worker threads (reference: decorator.py
+    xmap_readers). order=True preserves input order."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        err = []
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, d = item
+                try:
+                    out_q.put((i, mapper(d)))
+                except BaseException as e:
+                    err.append(e)
+                    out_q.put(_End)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending, want = {}, 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, d = item
+                pending[i] = d
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+        if err:
+            raise err[0]
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference:
+    python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for d in reader():
+            b.append(d)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

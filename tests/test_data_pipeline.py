@@ -1,0 +1,210 @@
+"""Data pipeline tests: reader decorators, DataFeeder, DataLoader, and the
+native C++ dataset backend (reference patterns: python/paddle/reader/tests,
+python/paddle/fluid/tests/unittests/test_dataset.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.dataset import DatasetFactory, _NativeFeed, _PyFeed, _SlotSpec
+from paddle_tpu.reader import decorator as dec
+
+
+# ---------------------------------------------------------------------------
+# decorators
+# ---------------------------------------------------------------------------
+
+
+def _counter_reader(n):
+    def reader():
+        yield from range(n)
+
+    return reader
+
+
+def test_decorator_batch_and_shuffle():
+    r = dec.batch(_counter_reader(10), 3)
+    batches = list(r())
+    assert [len(b) for b in batches] == [3, 3, 3, 1]
+    r = dec.batch(_counter_reader(10), 3, drop_last=True)
+    assert [len(b) for b in r()] == [3, 3, 3]
+    r = dec.shuffle(_counter_reader(20), buf_size=50)
+    assert sorted(r()) == list(range(20))
+
+
+def test_decorator_compose_chain_cache_firstn():
+    c = dec.compose(_counter_reader(3), _counter_reader(3))
+    assert list(c()) == [(0, 0), (1, 1), (2, 2)]
+    ch = dec.chain(_counter_reader(2), _counter_reader(2))
+    assert list(ch()) == [0, 1, 0, 1]
+    cached = dec.cache(_counter_reader(4))
+    assert list(cached()) == list(cached())
+    assert list(dec.firstn(_counter_reader(100), 5)()) == [0, 1, 2, 3, 4]
+
+
+def test_decorator_buffered_and_xmap():
+    buf = dec.buffered(_counter_reader(50), size=4)
+    assert list(buf()) == list(range(50))
+    xm = dec.xmap_readers(lambda x: x * 2, _counter_reader(30), 4, 8, order=True)
+    assert list(xm()) == [2 * i for i in range(30)]
+    xm2 = dec.xmap_readers(lambda x: x * 2, _counter_reader(30), 4, 8)
+    assert sorted(xm2()) == [2 * i for i in range(30)]
+
+
+def test_xmap_propagates_errors():
+    def bad(x):
+        raise ValueError("boom")
+
+    xm = dec.xmap_readers(bad, _counter_reader(3), 2, 4)
+    with pytest.raises(ValueError):
+        list(xm())
+
+
+# ---------------------------------------------------------------------------
+# DataFeeder + DataLoader
+# ---------------------------------------------------------------------------
+
+
+def test_data_feeder_shapes():
+    main = Program()
+    with program_guard(main, Program()):
+        img = fluid.data("img", shape=[-1, 2, 2])
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
+        feeder = fluid.DataFeeder([img, label])
+    feed = feeder.feed([(np.ones(4), 3), (np.zeros(4), 1)])
+    assert feed["img"].shape == (2, 2, 2)
+    assert feed["img"].dtype == np.float32
+    assert feed["label"].shape == (2, 1)
+    assert feed["label"].dtype == np.int64
+
+
+def test_dataloader_trains(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        loader = fluid.DataLoader.from_generator(feed_list=[x, y], capacity=4)
+
+    def sample_gen():
+        for i in range(64):
+            xv = rng.rand(4).astype("float32")
+            yield xv, np.array([xv.sum()], dtype="float32")
+
+    loader.set_sample_generator(sample_gen, batch_size=16)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for epoch in range(8):
+        for feed in loader:
+            out = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(out[0][0]))
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# native dataset backend
+# ---------------------------------------------------------------------------
+
+MULTISLOT = """2 11 12 1 0.5 3 7 8 9
+2 21 22 1 1.5 1 4
+2 31 32 1 2.5 2 5 6
+"""
+SLOTS = [
+    _SlotSpec("ids", "int64", 2),
+    _SlotSpec("w", "float32", 1),
+    _SlotSpec("seq", "int64", -1),
+]
+
+
+@pytest.mark.parametrize("feed_cls", [_NativeFeed, _PyFeed])
+def test_feed_backends_parse_and_batch(feed_cls):
+    feed = feed_cls(SLOTS)
+    feed.load_buffer(MULTISLOT)
+    assert feed.size() == 3
+    feed.begin_pass(2, False)
+    assert feed.next_batch() == 2
+    ids, _ = feed.batch_arrays(0)
+    np.testing.assert_array_equal(ids, [[11, 12], [21, 22]])
+    w, _ = feed.batch_arrays(1)
+    np.testing.assert_allclose(w, [[0.5], [1.5]])
+    seq, lens = feed.batch_arrays(2)
+    np.testing.assert_array_equal(lens, [3, 1])
+    np.testing.assert_array_equal(seq, [[7, 8, 9], [4, 0, 0]])
+    assert feed.next_batch() == 1
+    assert feed.next_batch() == 0
+
+
+def test_native_matches_python_on_files(tmp_path, rng):
+    """Backend parity: the C++ parser/batcher must agree with the Python
+    fallback on multi-file input."""
+    paths = []
+    for f in range(3):
+        lines = []
+        for i in range(17):
+            n = rng.randint(1, 5)
+            vals = " ".join(str(rng.randint(0, 100)) for _ in range(n))
+            lines.append(f"2 {f} {i} 1 {rng.rand():.4f} {n} {vals}")
+        p = tmp_path / f"part-{f}.txt"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+
+    outs = []
+    for cls in (_NativeFeed, _PyFeed):
+        feed = cls(SLOTS)
+        feed.load_files(paths, nthreads=3)
+        feed.begin_pass(8, False)
+        got = []
+        while feed.next_batch() > 0:
+            got.append([feed.batch_arrays(i) for i in range(len(SLOTS))])
+        outs.append(got)
+    assert len(outs[0]) == len(outs[1])
+    for b0, b1 in zip(*outs):
+        for (a0, l0), (a1, l1) in zip(b0, b1):
+            np.testing.assert_array_equal(a0, a1)
+            np.testing.assert_array_equal(l0, l1)
+
+
+def test_inmemory_dataset_end_to_end(tmp_path, rng):
+    """InMemoryDataset + train_from_dataset (reference:
+    test_dataset.py + executor train_from_dataset)."""
+    lines = []
+    for i in range(64):
+        x = rng.rand(4)
+        y = x.sum()
+        lines.append(
+            "4 " + " ".join(f"{v:.5f}" for v in x) + f" 1 {y:.5f}"
+        )
+    p = tmp_path / "data.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_thread(2)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 64
+    ds.local_shuffle(seed=1)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    first = exe.run(main, feed=next(ds._iter_batches()), fetch_list=[loss])
+    for _ in range(10):
+        out = exe.train_from_dataset(
+            main, ds, fetch_list=[loss], print_period=10**9
+        )
+    assert float(out[0][0]) < float(first[0][0])
