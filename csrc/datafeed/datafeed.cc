@@ -223,13 +223,15 @@ int paddle_ds_load_files(void* h, const char** paths, int nfiles,
     });
   }
   for (auto& t : threads) t.join();
+  // validate every shard BEFORE merging any: a partial merge would leave
+  // duplicate records behind a failed-then-retried load
   for (int i = 0; i < nfiles; ++i) {
     if (!shards[i].error.empty()) {
       ds->error = shards[i].error;
       return -1;
     }
-    merge_shard(ds, std::move(shards[i]));
   }
+  for (int i = 0; i < nfiles; ++i) merge_shard(ds, std::move(shards[i]));
   return 0;
 }
 

@@ -72,8 +72,11 @@ class _PyFeed:
         return len(self.records)
 
     def shuffle(self, seed):
+        # compose onto the existing permutation (matches the native backend:
+        # repeated per-epoch shuffles keep mixing rather than resetting)
         rng = np.random.RandomState(seed)
-        self.order = np.arange(len(self.records))
+        if self.order is None or len(self.order) != len(self.records):
+            self.order = np.arange(len(self.records))
         rng.shuffle(self.order)
 
     def begin_pass(self, batch_size, drop_last):
@@ -208,6 +211,7 @@ class DatasetBase:
         self._drop_last = False
         self._emit_lengths = False
         self._loaded = False
+        self._pad_to = {}
 
     # -- configuration (reference: dataset.py DatasetBase) -----------------
     def set_batch_size(self, batch_size):
@@ -236,6 +240,13 @@ class DatasetBase:
     def set_emit_lengths(self, emit=True):
         """Also yield `<name>.lens` int64 arrays for variable-length slots."""
         self._emit_lengths = emit
+
+    def set_pad_to(self, pad_lengths):
+        """Fixed pad length per variable-length slot: {slot_name: L}. Without
+        this, var-len slots pad to the next power of two above the batch max
+        (shape bucketing) — otherwise every distinct batch max-length would
+        recompile the XLA step (the cache keys on feed shapes)."""
+        self._pad_to.update(pad_lengths)
 
     def _make_feed(self):
         if self._feed is not None:
@@ -266,9 +277,18 @@ class DatasetBase:
             out = {}
             for i, s in enumerate(self._slots):
                 arr, lens = feed.batch_arrays(i)
+                if s.length < 0:
+                    want = self._pad_to.get(s.name)
+                    if want is None:
+                        # bucket to next pow2 so step shapes stabilize
+                        want = 1 << max(int(np.ceil(np.log2(arr.shape[1]))), 0)
+                    if arr.shape[1] < want:
+                        arr = np.pad(arr, [(0, 0), (0, want - arr.shape[1])])
+                    elif arr.shape[1] > want:
+                        arr = arr[:, :want]
                 out[s.name] = arr
                 if self._emit_lengths and s.length < 0:
-                    out[s.name + ".lens"] = lens
+                    out[s.name + ".lens"] = np.minimum(lens, arr.shape[1])
             yield out
 
     def get_memory_data_size(self):

@@ -1,0 +1,211 @@
+"""Parameter-server fleet mode: billion-feature sparse training.
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/ — the pslib
+flow (DownpourWorker, device_worker.h:203): per batch, workers pull the
+batch's sparse rows from servers, run fwd/bwd locally, and push sparse grads
+back; dense parameters stay worker-side. The TPU translation: dense params
+live on-device inside the jit step (better than PS round-trips), sparse
+tables live on native PS servers (csrc/ps), and the worker's pull -> step ->
+push pipeline is host code around the compiled step (PSWorker.run). The
+trainer program needs NO transpilation — sparse_embedding already emitted
+the rows/idx feed structure (layers/nn.py sparse_embedding).
+
+Usage:
+    from paddle_tpu.fleet import parameter_server as psfleet
+    fleet = psfleet.fleet
+    fleet.init(role_maker)
+    if fleet.is_server():
+        fleet.init_server(); fleet.run_server()
+    else:
+        opt = fleet.distributed_optimizer(optimizer, strategy)
+        opt.minimize(loss)
+        fleet.init_worker()
+        worker = fleet.worker(exe)
+        for batch: worker.run(program, feed, fetch_list)
+        fleet.stop_worker()
+"""
+
+import os
+import time
+
+import numpy as np
+
+from paddle_tpu.core.backward import append_backward
+from paddle_tpu.core.ir import default_startup_program
+from paddle_tpu.fleet.base import DistributedOptimizer, Fleet
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = ["fleet", "PSDistributedStrategy", "ParameterServerOptimizer", "PSWorker"]
+
+_OPT_CODES = {"sgd": 0, "adagrad": 1}
+
+
+class PSDistributedStrategy:
+    """reference: incubate/fleet/parameter_server/distribute_transpiler/
+    distributed_strategy.py (Sync/Async/Geo). geo_sgd is accepted but maps
+    to async (delta-sync staleness is subsumed by merge_steps batching)."""
+
+    def __init__(self, mode="sync", sparse_lr=0.1, merge_steps=4):
+        enforce(mode in ("sync", "async", "half_async", "geo"), f"bad mode {mode}")
+        self.mode = mode
+        self.sparse_lr = sparse_lr
+        self.merge_steps = merge_steps
+
+
+class ParameterServerOptimizer(DistributedOptimizer):
+    """minimize() = normal dense minimize + grad seeds for every sparse
+    table's pulled-rows var (so rows@GRAD exists for the worker to fetch)."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or PSDistributedStrategy())
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        tables = getattr(program, "_sparse_tables", {})
+        rows_names = [t["rows"] for t in tables.values()]
+        opt = self._optimizer
+        opt.helper = LayerHelper(opt.__class__.__name__)
+        opt._create_global_learning_rate()
+        params_grads = append_backward(
+            loss, parameter_list, no_grad_set, extra_seeds=rows_names
+        )
+        optimize_ops = opt.apply_gradients(params_grads)
+        fleet._origin_program = program
+        fleet._main_program = program
+        fleet._startup_program = startup_program or default_startup_program()
+        fleet._strategy = self._strategy
+        return optimize_ops, params_grads
+
+
+class PSWorker:
+    """Per-process worker driver: pull -> compiled step -> push.
+
+    The reference runs this loop thread-per-core in C++ DeviceWorkers
+    (reference: paddle/fluid/framework/device_worker.h:203 DownpourWorker,
+    hogwild_worker.cc:237); here one loop feeds the whole chip because the
+    step itself is a single XLA computation — overlap comes from the async
+    Communicator and the DataLoader's prefetch thread."""
+
+    def __init__(self, exe, client, tables, strategy):
+        from paddle_tpu.distributed.ps import Communicator
+
+        self._exe = exe
+        self._client = client
+        self._tables = tables
+        self._strategy = strategy
+        mode = "sync" if strategy.mode == "sync" else "async"
+        self._comm = Communicator(
+            client, mode=mode, merge_steps=strategy.merge_steps
+        )
+
+    def run(self, program, feed, fetch_list=None, scope=None):
+        fetch_list = list(fetch_list or [])
+        feed = dict(feed)
+        pulled = {}  # table name -> (uniq_ids,)
+        for tname, t in self._tables.items():
+            ids = np.asarray(feed[t["ids"]])
+            uniq, inv = np.unique(ids.astype(np.uint64), return_inverse=True)
+            rows = self._client.pull_sparse(t["table_id"], uniq, t["dim"])
+            feed[t["rows"]] = rows
+            feed[t["idx"]] = inv.astype(np.int32).reshape(ids.shape)
+            pulled[tname] = uniq
+        grad_fetches = [t["rows"] + "@GRAD" for t in self._tables.values()]
+        out = self._exe.run(
+            program, feed=feed, fetch_list=fetch_list + grad_fetches,
+            scope=scope,
+        )
+        n_user = len(fetch_list)
+        for (tname, t), g in zip(self._tables.items(), out[n_user:]):
+            self._comm.push_sparse(
+                t["table_id"], pulled[tname], np.asarray(g),
+                self._strategy.sparse_lr,
+            )
+        return out[:n_user]
+
+    def flush(self):
+        self._comm.flush()
+
+    def stop(self):
+        self._comm.stop()
+
+
+class _PSFleet(Fleet):
+    def __init__(self):
+        super().__init__()
+        self._server = None
+        self._client = None
+        self._worker_obj = None
+        self._strategy = None
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = ParameterServerOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    # -- server side -------------------------------------------------------
+    def init_server(self, model_dir=None, port=None):
+        from paddle_tpu.distributed.ps import PSServer
+
+        if port is None:
+            eps = self.server_endpoints()
+            me = self.server_index()
+            port = int(eps[me].rsplit(":", 1)[1]) if eps and me >= 0 else 0
+        self._server = PSServer(port)
+        return self._server
+
+    def run_server(self):
+        enforce(self._server is not None, "init_server first")
+        while True:
+            time.sleep(1)
+
+    # -- worker side -------------------------------------------------------
+    def init_worker(self, program=None):
+        from paddle_tpu.distributed.ps import PSClient
+
+        program = program or self._origin_program
+        eps = self.server_endpoints()
+        if not eps and self._server is not None:
+            eps = [self._server.endpoint]  # single-process test mode
+        enforce(eps, "no server endpoints (set PADDLE_PSERVERS_IP_PORT_LIST)")
+        self._client = PSClient(eps)
+        tables = getattr(program, "_sparse_tables", {})
+        if self.worker_index() <= 0:
+            for t in tables.values():
+                self._client.create_table(
+                    t["table_id"],
+                    dim=t["dim"],
+                    init_range=t["init_range"],
+                    optimizer=_OPT_CODES.get(t["optimizer"], 0),
+                )
+        if self.worker_num() > 1:
+            self._client.barrier(self.worker_num())
+
+    def worker(self, exe, program=None):
+        program = program or self._origin_program
+        tables = getattr(program, "_sparse_tables", {})
+        self._worker_obj = PSWorker(
+            exe, self._client, tables, self._strategy or PSDistributedStrategy()
+        )
+        return self._worker_obj
+
+    def stop_worker(self):
+        if self._worker_obj is not None:
+            self._worker_obj.stop()
+        if self._client is not None:
+            self._client.close()
+
+    # -- persistence -------------------------------------------------------
+    def save_sparse_tables(self, dirname):
+        tables = getattr(self._origin_program, "_sparse_tables", {})
+        os.makedirs(dirname, exist_ok=True)
+        for tname, t in tables.items():
+            self._client.save(t["table_id"], os.path.join(dirname, tname + ".tbl"))
+
+    def load_sparse_tables(self, dirname):
+        tables = getattr(self._origin_program, "_sparse_tables", {})
+        for tname, t in tables.items():
+            self._client.load(t["table_id"], os.path.join(dirname, tname + ".tbl"))
+
+
+fleet = _PSFleet()

@@ -8,6 +8,7 @@ computation happens at build time.
 
 from paddle_tpu.core.dtypes import convert_dtype
 from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils import unique_name
 from paddle_tpu.utils.enforce import enforce
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "instance_norm",
     "group_norm",
     "embedding",
+    "sparse_embedding",
     "dropout",
     "softmax",
     "log_softmax",
@@ -486,6 +488,63 @@ def embedding(
         {"Out": [out.name]},
         {"padding_idx": -1 if padding_idx is None else padding_idx},
     )
+    return out
+
+
+def sparse_embedding(
+    input,
+    embedding_dim,
+    table_id=None,
+    init_range=0.01,
+    optimizer="sgd",
+    name=None,
+):
+    """Parameter-server-backed embedding for billion-feature tables
+    (reference: distributed_lookup_table / prefetch flow —
+    paddle/fluid/operators/distributed/parameter_prefetch.cc; pslib pull in
+    fleet_wrapper.h:84). The table never materializes on device: per step
+    the PS worker pulls the batch's unique rows (fleet/parameter_server.py
+    PSWorker.run), feeds them as `<name>__rows`, and the graph gathers via
+    `<name>__idx`; row grads flow back through the gather vjp and are pushed
+    to the server. `input` must be an int feed var of ids (any shape)."""
+    from paddle_tpu.core.ir import default_main_program
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    helper = LayerHelper("sparse_embedding", name=name)
+    tname = name or unique_name.generate("sparse_emb")
+    program = default_main_program()
+    tables = getattr(program, "_sparse_tables", None)
+    if tables is None:
+        tables = program._sparse_tables = {}
+    if table_id is None:
+        used = {t["table_id"] for t in tables.values()}
+        table_id = max(used, default=0) + 1
+    rows = tensor_layers.data(
+        f"{tname}__rows", shape=[-1, embedding_dim],
+        dtype="float32", append_batch_size=False,
+    )
+    rows.stop_gradient = False  # leaf grad target (extra seed in backward)
+    idx_shape = [(-1 if d in (-1, None) else d) for d in (input.shape or [-1])]
+    idx = tensor_layers.data(
+        f"{tname}__idx", shape=idx_shape, dtype="int32",
+        append_batch_size=False,
+    )
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "lookup_table_ps",
+        {"Rows": [rows.name], "Idx": [idx.name]},
+        {"Out": [out.name]},
+        {"table_id": table_id},
+    )
+    tables[tname] = {
+        "table_id": table_id,
+        "ids": input.name,
+        "rows": rows.name,
+        "idx": idx.name,
+        "dim": embedding_dim,
+        "init_range": init_range,
+        "optimizer": optimizer,
+    }
     return out
 
 

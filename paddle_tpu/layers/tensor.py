@@ -14,6 +14,7 @@ __all__ = [
     "ones_like",
     "assign",
     "cast",
+    "sums",
     "concat",
     "split",
     "reshape",
@@ -129,6 +130,18 @@ def cast(x, dtype, name=None):
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(
         "cast", {"X": [x.name]}, {"Out": [out.name]}, {"out_dtype": dtype}
+    )
+    return out
+
+
+def sums(input, out=None, name=None):
+    """Elementwise sum of a list of tensors (reference: python/paddle/fluid/
+    layers/tensor.py sums -> sum op)."""
+    helper = LayerHelper("sum", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(
+        "sum", {"X": [v.name for v in input]}, {"Out": [out.name]}, {}
     )
     return out
 

@@ -1,1 +1,1 @@
-from paddle_tpu.models import mnist, resnet, bert
+from paddle_tpu.models import mnist, resnet, bert, ctr

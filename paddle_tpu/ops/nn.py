@@ -380,6 +380,19 @@ def _lookup_table_v1(ins, attrs):
     return _lookup_table({"W": [w], "Ids": [ids]}, attrs)
 
 
+@register_op("lookup_table_ps", nondiff_inputs=("Idx",))
+def _lookup_table_ps(ins, attrs):
+    """PS-backed embedding lookup: `Rows` are the batch's unique embedding
+    vectors pulled from the parameter server by the worker (host side,
+    fleet/parameter_server.py), `Idx` maps each id occurrence to its row.
+    The gather's vjp sums duplicate-id grads into per-row grads — exactly
+    the SelectedRows grad aggregation the reference does in
+    lookup_table_grad (reference: paddle/fluid/operators/lookup_table_op.h
+    LookupTableGradKernel) but expressed as dense XLA."""
+    rows, idx = first(ins, "Rows"), first(ins, "Idx")
+    return {"Out": [jnp.take(rows, idx, axis=0)]}
+
+
 @register_op("one_hot", nondiff_inputs=("X",))
 def _one_hot(ins, attrs):
     x = first(ins, "X")

@@ -103,6 +103,7 @@ class _GeneratorLoader:
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
+        names = [v if isinstance(v, str) else v.name for v in self._feed_list]
         try:
             while True:
                 item = q.get()
@@ -110,7 +111,10 @@ class _GeneratorLoader:
                     if err:
                         raise err[0]
                     return
-                yield item
+                if self._return_list:
+                    yield [item[n] for n in names]
+                else:
+                    yield item
         finally:
             stop.set()
             while not q.empty():  # unblock producer, drop device buffers

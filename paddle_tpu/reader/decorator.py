@@ -99,6 +99,18 @@ def compose(*readers, **kwargs):
     return reader
 
 
+def _abortable_put(q, item, stop):
+    """Bounded put that gives up when the consumer abandoned iteration, so
+    producer threads never block forever on a full queue."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 def buffered(reader, size):
     """Decouple producer/consumer with a background thread + bounded queue
     (reference: decorator.py buffered)."""
@@ -110,25 +122,30 @@ def buffered(reader, size):
         r = reader()
         q = queue.Queue(maxsize=size)
         err = []
+        stop = threading.Event()
 
         def produce():
             try:
                 for d in r:
-                    q.put(d)
+                    if not _abortable_put(q, d, stop):
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(_End)
+                _abortable_put(q, _End, stop)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                if err:
-                    raise err[0]
-                return
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    if err:
+                        raise err[0]
+                    return
+                yield e
+        finally:
+            stop.set()
 
     return data_reader
 
@@ -151,59 +168,69 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
         err = []
+        stop = threading.Event()
 
         def feed():
             try:
                 for i, d in enumerate(reader()):
-                    in_q.put((i, d))
+                    if not _abortable_put(in_q, (i, d), stop):
+                        return
             except BaseException as e:
                 err.append(e)
             finally:
                 for _ in range(process_num):
-                    in_q.put(_End)
+                    if not _abortable_put(in_q, _End, stop):
+                        return
 
         def work():
-            while True:
-                item = in_q.get()
+            while not stop.is_set():
+                try:
+                    item = in_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
                 if item is _End:
-                    out_q.put(_End)
+                    _abortable_put(out_q, _End, stop)
                     return
                 i, d = item
                 try:
-                    out_q.put((i, mapper(d)))
+                    if not _abortable_put(out_q, (i, mapper(d)), stop):
+                        return
                 except BaseException as e:
                     err.append(e)
-                    out_q.put(_End)
+                    _abortable_put(out_q, _End, stop)
                     return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
 
-        finished = 0
-        if order:
-            pending, want = {}, 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                i, d = item
-                pending[i] = d
-                while want in pending:
-                    yield pending.pop(want)
-                    want += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is _End:
-                    finished += 1
-                    continue
-                yield item[1]
-        if err:
-            raise err[0]
+        try:
+            finished = 0
+            if order:
+                pending, want = {}, 0
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is _End:
+                        finished += 1
+                        continue
+                    i, d = item
+                    pending[i] = d
+                    while want in pending:
+                        yield pending.pop(want)
+                        want += 1
+                for i in sorted(pending):
+                    yield pending[i]
+            else:
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is _End:
+                        finished += 1
+                        continue
+                    yield item[1]
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
 
     return data_reader
 
