@@ -26,6 +26,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -33,6 +34,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <shared_mutex>
@@ -81,13 +83,19 @@ struct Table {
 };
 
 struct Server {
+  ~Server() = default;
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> stopping{false};
   std::vector<std::thread> threads;
   std::thread accept_thread;
-  std::unordered_map<uint32_t, Table*> tables;
+  // shared_ptr: a re-created table must not be freed under a concurrent
+  // handler still using the old instance
+  std::unordered_map<uint32_t, std::shared_ptr<Table>> tables;
   std::shared_mutex tables_mu;
+  // open connection fds, so stop() can shutdown() blocked recv()s
+  std::mutex conns_mu;
+  std::vector<int> conn_fds;
   // barrier
   std::mutex barrier_mu;
   std::condition_variable barrier_cv;
@@ -96,10 +104,6 @@ struct Server {
   // heartbeat: worker id -> last seen (steady seconds)
   std::mutex hb_mu;
   std::unordered_map<uint32_t, double> last_seen;
-
-  ~Server() {
-    for (auto& kv : tables) delete kv.second;
-  }
 };
 
 double now_sec() {
@@ -178,6 +182,10 @@ void apply_update(std::vector<float>* w, std::vector<float>* g2,
 void handle_conn(Server* srv, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    srv->conn_fds.push_back(fd);
+  }
   std::string body;
   while (!srv->stopping.load()) {
     uint32_t len;
@@ -191,10 +199,13 @@ void handle_conn(Server* srv, int fd) {
 
     if (cmd == kStop) {
       send_response(fd, 0, "");
-      srv->stopping.store(true);
-      // wake barrier waiters so their connections unwind
+      {
+        // barrier_mu held across store+notify: otherwise a waiter that just
+        // evaluated its predicate can sleep through the notification forever
+        std::lock_guard<std::mutex> lk(srv->barrier_mu);
+        srv->stopping.store(true);
+      }
       srv->barrier_cv.notify_all();
-      // poke the accept loop
       break;
     }
 
@@ -204,7 +215,7 @@ void handle_conn(Server* srv, int fd) {
       uint64_t dense_size = read_pod<uint64_t>(p);
       float init_range = read_pod<float>(p);
       uint8_t opt = read_pod<uint8_t>(p);
-      auto* t = new Table();
+      auto t = std::make_shared<Table>();
       t->is_dense = is_dense;
       t->dim = dim;
       t->init_range = init_range;
@@ -215,15 +226,15 @@ void handle_conn(Server* srv, int fd) {
       }
       {
         std::unique_lock<std::shared_mutex> lk(srv->tables_mu);
-        auto it = srv->tables.find(table_id);
-        if (it != srv->tables.end()) delete it->second;
-        srv->tables[table_id] = t;
+        // replace: the shared_ptr keeps the old instance alive for any
+        // handler that already grabbed it
+        srv->tables[table_id] = std::move(t);
       }
       send_response(fd, 0, "");
       continue;
     }
 
-    Table* t = nullptr;
+    std::shared_ptr<Table> t;
     if (cmd != kBarrier && cmd != kHeartbeat && cmd != kStats) {
       std::shared_lock<std::shared_mutex> lk(srv->tables_mu);
       auto it = srv->tables.find(table_id);
@@ -239,16 +250,20 @@ void handle_conn(Server* srv, int fd) {
         uint64_t n = read_pod<uint64_t>(p);
         std::string out;
         out.reserve(n * t->dim * 4);
-        std::unique_lock<std::shared_mutex> lk(t->mu);  // may insert
-        for (uint64_t i = 0; i < n; ++i) {
-          uint64_t id = read_pod<uint64_t>(p);
-          auto it = t->rows.find(id);
-          if (it == t->rows.end()) {
-            it = t->rows.emplace(id, SparseRow()).first;
-            init_row(&it->second, *t, id);
+        {
+          // serialize under the lock, send AFTER releasing it — a slow
+          // client's socket must not stall every other worker's table access
+          std::unique_lock<std::shared_mutex> lk(t->mu);  // may insert
+          for (uint64_t i = 0; i < n; ++i) {
+            uint64_t id = read_pod<uint64_t>(p);
+            auto it = t->rows.find(id);
+            if (it == t->rows.end()) {
+              it = t->rows.emplace(id, SparseRow()).first;
+              init_row(&it->second, *t, id);
+            }
+            out.append(reinterpret_cast<const char*>(it->second.w.data()),
+                       t->dim * 4);
           }
-          out.append(reinterpret_cast<const char*>(it->second.w.data()),
-                     t->dim * 4);
         }
         send_response(fd, 0, out);
         break;
@@ -277,9 +292,12 @@ void handle_conn(Server* srv, int fd) {
         break;
       }
       case kPullDense: {
-        std::shared_lock<std::shared_mutex> lk(t->mu);
-        std::string out(reinterpret_cast<const char*>(t->dense.data()),
-                        t->dense.size() * 4);
+        std::string out;
+        {
+          std::shared_lock<std::shared_mutex> lk(t->mu);
+          out.assign(reinterpret_cast<const char*>(t->dense.data()),
+                     t->dense.size() * 4);
+        }
         send_response(fd, 0, out);
         break;
       }
@@ -306,18 +324,22 @@ void handle_conn(Server* srv, int fd) {
           send_response(fd, 1, "cannot open " + path);
           break;
         }
+        uint8_t has_g2 = t->opt == kAdagrad ? 1 : 0;
         fwrite(&t->is_dense, 1, 1, f);
+        fwrite(&has_g2, 1, 1, f);
         fwrite(&t->dim, 4, 1, f);
         if (t->is_dense) {
           uint64_t n = t->dense.size();
           fwrite(&n, 8, 1, f);
           fwrite(t->dense.data(), 4, n, f);
+          if (has_g2) fwrite(t->dense_g2.data(), 4, n, f);
         } else {
           uint64_t n = t->rows.size();
           fwrite(&n, 8, 1, f);
           for (auto& kv : t->rows) {
             fwrite(&kv.first, 8, 1, f);
             fwrite(kv.second.w.data(), 4, t->dim, f);
+            if (has_g2) fwrite(kv.second.g2.data(), 4, t->dim, f);
           }
         }
         fclose(f);
@@ -333,43 +355,51 @@ void handle_conn(Server* srv, int fd) {
           send_response(fd, 1, "cannot open " + path);
           break;
         }
-        uint8_t is_dense;
+        uint8_t is_dense, has_g2;
         uint32_t dim;
         uint64_t n;
-        if (fread(&is_dense, 1, 1, f) != 1 || fread(&dim, 4, 1, f) != 1 ||
-            fread(&n, 8, 1, f) != 1 || is_dense != t->is_dense ||
-            dim != t->dim) {
+        if (fread(&is_dense, 1, 1, f) != 1 || fread(&has_g2, 1, 1, f) != 1 ||
+            fread(&dim, 4, 1, f) != 1 || fread(&n, 8, 1, f) != 1 ||
+            is_dense != t->is_dense || dim != t->dim) {
           fclose(f);
           send_response(fd, 1, "checkpoint/table mismatch");
           break;
         }
+        bool ok = true;
         if (t->is_dense) {
           t->dense.resize(n);
-          if (fread(t->dense.data(), 4, n, f) != n) {
-            fclose(f);
-            send_response(fd, 1, "short read");
-            break;
+          ok = fread(t->dense.data(), 4, n, f) == n;
+          if (ok && has_g2) {
+            t->dense_g2.resize(n);
+            ok = fread(t->dense_g2.data(), 4, n, f) == n;
           }
         } else {
           t->rows.clear();
-          bool ok = true;
           for (uint64_t i = 0; i < n && ok; ++i) {
             uint64_t id;
             ok = fread(&id, 8, 1, f) == 1;
             if (!ok) break;
             SparseRow row;
             row.w.resize(dim);
-            if (t->opt == kAdagrad) row.g2.assign(dim, 0.f);
             ok = fread(row.w.data(), 4, dim, f) == dim;
+            if (ok && has_g2) {
+              row.g2.resize(dim);
+              ok = fread(row.g2.data(), 4, dim, f) == dim;
+            } else if (t->opt == kAdagrad) {
+              row.g2.assign(dim, 0.f);
+            }
+            // loaded rows start at the current generation — a shrink right
+            // after restore must NOT wipe the table
+            row.version = t->version + 1;
             t->rows.emplace(id, std::move(row));
           }
-          if (!ok) {
-            fclose(f);
-            send_response(fd, 1, "short read");
-            break;
-          }
+          if (ok) t->version++;
         }
         fclose(f);
+        if (!ok) {
+          send_response(fd, 1, "short read");
+          break;
+        }
         send_response(fd, 0, "");
         break;
       }
@@ -442,6 +472,13 @@ void handle_conn(Server* srv, int fd) {
         break;
     }
   }
+  {
+    // deregister BEFORE close: stop() must never shutdown() a recycled fd
+    // number belonging to an unrelated file in this process
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    auto& v = srv->conn_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+  }
   ::close(fd);
 }
 
@@ -492,10 +529,18 @@ int paddle_ps_port(void* h) { return static_cast<Server*>(h)->port; }
 
 void paddle_ps_stop(void* h) {
   auto* srv = static_cast<Server*>(h);
-  srv->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(srv->barrier_mu);
+    srv->stopping.store(true);
+  }
   srv->barrier_cv.notify_all();
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
+  {
+    // unblock connection threads parked in recv()
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
   for (auto& t : srv->threads)
     if (t.joinable()) t.join();

@@ -271,12 +271,22 @@ class Communicator:
             self._thread.start()
 
     def push_sparse(self, table_id, ids, grads, lr):
+        import queue as _q
+
         if self._mode == "sync":
             self._client.push_sparse(table_id, ids, grads, lr)
             return
-        if self._err:
-            raise self._err[0]
-        self._queue.put((table_id, np.asarray(ids), np.asarray(grads), lr))
+        item = (table_id, np.asarray(ids), np.asarray(grads), lr)
+        # bounded put that keeps checking for a dead background thread —
+        # blocking forever on a full queue would hide the PS failure
+        while True:
+            if self._err:
+                raise self._err[0]
+            try:
+                self._queue.put(item, timeout=0.2)
+                return
+            except _q.Full:
+                continue
 
     def _loop(self):
         import queue as _q

@@ -1,1 +1,1 @@
-from paddle_tpu.models import mnist, resnet, bert, ctr
+from paddle_tpu.models import mnist, resnet, bert, ctr, transformer

@@ -1,0 +1,550 @@
+"""Transformer encoder-decoder for WMT en-de — BASELINE.md config 4
+("Transformer-big WMT en-de, dynamic shapes + beam search infer").
+
+Training builds on the program IR like the reference's transformer example
+(reference analog: the fluid Transformer in its models repo driven through
+dist_transformer.py, python/paddle/fluid/tests/unittests/dist_transformer.py);
+decoding is where the designs diverge hard:
+
+* reference: beam search as LoD-manipulating graph ops inside a While op
+  (reference: paddle/fluid/operators/beam_search_op.cc,
+  beam_search_decode_op.cc — per-step host-visible LoD surgery).
+* here: a single jitted `lax.while_loop` with static [batch, beam, max_len]
+  state and per-layer KV caches — dense shapes, no LoD, the whole decode is
+  ONE XLA computation (SURVEY §5.7: LoD subsumed by padding; §7 hard parts:
+  beam search needs bucketing + static shapes up front).
+
+Weight sharing between the IR training program and the functional decoder is
+by parameter NAME: build_wmt_train names every parameter, and
+`params_from_scope` pulls the trained values for the decode function.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import paddle_tpu as fluid
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = [
+    "TransformerConfig",
+    "build_wmt_train",
+    "params_from_scope",
+    "make_beam_decoder",
+    "synthetic_batch",
+]
+
+
+class TransformerConfig:
+    def __init__(
+        self,
+        vocab_size=37000,
+        d_model=1024,
+        n_heads=16,
+        d_ffn=4096,
+        n_enc_layers=6,
+        n_dec_layers=6,
+        max_len=256,
+        dropout=0.1,
+        label_smooth=0.1,
+        bos_id=0,
+        eos_id=1,
+        pad_id=2,
+        pre_ln=True,
+    ):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.d_ffn = d_ffn
+        self.n_enc_layers = n_enc_layers
+        self.n_dec_layers = n_dec_layers
+        self.max_len = max_len
+        self.dropout = dropout
+        self.label_smooth = label_smooth
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        # pre-LN ("normalize_before") trains stably without long warmup;
+        # post-LN (pre_ln=False) matches the 2017 paper layout
+        self.pre_ln = pre_ln
+
+    @staticmethod
+    def big():
+        return TransformerConfig()
+
+    @staticmethod
+    def base():
+        return TransformerConfig(d_model=512, n_heads=8, d_ffn=2048)
+
+    @staticmethod
+    def tiny():
+        return TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, d_ffn=64,
+            n_enc_layers=2, n_dec_layers=2, max_len=32, dropout=0.0,
+        )
+
+
+def _sinusoid(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float64")
+    i = np.arange(d_model)[None, :].astype("float64")
+    angle = pos / np.power(10000.0, 2 * (i // 2) / d_model)
+    enc = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return enc.astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# IR training program
+# ---------------------------------------------------------------------------
+
+
+def _init(cfg):
+    return fluid.initializer.Xavier()
+
+
+def _dense(x, size, cfg, act=None, name=None, nfd=2):
+    return fluid.layers.fc(
+        x, size=size, num_flatten_dims=nfd, act=act,
+        param_attr=ParamAttr(name=name + ".w", initializer=_init(cfg)),
+        bias_attr=ParamAttr(name=name + ".b"),
+        name=name,
+    )
+
+
+def _ln(x, cfg, name):
+    return fluid.layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=name + ".scale"),
+        bias_attr=ParamAttr(name=name + ".bias"),
+        name=name,
+    )
+
+
+def _mha(q_in, kv_in, bias, cfg, name):
+    """Multi-head attention through IR ops; bias is additive, broadcastable
+    to [B, heads, Sq, Sk]."""
+    H, n, d = cfg.d_model, cfg.n_heads, cfg.d_model // cfg.n_heads
+    q = _dense(q_in, H, cfg, name=name + ".q")
+    k = _dense(kv_in, H, cfg, name=name + ".k")
+    v = _dense(kv_in, H, cfg, name=name + ".v")
+
+    def split(t):
+        t = fluid.layers.reshape(t, [0, 0, n, d])
+        return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = split(q), split(k), split(v)
+    scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=1.0 / math.sqrt(d))
+    scores = fluid.layers.elementwise_add(scores, bias)
+    probs = fluid.layers.softmax(scores)
+    if cfg.dropout:
+        probs = fluid.layers.dropout(
+            probs, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    ctx = fluid.layers.matmul(probs, v)
+    ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = fluid.layers.reshape(ctx, [0, 0, H])
+    return _dense(ctx, H, cfg, name=name + ".out")
+
+
+def _res_drop(x, y, cfg):
+    if cfg.dropout:
+        y = fluid.layers.dropout(
+            y, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    return fluid.layers.elementwise_add(x, y)
+
+
+def _ffn(x, cfg, name):
+    h = _dense(x, cfg.d_ffn, cfg, act="relu", name=name + "1")
+    return _dense(h, cfg.d_model, cfg, name=name + "2")
+
+
+def _embed(ids, cfg, pos_table, name_prefix=""):
+    emb = fluid.layers.embedding(
+        ids, size=[cfg.vocab_size, cfg.d_model],
+        param_attr=ParamAttr(name="word_emb", initializer=_init(cfg)),
+    )
+    emb = fluid.layers.scale(emb, scale=math.sqrt(cfg.d_model))
+    emb = fluid.layers.elementwise_add(emb, pos_table)
+    if cfg.dropout:
+        emb = fluid.layers.dropout(
+            emb, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    return emb
+
+
+def _const(arr, name, dtype):
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("const_" + name)
+    out = helper.block.create_var(
+        name=helper.name, shape=list(arr.shape), dtype=dtype, stop_gradient=True
+    )
+    helper.append_op(
+        "assign_value", {}, {"Out": [out.name]},
+        {"shape": list(arr.shape), "dtype": dtype,
+         "values": np.asarray(arr).reshape(-1).tolist()},
+    )
+    return out
+
+
+def build_wmt_train(cfg=None, src_len=64, tgt_len=64, lr=2.0, warmup=4000,
+                    optimizer=None):
+    """Teacher-forced training program with label smoothing and Noam LR.
+    Feeds: src_ids [B,S], tgt_ids [B,T] (decoder input, BOS-prefixed),
+    labels [B,T] (gold, EOS-suffixed); pad_id positions are masked out.
+    Returns (main, startup, feeds, fetches=[loss])."""
+    cfg = cfg or TransformerConfig.base()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src_ids = fluid.data("src_ids", shape=[-1, src_len], dtype="int64")
+        tgt_ids = fluid.data("tgt_ids", shape=[-1, tgt_len], dtype="int64")
+        labels = fluid.data("labels", shape=[-1, tgt_len], dtype="int64")
+
+        pos_src = _const(_sinusoid(src_len, cfg.d_model)[None], "pos_src", "float32")
+        pos_tgt = _const(_sinusoid(tgt_len, cfg.d_model)[None], "pos_tgt", "float32")
+
+        # masks -> additive biases
+        src_pad = fluid.layers.cast(
+            fluid.layers.tensor.not_equal(
+                src_ids, fluid.layers.tensor.fill_constant([1], "int64", cfg.pad_id)
+            ), "float32",
+        )  # [B,S] 1=token
+        src_bias = fluid.layers.reshape(
+            fluid.layers.scale(src_pad, scale=1e4, bias=-1e4), [0, 1, 1, src_len]
+        )
+        causal = np.triu(np.full((tgt_len, tgt_len), -1e4, "float32"), k=1)
+        tgt_bias = _const(causal[None, None], "causal", "float32")
+
+        # encoder
+        x = _embed(src_ids, cfg, pos_src)
+        for i in range(cfg.n_enc_layers):
+            nm = f"enc_{i}"
+            if cfg.pre_ln:
+                xn = _ln(x, cfg, nm + ".ln1")
+                x = _res_drop(x, _mha(xn, xn, src_bias, cfg, nm + ".self"), cfg)
+                x = _res_drop(x, _ffn(_ln(x, cfg, nm + ".ln2"), cfg, nm + ".ffn"), cfg)
+            else:
+                x = _ln(_res_drop(x, _mha(x, x, src_bias, cfg, nm + ".self"), cfg),
+                        cfg, nm + ".ln1")
+                x = _ln(_res_drop(x, _ffn(x, cfg, nm + ".ffn"), cfg), cfg, nm + ".ln2")
+        if cfg.pre_ln:
+            x = _ln(x, cfg, "enc_ln")
+        enc_out = x
+
+        # decoder
+        y = _embed(tgt_ids, cfg, pos_tgt)
+        for i in range(cfg.n_dec_layers):
+            nm = f"dec_{i}"
+            if cfg.pre_ln:
+                yn = _ln(y, cfg, nm + ".ln1")
+                y = _res_drop(y, _mha(yn, yn, tgt_bias, cfg, nm + ".self"), cfg)
+                y = _res_drop(
+                    y, _mha(_ln(y, cfg, nm + ".ln2"), enc_out, src_bias, cfg,
+                            nm + ".cross"), cfg)
+                y = _res_drop(y, _ffn(_ln(y, cfg, nm + ".ln3"), cfg, nm + ".ffn"), cfg)
+            else:
+                y = _ln(_res_drop(y, _mha(y, y, tgt_bias, cfg, nm + ".self"), cfg),
+                        cfg, nm + ".ln1")
+                y = _ln(_res_drop(y, _mha(y, enc_out, src_bias, cfg, nm + ".cross"), cfg),
+                        cfg, nm + ".ln2")
+                y = _ln(_res_drop(y, _ffn(y, cfg, nm + ".ffn"), cfg), cfg, nm + ".ln3")
+        if cfg.pre_ln:
+            y = _ln(y, cfg, "dec_ln")
+
+        # tied output projection: logits = y @ word_emb^T
+        word_emb = main.global_block().var("word_emb")
+        logits = fluid.layers.matmul(y, word_emb, transpose_y=True)  # [B,T,V]
+
+        # label-smoothed CE over non-pad positions
+        labels3 = fluid.layers.reshape(labels, [0, tgt_len, 1])
+        nll = fluid.layers.softmax_with_cross_entropy(logits, labels3, axis=-1)
+        logp = fluid.layers.log_softmax(logits)  # [B,T,V]
+        uniform = fluid.layers.scale(
+            fluid.layers.reduce_sum(logp, dim=[-1], keep_dim=True),
+            scale=-1.0 / cfg.vocab_size,
+        )
+        eps = cfg.label_smooth
+        tok_loss = fluid.layers.elementwise_add(
+            fluid.layers.scale(nll, scale=1.0 - eps),
+            fluid.layers.scale(uniform, scale=eps),
+        )  # [B,T,1]
+        non_pad = fluid.layers.cast(
+            fluid.layers.tensor.not_equal(
+                labels, fluid.layers.tensor.fill_constant([1], "int64", cfg.pad_id)
+            ), "float32",
+        )
+        non_pad3 = fluid.layers.reshape(non_pad, [0, tgt_len, 1])
+        denom = fluid.layers.elementwise_max(
+            fluid.layers.reduce_sum(non_pad3),
+            fluid.layers.tensor.fill_constant([1], "float32", 1.0),
+        )
+        loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(tok_loss, non_pad3)
+            ),
+            denom,
+        )
+
+        if optimizer is None:
+            sched = fluid.layers.scale(
+                fluid.layers.learning_rate_scheduler.noam_decay(
+                    cfg.d_model, warmup_steps=warmup
+                ),
+                scale=lr,
+            )
+            optimizer = fluid.optimizer.Adam(
+                learning_rate=sched, beta1=0.9, beta2=0.997, epsilon=1e-9
+            )
+        optimizer.minimize(loss)
+    return main, startup, [src_ids, tgt_ids, labels], [loss]
+
+
+# ---------------------------------------------------------------------------
+# functional decoder (beam search, one jitted while_loop)
+# ---------------------------------------------------------------------------
+
+
+def params_from_scope(cfg, scope=None):
+    """Pull trained weights by name into a flat dict of jnp arrays."""
+    scope = scope or fluid.global_scope()
+    names = ["word_emb"]
+    for i in range(cfg.n_enc_layers):
+        nm = f"enc_{i}"
+        for part in (".self.q", ".self.k", ".self.v", ".self.out",
+                     ".ffn1", ".ffn2"):
+            names += [nm + part + ".w", nm + part + ".b"]
+        for part in (".ln1", ".ln2"):
+            names += [nm + part + ".scale", nm + part + ".bias"]
+    for i in range(cfg.n_dec_layers):
+        nm = f"dec_{i}"
+        for part in (".self.q", ".self.k", ".self.v", ".self.out",
+                     ".cross.q", ".cross.k", ".cross.v", ".cross.out",
+                     ".ffn1", ".ffn2"):
+            names += [nm + part + ".w", nm + part + ".b"]
+        for part in (".ln1", ".ln2", ".ln3"):
+            names += [nm + part + ".scale", nm + part + ".bias"]
+    if cfg.pre_ln:
+        for nm in ("enc_ln", "dec_ln"):
+            names += [nm + ".scale", nm + ".bias"]
+    out = {}
+    for n in names:
+        v = scope.find_var(n)
+        if v is None:
+            raise KeyError(f"parameter {n} not found in scope (train first?)")
+        out[n] = jnp.asarray(v)
+    return out
+
+
+def _f_ln(p, nm, x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * p[nm + ".scale"] + p[nm + ".bias"]
+
+
+def _f_dense(p, nm, x, act=None):
+    y = x @ p[nm + ".w"] + p[nm + ".b"]
+    return jax.nn.relu(y) if act == "relu" else y
+
+
+def _f_heads(cfg, t):
+    B, S, _ = t.shape
+    return t.reshape(B, S, cfg.n_heads, -1).transpose(0, 2, 1, 3)
+
+
+def _f_mha(p, nm, cfg, q_in, kv_in, bias):
+    d = cfg.d_model // cfg.n_heads
+    q = _f_heads(cfg, _f_dense(p, nm + ".q", q_in))
+    k = _f_heads(cfg, _f_dense(p, nm + ".k", kv_in))
+    v = _f_heads(cfg, _f_dense(p, nm + ".v", kv_in))
+    s = q @ k.transpose(0, 1, 3, 2) / math.sqrt(d) + bias
+    ctx = jax.nn.softmax(s, axis=-1) @ v
+    B = ctx.shape[0]
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, -1, cfg.d_model)
+    return _f_dense(p, nm + ".out", ctx)
+
+
+def _f_encode(p, cfg, src_ids):
+    """src_ids [B,S] -> (enc_out [B,S,H], src_bias [B,1,1,S])."""
+    B, S = src_ids.shape
+    x = p["word_emb"][src_ids] * math.sqrt(cfg.d_model)
+    x = x + jnp.asarray(_sinusoid(S, cfg.d_model))[None]
+    src_bias = jnp.where(src_ids == cfg.pad_id, -1e4, 0.0).astype(jnp.float32)
+    src_bias = src_bias[:, None, None, :]
+    for i in range(cfg.n_enc_layers):
+        nm = f"enc_{i}"
+        if cfg.pre_ln:
+            xn = _f_ln(p, nm + ".ln1", x)
+            x = x + _f_mha(p, nm + ".self", cfg, xn, xn, src_bias)
+            x = x + _f_dense(p, nm + ".ffn2",
+                             _f_dense(p, nm + ".ffn1",
+                                      _f_ln(p, nm + ".ln2", x), act="relu"))
+        else:
+            x = _f_ln(p, nm + ".ln1",
+                      x + _f_mha(p, nm + ".self", cfg, x, x, src_bias))
+            x = _f_ln(p, nm + ".ln2",
+                      x + _f_dense(p, nm + ".ffn2",
+                                   _f_dense(p, nm + ".ffn1", x, act="relu")))
+    if cfg.pre_ln:
+        x = _f_ln(p, "enc_ln", x)
+    return x, src_bias
+
+
+def make_beam_decoder(cfg, beam_size=4, max_len=None, length_penalty=0.6):
+    """Returns a jitted fn: (params, src_ids [B,S]) -> (tokens [B,L],
+    scores [B]). Greedy = beam_size 1. The whole search — encoder, KV-cached
+    decoder steps, beam bookkeeping — is one XLA computation."""
+    max_len = max_len or cfg.max_len
+    K, V, H = beam_size, cfg.vocab_size, cfg.d_model
+    n_h, d_h = cfg.n_heads, cfg.d_model // cfg.n_heads
+    NEG = -1e9
+
+    pos_table = jnp.asarray(_sinusoid(max_len, cfg.d_model))
+
+    def step_logits(p, tok, t, self_caches, cross_kv, src_bias):
+        """tok [N] current input token; returns (logits [N,V], new caches).
+        self_caches: per dec layer (k,v) [N, n_h, max_len, d_h]."""
+        N = tok.shape[0]
+        x = p["word_emb"][tok][:, None, :] * math.sqrt(H)  # [N,1,H]
+        x = x + lax.dynamic_slice_in_dim(pos_table, t, 1)[None]
+        new_caches = []
+        # causal bias over cache positions: only <= t visible
+        valid = (jnp.arange(max_len) <= t).astype(jnp.float32)
+        self_bias = (1.0 - valid) * NEG  # [max_len]
+        def self_attn(nm, xin, i):
+            q = _f_heads(cfg, _f_dense(p, nm + ".self.q", xin))  # [N,h,1,d]
+            k1 = _f_heads(cfg, _f_dense(p, nm + ".self.k", xin))
+            v1 = _f_heads(cfg, _f_dense(p, nm + ".self.v", xin))
+            ck, cv = self_caches[i]
+            ck = lax.dynamic_update_slice_in_dim(ck, k1, t, axis=2)
+            cv = lax.dynamic_update_slice_in_dim(cv, v1, t, axis=2)
+            new_caches.append((ck, cv))
+            s = (q @ ck.transpose(0, 1, 3, 2)) / math.sqrt(d_h)
+            s = s + self_bias[None, None, None, :]
+            ctx = jax.nn.softmax(s, axis=-1) @ cv  # [N,h,1,d]
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(N, 1, H)
+            return _f_dense(p, nm + ".self.out", ctx)
+
+        def cross_attn(nm, xin, i):
+            ek, ev = cross_kv[i]  # [N,h,S,d]
+            q2 = _f_heads(cfg, _f_dense(p, nm + ".cross.q", xin))
+            s2 = (q2 @ ek.transpose(0, 1, 3, 2)) / math.sqrt(d_h) + src_bias
+            ctx2 = jax.nn.softmax(s2, axis=-1) @ ev
+            ctx2 = ctx2.transpose(0, 2, 1, 3).reshape(N, 1, H)
+            return _f_dense(p, nm + ".cross.out", ctx2)
+
+        def ffn(nm, xin):
+            return _f_dense(p, nm + ".ffn2",
+                            _f_dense(p, nm + ".ffn1", xin, act="relu"))
+
+        for i in range(cfg.n_dec_layers):
+            nm = f"dec_{i}"
+            if cfg.pre_ln:
+                x = x + self_attn(nm, _f_ln(p, nm + ".ln1", x), i)
+                x = x + cross_attn(nm, _f_ln(p, nm + ".ln2", x), i)
+                x = x + ffn(nm, _f_ln(p, nm + ".ln3", x))
+            else:
+                x = _f_ln(p, nm + ".ln1", x + self_attn(nm, x, i))
+                x = _f_ln(p, nm + ".ln2", x + cross_attn(nm, x, i))
+                x = _f_ln(p, nm + ".ln3", x + ffn(nm, x))
+        if cfg.pre_ln:
+            x = _f_ln(p, "dec_ln", x)
+        logits = (x[:, 0, :] @ p["word_emb"].T)  # [N,V]
+        return logits, new_caches
+
+    def decode(p, src_ids):
+        B, S = src_ids.shape
+        N = B * K
+        enc_out, src_bias = _f_encode(p, cfg, src_ids)
+        # expand to beams
+        enc_out = jnp.repeat(enc_out, K, axis=0)           # [N,S,H]
+        src_bias_n = jnp.repeat(src_bias, K, axis=0)       # [N,1,1,S]
+        cross_kv = []
+        for i in range(cfg.n_dec_layers):
+            nm = f"dec_{i}"
+            ek = _f_heads(cfg, _f_dense(p, nm + ".cross.k", enc_out))
+            ev = _f_heads(cfg, _f_dense(p, nm + ".cross.v", enc_out))
+            cross_kv.append((ek, ev))
+
+        ys = jnp.full((B, K, max_len), cfg.pad_id, jnp.int32)
+        scores = jnp.tile(
+            jnp.array([0.0] + [NEG] * (K - 1), jnp.float32)[None], (B, 1)
+        )
+        finished = jnp.zeros((B, K), bool)
+        tok = jnp.full((N,), cfg.bos_id, jnp.int32)
+        caches = tuple(
+            (jnp.zeros((N, n_h, max_len, d_h), jnp.float32),
+             jnp.zeros((N, n_h, max_len, d_h), jnp.float32))
+            for _ in range(cfg.n_dec_layers)
+        )
+
+        lengths = jnp.zeros((B, K), jnp.int32)
+
+        def body(state):
+            t, ys, scores, finished, lengths, tok, caches = state
+            logits, caches = step_logits(
+                p, tok, t, caches, cross_kv, src_bias_n
+            )
+            logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+            # finished beams: only EOS continuation, at zero added cost
+            eos_only = jnp.full((V,), NEG).at[cfg.eos_id].set(0.0)
+            logp = jnp.where(finished[:, :, None], eos_only[None, None, :], logp)
+            cand = scores[:, :, None] + logp              # [B,K,V]
+            top_scores, top_idx = lax.top_k(cand.reshape(B, K * V), K)
+            beam_idx = top_idx // V                        # [B,K]
+            new_tok = (top_idx % V).astype(jnp.int32)
+            # reorder beam state
+            ys = jnp.take_along_axis(ys, beam_idx[:, :, None], axis=1)
+            was_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            # already-finished beams write pad (not EOS spam), and their
+            # length stays frozen so the GNMT penalty compares true lengths
+            write_tok = jnp.where(was_finished, cfg.pad_id, new_tok)
+            ys = ys.at[:, :, t].set(write_tok)
+            lengths = jnp.where(was_finished, lengths, t + 1)
+            finished = was_finished | (new_tok == cfg.eos_id)
+            flat_idx = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+            caches = tuple(
+                (ck[flat_idx], cv[flat_idx]) for ck, cv in caches
+            )
+            return (t + 1, ys, top_scores, finished, lengths,
+                    new_tok.reshape(-1), caches)
+
+        def cond2(state):
+            t, _, _, finished, _, _, _ = state
+            return (t < max_len) & ~finished.all()
+
+        state = (jnp.array(0), ys, scores, finished, lengths, tok, caches)
+        _, ys, scores, finished, lengths, _, _ = lax.while_loop(
+            cond2, body, state
+        )
+        # length penalty (GNMT): score / ((5+len)/6)^alpha
+        lp = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+        norm = scores / jnp.where(lp == 0, 1.0, lp)
+        best = norm.argmax(axis=1)
+        return (
+            jnp.take_along_axis(ys, best[:, None, None], axis=1)[:, 0, :],
+            jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0],
+        )
+
+    return jax.jit(decode)
+
+
+def synthetic_batch(rng, batch, src_len, tgt_len, cfg):
+    """Copy-task data: target = source (the model must learn identity),
+    giving a real learnable signal for convergence tests."""
+    body = rng.randint(3, cfg.vocab_size, (batch, src_len - 1)).astype("int64")
+    src = np.concatenate(
+        [body, np.full((batch, 1), cfg.pad_id, "int64")], axis=1
+    )
+    tgt_in = np.full((batch, tgt_len), cfg.pad_id, "int64")
+    labels = np.full((batch, tgt_len), cfg.pad_id, "int64")
+    L = min(tgt_len - 1, src_len - 1)
+    tgt_in[:, 0] = cfg.bos_id
+    tgt_in[:, 1:L + 1] = body[:, :L]
+    labels[:, :L] = body[:, :L]
+    labels[:, L] = cfg.eos_id
+    return {"src_ids": src, "tgt_ids": tgt_in, "labels": labels}

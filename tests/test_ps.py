@@ -74,6 +74,56 @@ def test_shrink_and_stats(ps):
     assert c.table_stats()[4] == 2
 
 
+def test_stop_with_open_connection_does_not_hang():
+    """Server stop must shutdown() connections parked in recv()."""
+    import threading
+
+    srv = PSServer()
+    c = PSClient([srv.endpoint])  # idle connection, blocked server-side
+    done = threading.Event()
+
+    def stopper():
+        srv.stop()
+        done.set()
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    assert done.wait(timeout=10), "PSServer.stop() hung with an open client"
+    c.close()
+
+
+def test_shrink_after_load_keeps_rows(ps, tmp_path):
+    """Loaded rows join the current version generation — a shrink right
+    after checkpoint restore must not wipe the table."""
+    _, c = ps
+    c.create_table(6, dim=2, init_range=0.0)
+    for i in range(3):
+        c.push_sparse(6, np.array([i], dtype=np.uint64),
+                      np.ones((1, 2), np.float32), lr=0.1)
+    path = str(tmp_path / "t6.tbl")
+    c.save(6, path)
+    c.load(6, path)
+    assert c.shrink(6, keep_versions=1000) == 0
+    assert c.table_stats()[6] == 3
+
+
+def test_adagrad_state_survives_checkpoint(ps, tmp_path):
+    """g2 accumulators are part of the checkpoint: post-restore updates must
+    be damped exactly as pre-restore ones."""
+    _, c = ps
+    c.create_table(7, dim=1, init_range=0.0, optimizer=OPT_ADAGRAD)
+    ids = np.array([1], dtype=np.uint64)
+    g = np.array([[2.0]], dtype=np.float32)
+    c.push_sparse(7, ids, g, lr=0.1)
+    path = str(tmp_path / "t7.tbl")
+    c.save(7, path)
+    c.push_sparse(7, ids, g, lr=0.1)
+    expected = c.pull_sparse(7, ids, 1).copy()
+    c.load(7, path)
+    c.push_sparse(7, ids, g, lr=0.1)  # must replay identically
+    np.testing.assert_allclose(c.pull_sparse(7, ids, 1), expected, rtol=1e-6)
+
+
 def test_heartbeat(ps):
     _, c = ps
     ages = c.heartbeat(3)
