@@ -43,6 +43,28 @@ from paddle_tpu.reader import DataLoader, PyReader
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu import dataset
 from paddle_tpu.dataset import DatasetFactory
+from paddle_tpu import metrics
+from paddle_tpu import profiler
+from paddle_tpu import debugger
+from paddle_tpu import fleet
+
+
+class FetchHandler:
+    """Periodic fetch callback for dataset training (reference:
+    python/paddle/fluid/executor.py:406). Subclass and override handler();
+    handler receives {fetch_name: value} built from the train_from_dataset
+    fetch_list (var_dict is accepted for reference API parity — fetches are
+    selected by fetch_list here, not by this mapping)."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        self.var_dict = var_dict or {}
+        self.period_secs = period_secs
+
+    def handler(self, fetch_vars):
+        import numpy as _np
+
+        for name, value in fetch_vars.items():
+            print(f"{name}: {_np.asarray(value).reshape(-1)[:8]}")
 from paddle_tpu.layers.tensor import data_v2 as data
 from paddle_tpu.utils.flags import set_flags, get_flags
 from paddle_tpu.utils.enforce import EnforceError

@@ -32,6 +32,7 @@ from paddle_tpu.core.ir import Program
 from paddle_tpu.core.places import CPUPlace, TPUPlace
 from paddle_tpu.core.backward import resolve_op_def as get_op_def
 from paddle_tpu.core.scope import global_scope
+from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.utils.enforce import EnforceError
 from paddle_tpu.utils.flags import flags
 
@@ -241,7 +242,7 @@ class Executor:
             name: self._to_device(value, block, name) for name, value in feed.items()
         }
 
-        if flags.check_nan_inf:
+        if flags.check_nan_inf or flags.benchmark:
             return self._run_interpreted(
                 program, feed_arrays, fetch_names, scope, return_numpy
             )
@@ -260,6 +261,7 @@ class Executor:
         fetch_list=None,
         fetch_info=None,
         print_period=100,
+        fetch_handler=None,
     ):
         """Dataset-mode training loop (reference: python/paddle/fluid/
         executor.py:1124 train_from_dataset -> C++ Executor::RunFromDataset
@@ -270,16 +272,33 @@ class Executor:
         from paddle_tpu.utils.enforce import enforce as _enforce
 
         _enforce(dataset is not None, "dataset is required")
+        import time as _time
+
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [str(f) for f in fetch_list]
         step = 0
         last = None
+        last_handled = _time.monotonic()
         for feed in dataset._iter_batches():
             out = self.run(
                 program, feed=feed, fetch_list=fetch_list, scope=scope
             )
             last = out
-            if fetch_list and (debug or (step % print_period == 0)):
+            if fetch_list and fetch_handler is not None:
+                # time-based callback cadence (reference: FetchHandlerMonitor
+                # wakes every period_secs, executor.py:406) with a step
+                # fallback so short runs still observe fetches
+                now = _time.monotonic()
+                if (
+                    now - last_handled >= fetch_handler.period_secs
+                    or step % print_period == 0
+                ):
+                    names = [
+                        f if isinstance(f, str) else f.name for f in fetch_list
+                    ]
+                    fetch_handler.handler(dict(zip(names, out)))
+                    last_handled = now
+            elif fetch_list and (debug or step % print_period == 0):
                 msgs = [
                     f"{info}={np.asarray(v).reshape(-1)[:1][0]:.6f}"
                     for info, v in zip(fetch_info, out)
@@ -290,10 +309,11 @@ class Executor:
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
         return self.train_from_dataset(
             program, dataset, scope, thread, debug, fetch_list, fetch_info,
-            print_period,
+            print_period, fetch_handler,
         )
 
     # ------------------------------------------------------------------
@@ -398,7 +418,18 @@ class Executor:
                 ]
             if op_def.needs_base_rng:
                 ins["__base_rng__"] = [rng_key]
-            outs = op_def.lowering()(ins, op.attrs)
+            if flags.benchmark:
+                # per-op timing: block on the op's outputs so device time is
+                # attributed to the op (reference: FLAGS_benchmark serializes
+                # with dev_ctx->Wait, operator.cc:1006)
+                with RecordEvent(op.type):
+                    outs = op_def.lowering()(ins, op.attrs)
+                    for vals in outs.values():
+                        for v in vals if isinstance(vals, (list, tuple)) else [vals]:
+                            if hasattr(v, "block_until_ready"):
+                                v.block_until_ready()
+            else:
+                outs = op_def.lowering()(ins, op.attrs)
             for slot, names in op.outputs.items():
                 if slot not in outs:
                     continue

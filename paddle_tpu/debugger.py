@@ -1,0 +1,72 @@
+"""Program visualization & text debugging.
+
+Reference: python/paddle/fluid/debugger.py (draw_block_graphviz) and
+paddle/fluid/framework/ir/graph_viz_pass.cc — dump the op/var graph as
+graphviz dot for inspection.
+"""
+
+__all__ = ["draw_block_graphviz", "program_summary"]
+
+_OP_STYLE = 'shape=box, style="rounded,filled", fillcolor="#d5e8f7"'
+_VAR_STYLE = 'shape=ellipse, style=filled, fillcolor="#eeeeee"'
+_PARAM_STYLE = 'shape=ellipse, style=filled, fillcolor="#d9ead3"'
+
+
+def _q(s):
+    return '"' + str(s).replace('"', '\\"') + '"'
+
+
+def draw_block_graphviz(block, highlights=None, path=None):
+    """Render a block as graphviz dot text; optionally write to `path`."""
+    from paddle_tpu.core.ir import Parameter
+
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_nodes = set()
+
+    def var_node(name):
+        if name in var_nodes:
+            return
+        var_nodes.add(name)
+        v = block._find_var_recursive(name)
+        style = _PARAM_STYLE if isinstance(v, Parameter) else _VAR_STYLE
+        if name in highlights:
+            style += ', color=red, penwidth=2'
+        label = name
+        if v is not None and v.shape is not None:
+            label += f"\\n{list(v.shape)}|{v.dtype}"
+        lines.append(f"  {_q('var_' + name)} [{style}, label={_q(label)}];")
+
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}_{op.type}"
+        lines.append(f"  {_q(op_id)} [{_OP_STYLE}, label={_q(op.type)}];")
+        for name in op.input_names():
+            var_node(name)
+            lines.append(f"  {_q('var_' + name)} -> {_q(op_id)};")
+        for name in op.output_names():
+            var_node(name)
+            lines.append(f"  {_q(op_id)} -> {_q('var_' + name)};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def program_summary(program):
+    """Compact per-block op/var counts + op histogram."""
+    from collections import Counter
+
+    out = []
+    for b in program.blocks:
+        hist = Counter(op.type for op in b.ops)
+        out.append(
+            {
+                "block": b.idx,
+                "num_ops": len(b.ops),
+                "num_vars": len(b.vars),
+                "op_histogram": dict(hist.most_common()),
+            }
+        )
+    return out

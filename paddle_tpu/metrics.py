@@ -1,0 +1,207 @@
+"""Streaming training metrics, updated host-side from fetched batches.
+
+Reference: python/paddle/fluid/metrics.py — MetricBase :62, Accuracy :435,
+Auc :699, Precision :535, Recall :610, CompositeMetric :364. These accumulate
+across exe.run fetches (the in-graph accuracy/auc ops in layers/nn.py are the
+per-batch device-side counterparts)."""
+
+import numpy as np
+
+__all__ = [
+    "MetricBase",
+    "Accuracy",
+    "Precision",
+    "Recall",
+    "Auc",
+    "CompositeMetric",
+    "ChunkEvaluator",
+]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+    def get_config(self):
+        return {
+            k: v for k, v in self.__dict__.items() if not k.startswith("_")
+        }
+
+
+class Accuracy(MetricBase):
+    """Weighted streaming accuracy (reference: metrics.py:435)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("no data updated into Accuracy")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary precision from (pred label in {0,1}, gold) batches
+    (reference: metrics.py:535)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    """reference: metrics.py:610."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(MetricBase):
+    """Thresholded streaming AUC, same histogram algorithm as the reference
+    (reference: metrics.py:699 and operators/metrics/auc_op.cc): bucket
+    positive/negative counts by predicted score, integrate trapezoidally."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n, dtype=np.int64)
+        self._stat_neg = np.zeros(n, dtype=np.int64)
+
+    def update(self, preds, labels):
+        """preds: [N, 2] class probabilities (or [N] positive scores)."""
+        preds = np.asarray(preds)
+        scores = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        idx = np.clip(
+            (scores * self._num_thresholds).astype(np.int64),
+            0, self._num_thresholds,
+        )
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def eval(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc) / denom if denom else 0.0
+
+
+class CompositeMetric(MetricBase):
+    """reference: metrics.py:364."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise TypeError("add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class ChunkEvaluator(MetricBase):
+    """Streaming chunk F1 from per-batch (num_infer, num_label, num_correct)
+    counts (reference: metrics.py ChunkEvaluator)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).reshape(-1)[0])
+        self.num_label_chunks += int(np.asarray(num_label_chunks).reshape(-1)[0])
+        self.num_correct_chunks += int(
+            np.asarray(num_correct_chunks).reshape(-1)[0]
+        )
+
+    def eval(self):
+        precision = (
+            self.num_correct_chunks / self.num_infer_chunks
+            if self.num_infer_chunks
+            else 0.0
+        )
+        recall = (
+            self.num_correct_chunks / self.num_label_chunks
+            if self.num_label_chunks
+            else 0.0
+        )
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision + recall
+            else 0.0
+        )
+        return precision, recall, f1

@@ -1,0 +1,163 @@
+"""Profiler: host-side event timing + device traces.
+
+Reference: paddle/fluid/platform/profiler.h:199-209 (RAII RecordEvent around
+each op-dispatch phase), device_tracer.h:41 (CUPTI kernel timeline ->
+chrome-trace), python/paddle/fluid/profiler.py:129-253 (context managers,
+sorted report). TPU translation:
+
+* device side: `jax.profiler` traces (TensorBoard/XPlane, viewable in
+  chrome://tracing via tensorboard) replace CUPTI — start_profiler /
+  stop_profiler wrap jax.profiler.start_trace/stop_trace.
+* host side: `RecordEvent` spans + a per-op timing mode in the interpretive
+  executor path (profile_ops below); the whole-block compiled path is ONE
+  XLA computation, so per-op host timing only exists in interpreted mode —
+  the same trade the reference makes between graph and dygraph profiling.
+"""
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+__all__ = [
+    "RecordEvent",
+    "start_profiler",
+    "stop_profiler",
+    "reset_profiler",
+    "profiler",
+    "profile_ops",
+    "get_profile_report",
+    "print_profiler_report",
+]
+
+_events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # count,total,max,min
+_enabled = False
+_trace_dir = None
+
+
+class RecordEvent:
+    """RAII host span (reference: profiler.h:205). Usable as context manager
+    or decorator; nests freely."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:
+            return False
+        dt = time.perf_counter() - self._t0
+        rec = _events[self.name]
+        rec[0] += 1
+        rec[1] += dt
+        rec[2] = max(rec[2], dt)
+        rec[3] = min(rec[3], dt)
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """state/tracer_option accepted for parity (reference: profiler.py:196);
+    device tracing starts when trace_dir is given (jax.profiler)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    if trace_dir:
+        import jax
+
+        _trace_dir = trace_dir
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir:
+        import jax
+
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    report = get_profile_report(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(_format_report(report))
+    return report
+
+
+def reset_profiler():
+    _events.clear()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir=None):
+    """with profiler.profiler(): ... (reference: profiler.py:253)."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        report = stop_profiler(sorted_key, profile_path)
+        print_profiler_report(report)
+
+
+@contextlib.contextmanager
+def profile_ops():
+    """Per-op interpretive profiling: forces the interpreted executor path
+    with a RecordEvent around every op lowering — the analog of the
+    reference's in-dispatch event records (operator.cc:959-988)."""
+    global _enabled
+    from paddle_tpu.utils.flags import flags
+
+    old_bench, old_enabled = flags.benchmark, _enabled
+    flags.benchmark = True
+    _enabled = True
+    try:
+        yield
+    finally:
+        flags.benchmark = old_bench
+        _enabled = old_enabled
+
+
+def get_profile_report(sorted_key="total"):
+    keyfn = {
+        "total": lambda r: r[1][1],
+        "calls": lambda r: r[1][0],
+        "max": lambda r: r[1][2],
+        "min": lambda r: r[1][3],
+        "ave": lambda r: r[1][1] / max(r[1][0], 1),
+    }.get(sorted_key, lambda r: r[1][1])
+    rows = sorted(_events.items(), key=keyfn, reverse=True)
+    return [
+        {
+            "name": name,
+            "calls": c,
+            "total_s": tot,
+            "max_s": mx,
+            "min_s": mn if c else 0.0,
+            "ave_s": tot / max(c, 1),
+        }
+        for name, (c, tot, mx, mn) in rows
+    ]
+
+
+def _format_report(report):
+    lines = [
+        f"{'Event':<48}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}{'Max(s)':>12}"
+    ]
+    for r in report:
+        lines.append(
+            f"{r['name']:<48}{r['calls']:>8}{r['total_s']:>12.6f}"
+            f"{r['ave_s']:>12.6f}{r['max_s']:>12.6f}"
+        )
+    return "\n".join(lines)
+
+
+def print_profiler_report(report=None):
+    print(_format_report(report if report is not None else get_profile_report()))

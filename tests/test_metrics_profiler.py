@@ -1,0 +1,153 @@
+"""Metrics, profiler, debugger, NaN-check tests (reference patterns:
+test_metrics.py, test_profiler.py, debugger usage)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics, profiler
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def test_accuracy_metric():
+    m = metrics.Accuracy()
+    m.update(0.5, weight=10)
+    m.update(1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-9
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
+
+
+def test_precision_recall():
+    p, r = metrics.Precision(), metrics.Recall()
+    preds = np.array([1, 1, 0, 0, 1])
+    labels = np.array([1, 0, 1, 0, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.eval() - 2 / 3) < 1e-9
+    assert abs(r.eval() - 2 / 3) < 1e-9
+
+
+def test_auc_streaming_matches_exact():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(2000)
+    labels = (rng.rand(2000) < scores).astype(np.int64)  # informative scores
+    m = metrics.Auc()
+    # stream in chunks
+    for i in range(0, 2000, 256):
+        m.update(scores[i:i + 256], labels[i:i + 256])
+    got = m.eval()
+    # exact AUC by rank statistic
+    order = np.argsort(scores)
+    ranks = np.empty(2000)
+    ranks[order] = np.arange(1, 2001)
+    n_pos, n_neg = labels.sum(), (1 - labels).sum()
+    exact = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    assert abs(got - exact) < 5e-3, (got, exact)
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update(np.array([1, 0]), np.array([1, 1]))
+    assert c.eval() == [1.0, 0.5]
+
+
+def test_profiler_events_and_report():
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.RecordEvent("outer"):
+        for _ in range(3):
+            with profiler.RecordEvent("inner"):
+                pass
+    report = profiler.stop_profiler()
+    names = {r["name"]: r for r in report}
+    assert names["inner"]["calls"] == 3
+    assert names["outer"]["calls"] == 1
+    assert names["outer"]["total_s"] >= names["inner"]["max_s"]
+
+
+def test_profile_ops_per_op_timing(rng):
+    """profile_ops forces interpreted execution and records one event per
+    op type."""
+    profiler.reset_profiler()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 8])
+        h = fluid.layers.fc(x, size=4, act="relu")
+        loss = fluid.layers.mean(h)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with profiler.profile_ops():
+        exe.run(main, feed={"x": rng.rand(4, 8).astype("float32")},
+                fetch_list=[loss])
+    report = {r["name"] for r in profiler.get_profile_report()}
+    assert "mul" in report and "relu" in report and "mean" in report
+
+
+def test_check_nan_inf_names_op(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.layers.log(fluid.layers.scale(x, scale=-1.0))  # log(neg) = nan
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(fluid.EnforceError, match="log"):
+            exe.run(main, feed={"x": rng.rand(2, 4).astype("float32")},
+                    fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_graphviz_dump_and_summary(rng):
+    from paddle_tpu import debugger
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        h = fluid.layers.fc(x, size=2)
+        fluid.layers.mean(h)
+    dot = debugger.draw_block_graphviz(main.global_block())
+    assert dot.startswith("digraph G {") and "mul" in dot
+    summary = debugger.program_summary(main)
+    assert summary[0]["num_ops"] >= 2
+    assert "mul" in summary[0]["op_histogram"]
+
+
+def test_fetch_handler_called(tmp_path, rng):
+    lines = []
+    for i in range(8):
+        x = rng.rand(4)
+        lines.append("4 " + " ".join(f"{v:.4f}" for v in x) + f" 1 {x.sum():.4f}")
+    p = tmp_path / "d.txt"
+    p.write_text("\n".join(lines) + "\n")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(p)])
+
+    seen = []
+
+    class H(fluid.FetchHandler):
+        def handler(self, fetch_vars):
+            seen.append(dict(fetch_vars))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.train_from_dataset(
+        main, ds, fetch_list=[loss], print_period=1, fetch_handler=H()
+    )
+    assert len(seen) == 2  # 8 rows / batch 4
+    assert loss.name in seen[0]
