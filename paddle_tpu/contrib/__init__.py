@@ -1,0 +1,6 @@
+"""contrib: mixed-precision lives in paddle_tpu.amp; quantization here.
+
+Reference: python/paddle/fluid/contrib/ (slim/quantization, mixed_precision).
+"""
+
+from paddle_tpu.contrib import quantize  # noqa: F401

@@ -1,0 +1,192 @@
+"""Quantization-aware training as a program-rewriting pass.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py — QuantizationTransformPass inserts fake_quantize /
+dequantize op pairs on the inputs of quantizable ops (mul/conv2d/matmul),
+with abs-max scales for weights and moving-average abs-max state for
+activations; QuantizationFreezePass converts for inference.
+
+TPU translation: the fake-quant ops lower to round/clip jnp with a
+straight-through-estimator grad (registered `*_grad` lowerings), so QAT
+trains inside the same whole-block XLA computation. int8 *execution* is not
+a TPU win (MXU is bf16/int8-via-XLA), so "freeze" keeps the simulated-quant
+graph with frozen scales rather than emitting int8 kernels — the numerics
+users deploy against match training exactly.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.ir import Parameter
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first
+from paddle_tpu.utils import unique_name
+
+__all__ = ["QuantizationTransformPass", "quantize_program"]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant ops (with straight-through-estimator grads)
+# ---------------------------------------------------------------------------
+
+
+def _fake_quant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+@register_op("fake_quantize_dequantize_abs_max", nondiff_inputs=())
+def _fq_abs_max(ins, attrs):
+    """Per-tensor abs-max weight quant (reference: paddle/fluid/operators/
+    fake_quantize_op.cc FakeQuantizeDequantizeAbsMax)."""
+    x = first(ins, "X")
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_fake_quant(x, scale, bits)], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_abs_max_grad")
+def _fq_abs_max_grad(ins, attrs):
+    # straight-through estimator: d out / d x = 1 inside the clip range
+    return {"X@GRAD": [first(ins, "Out@GRAD")]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def _fq_moving(ins, attrs):
+    """Activation quant with moving-average abs-max state (reference:
+    fake_quantize_op.cc FakeQuantizeMovingAverageAbsMax)."""
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale").reshape(())
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    if attrs.get("is_test", False):
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x)).astype(in_scale.dtype)
+        # first batch (scale==0) adopts the current abs-max outright
+        scale = jnp.where(in_scale <= 0, cur, rate * in_scale + (1 - rate) * cur)
+    return {
+        "Out": [_fake_quant(x, scale, bits)],
+        "OutScale": [scale.reshape(1)],
+    }
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max_grad")
+def _fq_moving_grad(ins, attrs):
+    return {"X@GRAD": [first(ins, "Out@GRAD")]}
+
+
+# ---------------------------------------------------------------------------
+# transform pass
+# ---------------------------------------------------------------------------
+
+_DEFAULT_QUANTIZABLE = ("mul", "matmul", "conv2d")
+
+
+class QuantizationTransformPass:
+    """reference: slim/quantization/quantization_pass.py
+    QuantizationTransformPass."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_op_type=_DEFAULT_QUANTIZABLE, skip_pattern=None):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._ops = set(quantizable_op_type)
+        self._skip = skip_pattern
+
+    def apply(self, program, startup_program):
+        block = program.global_block()
+        sblock = startup_program.global_block()
+        quantized = {}  # src var name -> quantized var name (reuse per var)
+
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self._ops or op.attrs.get("__quant_skip__"):
+                i += 1
+                continue
+            if self._skip and self._skip in op.attrs.get("op_namescope", ""):
+                i += 1
+                continue
+            inserted = 0
+            for slot, names in op.inputs.items():
+                new_names = []
+                for name in names:
+                    v = block._find_var_recursive(name)
+                    if v is None or "int" in str(v.dtype):
+                        new_names.append(name)
+                        continue
+                    if name in quantized:
+                        new_names.append(quantized[name])
+                        continue
+                    qname = unique_name.generate(name + ".quantized")
+                    block.create_var(
+                        name=qname, shape=v.shape, dtype=v.dtype,
+                        persistable=False,
+                    ).stop_gradient = v.stop_gradient
+                    if isinstance(v, Parameter):
+                        qop, qins, qattrs = (
+                            "fake_quantize_dequantize_abs_max",
+                            {"X": [name]},
+                            {"bit_length": self._wbits},
+                        )
+                        scale_name = unique_name.generate(name + ".wscale")
+                        block.create_var(
+                            name=scale_name, shape=[1], dtype="float32",
+                        ).stop_gradient = True
+                        qouts = {"Out": [qname], "OutScale": [scale_name]}
+                    else:
+                        scale_name = unique_name.generate(name + ".scale")
+                        block.create_var(
+                            name=scale_name, shape=[1], dtype="float32",
+                            persistable=True,
+                        ).stop_gradient = True
+                        sblock.create_var(
+                            name=scale_name, shape=[1], dtype="float32",
+                            persistable=True,
+                        )
+                        sblock.append_op(
+                            "fill_constant", {}, {"Out": [scale_name]},
+                            {"shape": [1], "dtype": "float32", "value": 0.0},
+                        )
+                        qop = "fake_quantize_dequantize_moving_average_abs_max"
+                        qins = {"X": [name], "InScale": [scale_name]}
+                        qattrs = {
+                            "bit_length": self._abits,
+                            "moving_rate": self._rate,
+                            "is_test": False,
+                        }
+                        qouts = {"Out": [qname], "OutScale": [scale_name]}
+                    block._insert_op(i + inserted, qop, qins, qouts, qattrs)
+                    inserted += 1
+                    quantized[name] = qname
+                    new_names.append(qname)
+                op.inputs[slot] = new_names
+            i += inserted + 1
+        program._bump_version()
+        return program
+
+
+def quantize_program(program, startup_program, weight_bits=8,
+                     activation_bits=8, **kwargs):
+    """Convenience wrapper: apply QAT rewriting in place before minimize()
+    ... actually BEFORE building the optimizer: quantize, then call
+    optimizer.minimize(loss) so grads flow through the STE fake-quant ops."""
+    return QuantizationTransformPass(
+        weight_bits, activation_bits, **kwargs
+    ).apply(program, startup_program)
+
+
+def convert_to_test(program):
+    """Freeze for inference: moving-average scales stop updating (reference:
+    QuantizationFreezePass — scales become constants)."""
+    test = program.clone(for_test=True)
+    for b in test.blocks:
+        for op in b.ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                op.attrs["is_test"] = True
+    return test

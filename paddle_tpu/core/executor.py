@@ -204,6 +204,88 @@ def _block_io(block, feed_names, ops=None):
     return scope_inputs, written_persistable
 
 
+_OP_ROLE_OPTIMIZE = 2
+
+
+def _make_microbatched_step(block, ops, feed_names, donated, readonly,
+                            written_persistable, fetch_names, num_mb):
+    """Microbatched step for PipelineOptimizer: the forward+backward region
+    runs once per microbatch (feed dim 0 split into num_mb chunks) with
+    gradients averaged across microbatches, then the optimizer region runs
+    once. The TPU analog of the reference's section pipeline
+    (reference: python/paddle/fluid/optimizer.py:3414 PipelineOptimizer +
+    section_worker.cc:142 — there microbatches flow through scope queues
+    between device sections; here the schedule is unrolled into one XLA
+    computation, and with stage-sharded params under with_parallel the
+    per-stage overlap is GSPMD's to exploit)."""
+    fwd_ops = [
+        op for op in ops if op.attrs.get("op_role", 0) != _OP_ROLE_OPTIMIZE
+    ]
+    opt_ops = [
+        op for op in ops if op.attrs.get("op_role", 0) == _OP_ROLE_OPTIMIZE
+    ]
+    # gradients consumed by optimizer ops get accumulated across microbatches
+    fwd_produced = {n for op in fwd_ops for n in op.output_names()}
+    acc_names = sorted(
+        {
+            n
+            for op in opt_ops
+            for n in op.input_names()
+            if n.endswith("@GRAD") and n in fwd_produced
+        }
+    )
+
+    # float fetches produced per-microbatch (losses/metrics) are averaged
+    # across microbatches so they describe the WHOLE fed batch
+    fwd_fetches = [n for n in fetch_names if n in fwd_produced]
+
+    def step(feed_vals, donated_vals, readonly_vals, rng_key):
+        base_env = dict(zip(donated, donated_vals))
+        base_env.update(zip(readonly, readonly_vals))
+        feeds = dict(zip(feed_names, feed_vals))
+        for n, v in feeds.items():
+            if hasattr(v, "ndim") and v.ndim and v.shape[0] % num_mb != 0:
+                raise EnforceError(
+                    f"feed '{n}' batch dim {v.shape[0]} is not divisible by "
+                    f"num_microbatches={num_mb} — remainder rows would be "
+                    f"silently dropped"
+                )
+        acc = {}
+        fetch_parts = {n: [] for n in fwd_fetches}
+        last_env = None
+        mb_size = 0
+        for m in range(num_mb):
+            env = dict(base_env)
+            for n, v in feeds.items():
+                mb = v.shape[0] // num_mb if hasattr(v, "ndim") and v.ndim else 0
+                env[n] = v[m * mb:(m + 1) * mb] if mb else v
+                mb_size = mb or mb_size
+            _interpret_block(
+                block, env, jax.random.fold_in(rng_key, m), ops=fwd_ops
+            )
+            for n in acc_names:
+                g = env[n]
+                acc[n] = g if m == 0 else acc[n] + g
+            for n in fwd_fetches:
+                fetch_parts[n].append(env[n])
+            last_env = env
+        env = last_env
+        for n in acc_names:
+            env[n] = acc[n] / num_mb
+        _interpret_block(block, env, rng_key, ops=opt_ops)
+        for n, parts in fetch_parts.items():
+            v0 = jnp.asarray(parts[0])
+            if v0.ndim and mb_size and v0.shape[0] == mb_size:
+                env[n] = jnp.concatenate(parts, axis=0)  # per-example fetch
+            elif jnp.issubdtype(v0.dtype, jnp.floating):
+                env[n] = sum(parts) / num_mb  # scalar metric: batch mean
+        fetches = [env[n] for n in fetch_names]
+        updates = [env.get(n) for n in written_persistable]
+        return fetches, updates
+
+    return step
+
+
 class Executor:
     """Feed/fetch driver (reference: python/paddle/fluid/executor.py:432)."""
 
@@ -341,14 +423,21 @@ class Executor:
                 block, feed_names, fetch_names, scope, flags.use_donation
             )
 
-            def step(feed_vals, donated_vals, readonly_vals, rng_key):
-                env = dict(zip(feed_names, feed_vals))
-                env.update(zip(donated, donated_vals))
-                env.update(zip(readonly, readonly_vals))
-                _interpret_block(block, env, rng_key, ops=ops)
-                fetches = [env[n] for n in fetch_names]
-                updates = [env.get(n) for n in written_persistable]
-                return fetches, updates
+            num_mb = getattr(program, "_num_microbatches", 0)
+            if num_mb and num_mb > 1:
+                step = _make_microbatched_step(
+                    block, ops, feed_names, donated, readonly,
+                    written_persistable, fetch_names, num_mb,
+                )
+            else:
+                def step(feed_vals, donated_vals, readonly_vals, rng_key):
+                    env = dict(zip(feed_names, feed_vals))
+                    env.update(zip(donated, donated_vals))
+                    env.update(zip(readonly, readonly_vals))
+                    _interpret_block(block, env, rng_key, ops=ops)
+                    fetches = [env[n] for n in fetch_names]
+                    updates = [env.get(n) for n in written_persistable]
+                    return fetches, updates
 
             compiled = jax.jit(
                 step, donate_argnums=((1,) if donated else ())

@@ -41,7 +41,12 @@ def _decay_step_counter(begin=0):
     # _decay_step_counter creates the var once)
     if not already:
         helper.append_op(
-            "increment", {"X": [counter.name]}, {"Out": [counter.name]}, {"step": 1.0}
+            "increment",
+            {"X": [counter.name]},
+            {"Out": [counter.name]},
+            # optimize role: the counter must tick once per STEP, not once
+            # per microbatch, under PipelineOptimizer's microbatched step
+            {"step": 1.0, "op_role": 2},
         )
     return counter
 

@@ -313,3 +313,76 @@ def _update_loss_scaling(ins, attrs):
         "OutGoodSteps": [new_good.reshape(1)],
         "OutBadSteps": [new_bad.reshape(1)],
     }
+
+
+@register_op("ema_update")
+def _ema_update(ins, attrs):
+    """Shadow-parameter EMA step (reference: python/paddle/fluid/
+    optimizer.py:3166 ExponentialMovingAverage — its in-graph ema ops)."""
+    p, s = first(ins, "Param"), first(ins, "Shadow")
+    decay = attrs.get("decay", 0.999)
+    return {"ShadowOut": [decay * s + (1.0 - decay) * p.astype(s.dtype)]}
+
+
+@register_op("model_average_update")
+def _model_average_update(ins, attrs):
+    """Windowed running parameter sum (reference: python/paddle/fluid/
+    optimizer.py:2862 ModelAverage accumulators). The effective window is
+    clamp(rate * total_updates, min_window, max_window); once `count`
+    reaches it the sum decays geometrically so old snapshots age out — the
+    static-shape analog of the reference's sum_1/2/3 window restarts.
+    Count stores (window_count, total_updates)."""
+    p = first(ins, "Param")
+    s, c = first(ins, "Sum"), first(ins, "Count")
+    rate = attrs.get("rate", 0.15)
+    min_w = attrs.get("min_window", 10000.0)
+    max_w = attrs.get("max_window", 10000.0)
+    cnt = c.reshape(-1)[0]
+    total = c.reshape(-1)[1] if c.size > 1 else cnt
+    window = jnp.clip(rate * (total + 1.0), min_w, max_w)
+    at_cap = cnt >= window
+    new_sum = jnp.where(
+        at_cap, s * (window - 1.0) / window, s
+    ) + p.astype(s.dtype)
+    new_cnt = jnp.minimum(cnt + 1.0, window)
+    out = jnp.stack([new_cnt, total + 1.0])
+    return {"SumOut": [new_sum], "CountOut": [out]}
+
+
+@register_op("dgc_momentum")
+def _dgc_momentum(ins, attrs):
+    """DGC update (reference: paddle/fluid/operators/dgc_op.cc semantics):
+    u = mu*u + g; v += u; select |v| above the sparsity quantile; apply the
+    selected (sparse) update; clear u,v at selected positions (error
+    feedback keeps the rest)."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad").astype(p.dtype)
+    u, v = first(ins, "U"), first(ins, "V")
+    lr = _f32(first(ins, "LearningRate")).reshape(())
+    step = first(ins, "CurrentStep").reshape(())
+    mu = attrs.get("mu", 0.9)
+    begin = attrs.get("rampup_begin_step", 0.0)
+    ramp = max(attrs.get("rampup_step", 1.0), 1.0)
+    sparsity = jnp.asarray(attrs.get("sparsity", [0.999]), jnp.float32)
+    L = sparsity.shape[0]
+
+    u_new = mu * u + g
+    contrib = g + mu * u_new if attrs.get("use_nesterov", False) else u_new
+    # warmup ramp through the sparsity list; before rampup_begin the update
+    # is PLAIN momentum (reference runs the regular momentum op until
+    # rampup_begin_step) — u carries velocity, v stays untouched
+    idx = jnp.clip(((step - begin) * L / ramp).astype(jnp.int32), 0, L - 1)
+    ratio = jnp.where(step < begin, 0.0, jnp.take(sparsity, idx))
+    is_dense = ratio <= 0.0
+    v_acc = v + contrib
+    absv = jnp.abs(v_acc)
+    thr = jnp.quantile(absv.reshape(-1).astype(jnp.float32), ratio)
+    mask = absv >= thr.astype(absv.dtype)
+    update = jnp.where(is_dense, contrib, jnp.where(mask, v_acc, 0.0))
+    u_out = jnp.where(is_dense, u_new, jnp.where(mask, 0.0, u_new))
+    v_out = jnp.where(is_dense, v, jnp.where(mask, 0.0, v_acc))
+    return {
+        "ParamOut": [p - lr.astype(p.dtype) * update],
+        "UOut": [u_out],
+        "VOut": [v_out],
+    }

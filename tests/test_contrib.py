@@ -1,0 +1,204 @@
+"""contrib tests: QAT, DGC, EMA, ModelAverage (reference patterns:
+test_quantization_pass.py, test_dgc_optimizer.py, test_ema.py,
+test_model_average)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import quantize
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def _linreg(lr=0.05, opt=None):
+    x = fluid.data("x", shape=[-1, 8])
+    y = fluid.data("y", shape=[-1, 1])
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def test_ema_shadow_tracks_params(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        _, _, _, loss = _linreg()
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = rng.rand(32, 8).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+    for _ in range(10):
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    scope = fluid.global_scope()
+    pname = main.all_parameters()[0].name
+    raw = np.asarray(scope.find_var(pname))
+    with ema.apply():
+        shadow_applied = np.asarray(scope.find_var(pname))
+    restored = np.asarray(scope.find_var(pname))
+    assert not np.allclose(raw, shadow_applied)  # EMA lags training
+    np.testing.assert_array_equal(raw, restored)  # restored on exit
+    # the shadow should be an average-ish of parameter history: closer to
+    # zero-init than the latest value
+    assert np.abs(shadow_applied).sum() < np.abs(raw).sum() + 1e-6
+
+
+def test_model_average_apply_restore(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        _, _, _, loss = _linreg()
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        avg = fluid.optimizer.ModelAverage(max_average_window=100)
+        avg.minimize_after()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = rng.rand(32, 8).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+    snaps = []
+    for _ in range(5):
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        pname = main.all_parameters()[0].name
+        snaps.append(np.asarray(fluid.global_scope().find_var(pname)))
+    mean = np.mean(snaps, axis=0)
+    with avg.apply():
+        applied = np.asarray(fluid.global_scope().find_var(pname))
+    np.testing.assert_allclose(applied, mean, rtol=1e-5, atol=1e-6)
+
+
+def test_dgc_converges_and_sparsifies(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        _, _, _, loss = _linreg()
+        opt = fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.05, momentum=0.9,
+            rampup_begin_step=3, rampup_step=4, sparsity=[0.5, 0.75],
+        )
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = rng.rand(64, 8).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+    losses = [
+        float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+        for _ in range(40)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # error-feedback accumulator must be non-trivial once sparsity kicks in
+    scope = fluid.global_scope()
+    vnames = [n for n in scope.var_names() if "dgc_v" in n]
+    assert vnames
+
+
+def test_dgc_dense_phase_matches_momentum(rng):
+    """Before rampup_begin_step DGC must equal plain momentum."""
+    x = rng.rand(32, 8).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+
+    def run(opt):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            _, _, _, loss = _linreg()
+            opt().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            return [
+                float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+                for _ in range(5)
+            ]
+
+    ref = run(lambda: fluid.optimizer.Momentum(0.05, 0.9))
+    got = run(lambda: fluid.optimizer.DGCMomentumOptimizer(
+        0.05, 0.9, rampup_begin_step=1000))
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_qat_inserts_fake_quant_and_trains(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 8])
+        y = fluid.data("y", shape=[-1, 1])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        quantize.quantize_program(main, startup)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_dequantize_abs_max" in types          # weights
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types  # acts
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.rand(64, 8).astype("float32")
+    yv = xv.sum(1, keepdims=True).astype("float32")
+    losses = [
+        float(exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0][0])
+        for _ in range(30)
+    ]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # activation scale state must have been learned
+    scope = fluid.global_scope()
+    scales = [n for n in scope.var_names() if ".scale" in n]
+    assert scales and all(
+        float(np.asarray(scope.find_var(n)).reshape(-1)[0]) > 0 for n in scales
+    )
+
+
+def test_qat_convert_freezes_scales(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        pred = fluid.layers.fc(x, size=2)
+        quantize.quantize_program(main, startup)
+    test_prog = quantize.convert_to_test(main)
+    for op in test_prog.global_block().ops:
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            assert op.attrs["is_test"] is True
+    # original program untouched
+    for op in main.global_block().ops:
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            assert not op.attrs.get("is_test", False)
+
+
+def test_quantized_weights_have_limited_levels(rng):
+    """Fake-quantized values must land on <= 2^bits distinct levels."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.contrib.quantize import _fq_abs_max
+
+    x = rng.randn(64, 32).astype("float32")
+    out = np.asarray(
+        _fq_abs_max({"X": [jnp.asarray(x)]}, {"bit_length": 4})["Out"][0]
+    )
+    assert len(np.unique(out)) <= 2 ** 4
+    assert abs(out).max() <= abs(x).max() + 1e-6
+
+
+def test_pipeline_optimizer_matches_large_batch(rng):
+    """Microbatched grad accumulation must match the full-batch step when
+    the loss is a mean over examples (linear model => exact)."""
+    x = rng.rand(32, 8).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+
+    def run(wrap):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            _, _, _, loss = _linreg()
+            opt = fluid.optimizer.SGD(0.1)
+            if wrap:
+                opt = fluid.optimizer.PipelineOptimizer(opt, num_microbatches=4)
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out = []
+            for _ in range(4):
+                exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+                pname = main.all_parameters()[0].name
+            return np.asarray(fluid.global_scope().find_var(pname))
+
+    ref = run(False)
+    got = run(True)
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
