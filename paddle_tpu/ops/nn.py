@@ -10,7 +10,7 @@ their neighbors under whole-block XLA compilation.
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.registry import register_op, register_grad
+from paddle_tpu.core.registry import OpDef, OpRegistry, register_op, register_grad
 from paddle_tpu.ops.common import (
     first,
     maybe,
@@ -391,6 +391,51 @@ def _lookup_table_ps(ins, attrs):
     LookupTableGradKernel) but expressed as dense XLA."""
     rows, idx = first(ins, "Rows"), first(ins, "Idx")
     return {"Out": [jnp.take(rows, idx, axis=0)]}
+
+
+def _sdpa_reference(ins, attrs):
+    """Unfused attention (XLA-fused path): q,k,v [B,H,S,D], optional additive
+    key bias [B,S]."""
+    import math as _math
+
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    bias = first(ins, "Bias") if ins.get("Bias") else None
+    scale = attrs.get("sm_scale") or 1.0 / _math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if attrs.get("causal", False):
+        S = q.shape[2]
+        m = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(m[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return {"Out": [jnp.einsum("bhqk,bhkd->bhqd", p, v)]}
+
+
+def _sdpa_pallas(ins, attrs):
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    bias = first(ins, "Bias") if ins.get("Bias") else None
+    return {
+        "Out": [
+            flash_attention(
+                q, k, v, bias=bias,
+                causal=attrs.get("causal", False),
+                sm_scale=attrs.get("sm_scale"),
+            )
+        ]
+    }
+
+
+OpRegistry.register(
+    OpDef(
+        "scaled_dot_product_attention",
+        _sdpa_reference,
+        pallas=_sdpa_pallas,
+        nondiff_inputs=(),
+    )
+)
 
 
 @register_op("one_hot", nondiff_inputs=("X",))

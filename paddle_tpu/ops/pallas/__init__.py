@@ -1,0 +1,5 @@
+"""Hand-written Pallas TPU kernels overriding jnp lowerings for ops XLA
+fuses poorly (the analog of the reference's hand-fused CUDA kernels,
+reference: paddle/fluid/operators/fused/)."""
+
+from paddle_tpu.ops.pallas import flash_attention  # noqa: F401

@@ -1,0 +1,116 @@
+"""Flash attention kernel + sdpa op tests (CPU interpret mode)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _ref(q, k, v, bias=None, causal=False):
+    scale = 1 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if causal:
+        S = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_flash_matches_reference(rng, causal, with_bias):
+    B, H, S, D = 2, 2, 32, 8
+    q, k, v = [jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+               for _ in range(3)]
+    bias = (
+        jnp.asarray(np.where(rng.rand(B, S) > 0.25, 0, -1e9).astype("float32"))
+        if with_bias else None
+    )
+    out = flash_attention(q, k, v, bias=bias, causal=causal,
+                          block_q=16, block_k=8)
+    ref = _ref(q, k, v, bias, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gradients_match(rng):
+    B, H, S, D = 1, 2, 16, 8
+    q, k, v = [jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+               for _ in range(3)]
+    bias = jnp.zeros((B, S), jnp.float32)
+
+    gf = jax.grad(
+        lambda *a: (flash_attention(*a[:3], bias=a[3], causal=True,
+                                    block_q=8, block_k=8) ** 2).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, bias)
+    gr = jax.grad(
+        lambda *a: (_ref(*a[:3], bias=a[3], causal=True) ** 2).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, bias)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bert_flash_matches_unfused(rng):
+    """BERT with flash attention must match the unfused path when attention
+    dropout is off (the only semantic difference of the fused kernel)."""
+
+    def build(flash):
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_probs_dropout_prob = 0.0
+        cfg.use_flash_attention = flash
+        main, startup, feeds, fetches = bert.build_bert_pretrain(
+            cfg, seq_len=32, lr=1e-3
+        )
+        return cfg, main, startup, fetches
+
+    from paddle_tpu.models import bert
+
+    batch = bert.synthetic_batch(
+        np.random.RandomState(5), 4, 32, bert.BertConfig.tiny()
+    )
+    losses = {}
+    for flash in (False, True):
+        cfg, main, startup, fetches = build(flash)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out = [
+                float(
+                    exe.run(main, feed=batch, fetch_list=[fetches[0]])[0][0]
+                )
+                for _ in range(3)
+            ]
+        losses[flash] = out
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_op_in_program(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        q = fluid.data("q", shape=[-1, 2, 16, 8])
+        k = fluid.data("k", shape=[-1, 2, 16, 8])
+        v = fluid.data("v", shape=[-1, 2, 16, 8])
+        out = fluid.layers.scaled_dot_product_attention(q, k, v, causal=True)
+        loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {n: rng.randn(2, 2, 16, 8).astype("float32") for n in "qkv"}
+    got = exe.run(main, feed=feed, fetch_list=[out, loss])
+    ref = _ref(jnp.asarray(feed["q"]), jnp.asarray(feed["k"]),
+               jnp.asarray(feed["v"]), causal=True)
+    np.testing.assert_allclose(got[0], np.asarray(ref), rtol=1e-5, atol=1e-5)
