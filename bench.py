@@ -3,29 +3,107 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 The reference publishes no in-repo numbers (see BASELINE.md), so vs_baseline
 is reported against the BASELINE.json north-star MFU target (value/target).
+
+Backend robustness (round-1 postmortem: BENCH_r01 was rc=1 because the axon
+TPU backend failed to initialize, and a bare jax.devices() can hang >10 min
+when the chip tunnel stalls): the benchmark body runs in a WATCHDOG
+subprocess with a hard timeout. If the accelerator attempt fails or hangs,
+the bench re-runs forced to CPU with a reduced config. The JSON line is
+always emitted by the orchestrator — on total failure it carries value 0 and
+the diagnostic in "extra".
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+_INNER_ENV = "PADDLE_TPU_BENCH_INNER"
+
+
+def _emit(value, vs_baseline, extra):
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+                "value": value,
+                "unit": "tokens/s",
+                "vs_baseline": vs_baseline,
+                "extra": extra,
+            }
+        )
+    )
+
+
+def _run_inner(force_cpu, timeout):
+    env = dict(os.environ)
+    env[_INNER_ENV] = "1"
+    if force_cpu:
+        env["PADDLE_TPU_FORCE_CPU"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"bench subprocess timed out after {timeout}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                break
+    diag = proc.stderr.strip().splitlines()[-3:] or ["no output"]
+    return None, " | ".join(diag)
+
 
 def main():
-    import jax
+    if os.environ.get(_INNER_ENV):
+        return _bench()
+    # Orchestrate: accelerator attempt under a watchdog, then CPU fallback.
+    result, diag = _run_inner(force_cpu=False, timeout=900)
+    if result is not None:
+        print(json.dumps(result))
+        return
+    tpu_diag = diag
+    result, diag = _run_inner(force_cpu=True, timeout=900)
+    if result is not None:
+        result.setdefault("extra", {})["backend_diag"] = (
+            f"accelerator attempt failed ({tpu_diag}); ran on CPU"
+        )
+        print(json.dumps(result))
+        return
+    _emit(0.0, 0.0, {"error_tpu": tpu_diag, "error_cpu": diag})
+    sys.exit(1)
+
+
+def _bench():
+    from paddle_tpu.core.places import ensure_backend_or_cpu
+
+    on_tpu, diag = ensure_backend_or_cpu()
+
+    import jax  # noqa: F401  (backend decision made above)
 
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else (256 if on_tpu else 8)
     seq_len = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    steps = 10 if on_tpu else 2
+    if not on_tpu:
+        # CPU fallback must finish inside the watchdog even when the caller
+        # passed TPU-sized args: cap batch, keep the metric shape identical
+        batch = min(batch, 8)
     cfg = bert.BertConfig.base()
 
-    # bf16 AMP is the TPU-native default posture (SURVEY §7: AMP row —
-    # bf16-first policy; measured +11% tokens/s over f32 on v5e at this
-    # config with identical loss). PADDLE_TPU_BENCH_FP32=1 reverts.
+    # bf16 AMP is the TPU-native default posture (SURVEY §7: bf16-first
+    # policy). PADDLE_TPU_BENCH_FP32=1 reverts to f32 for comparison runs.
     use_amp = not os.environ.get("PADDLE_TPU_BENCH_FP32")
     main_prog, startup, feeds, fetches = bert.build_bert_pretrain(
         cfg, seq_len=seq_len, lr=1e-4, use_amp=use_amp
@@ -37,11 +115,12 @@ def main():
 
     # warmup (compile)
     for _ in range(2):
-        exe.run(main_prog, feed=data, fetch_list=[fetches[0]])
-    steps = 10
+        out = exe.run(main_prog, feed=data, fetch_list=[fetches[0]])
+    np.asarray(out[0])  # force sync before the timed region
     t0 = time.perf_counter()
     for _ in range(steps):
         out = exe.run(main_prog, feed=data, fetch_list=[fetches[0]])
+    final_loss = float(np.asarray(out[0]).reshape(-1)[0])  # sync point
     dt = time.perf_counter() - t0
     tokens_per_sec = steps * batch * seq_len / dt
 
@@ -51,25 +130,21 @@ def main():
     )
     flops_per_token = 6 * n_params
     achieved = tokens_per_sec * flops_per_token
-    peak = _chip_peak_flops()
+    peak = _chip_peak_flops() if on_tpu else 0.0
     mfu = achieved / peak if peak else 0.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(mfu / 0.5, 4),  # vs the >=50% MFU north star
-                "extra": {
-                    "batch": batch,
-                    "seq_len": seq_len,
-                    "params": n_params,
-                    "mfu_est": round(mfu, 4),
-                    "final_loss": float(np.asarray(out[0]).reshape(-1)[0]),
-                },
-            }
-        )
+    _emit(
+        round(tokens_per_sec, 1),
+        round(mfu / 0.5, 4),  # vs the >=50% MFU north star
+        {
+            "device": "tpu" if on_tpu else "cpu",
+            "backend_diag": diag,
+            "batch": batch,
+            "seq_len": seq_len,
+            "params": n_params,
+            "mfu_est": round(mfu, 4),
+            "final_loss": final_loss,
+        },
     )
 
 

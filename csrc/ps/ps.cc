@@ -197,6 +197,31 @@ void handle_conn(Server* srv, int fd) {
     uint8_t cmd = read_pod<uint8_t>(p);
     uint32_t table_id = read_pod<uint32_t>(p);
 
+    // validate the per-command fixed header BEFORE any read_pod touches it:
+    // a frame long enough for cmd+table_id but shorter than the command's
+    // fields would otherwise advance p past the body and make later
+    // (end - p) remaining-size math underflow to a huge unsigned value
+    {
+      uint64_t avail = static_cast<uint64_t>(body.data() + body.size() - p);
+      uint64_t fixed_need = 0;
+      switch (cmd) {
+        case kCreateTable: fixed_need = 18; break;  // u8+u32+u64+f32+u8
+        case kPullSparse:
+        case kShrink: fixed_need = 8; break;
+        case kPushSparse:
+        case kPushDense: fixed_need = 12; break;  // f32 lr + u64 n
+        case kSave:
+        case kLoad:
+        case kBarrier:
+        case kHeartbeat: fixed_need = 4; break;
+        default: break;
+      }
+      if (avail < fixed_need) {
+        send_response(fd, 1, "truncated request");
+        continue;
+      }
+    }
+
     if (cmd == kStop) {
       send_response(fd, 0, "");
       {
@@ -248,6 +273,11 @@ void handle_conn(Server* srv, int fd) {
     switch (cmd) {
       case kPullSparse: {
         uint64_t n = read_pod<uint64_t>(p);
+        // never trust wire counts: n ids must fit in the remaining body
+        if (n > static_cast<uint64_t>(body.data() + body.size() - p) / 8) {
+          send_response(fd, 1, "pull_sparse: id count exceeds body");
+          break;
+        }
         std::string out;
         out.reserve(n * t->dim * 4);
         {
@@ -271,6 +301,14 @@ void handle_conn(Server* srv, int fd) {
       case kPushSparse: {
         float lr = read_pod<float>(p);
         uint64_t n = read_pod<uint64_t>(p);
+        // n ids (8B each) + n*dim grads (4B each) must fit in the body;
+        // division form avoids u64 overflow for hostile n/dim
+        uint64_t remain = static_cast<uint64_t>(body.data() + body.size() - p);
+        if (n > remain / 8 ||
+            (t->dim && (remain - n * 8) / 4 / t->dim < n)) {
+          send_response(fd, 1, "push_sparse: payload exceeds body");
+          break;
+        }
         const char* ids_p = p;
         const char* grads_p = p + n * 8;
         std::unique_lock<std::shared_mutex> lk(t->mu);
@@ -305,7 +343,8 @@ void handle_conn(Server* srv, int fd) {
         float lr = read_pod<float>(p);
         uint64_t n = read_pod<uint64_t>(p);
         std::unique_lock<std::shared_mutex> lk(t->mu);
-        if (n != t->dense.size()) {
+        if (n != t->dense.size() ||
+            n * 4 > static_cast<uint64_t>(body.data() + body.size() - p)) {
           send_response(fd, 1, "dense size mismatch");
           break;
         }
@@ -317,6 +356,10 @@ void handle_conn(Server* srv, int fd) {
       }
       case kSave: {
         uint32_t plen = read_pod<uint32_t>(p);
+        if (plen > static_cast<uint64_t>(body.data() + body.size() - p)) {
+          send_response(fd, 1, "truncated path");
+          break;
+        }
         std::string path(p, plen);
         std::shared_lock<std::shared_mutex> lk(t->mu);
         FILE* f = fopen(path.c_str(), "wb");
@@ -348,6 +391,10 @@ void handle_conn(Server* srv, int fd) {
       }
       case kLoad: {
         uint32_t plen = read_pod<uint32_t>(p);
+        if (plen > static_cast<uint64_t>(body.data() + body.size() - p)) {
+          send_response(fd, 1, "truncated path");
+          break;
+        }
         std::string path(p, plen);
         std::unique_lock<std::shared_mutex> lk(t->mu);
         FILE* f = fopen(path.c_str(), "rb");

@@ -152,7 +152,7 @@ class CompiledProgram:
             (n, tuple(feed_arrays[n].shape), str(np.asarray(feed_arrays[n]).dtype))
             for n in feed_names
         )
-        key = (id(self._program), self._program._version, feed_sig, tuple(fetch_names))
+        key = (self._program._uid, self._program._version, feed_sig, tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
             donated, readonly, written, live = plan_step(

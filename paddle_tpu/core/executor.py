@@ -268,6 +268,13 @@ def _make_microbatched_step(block, ops, feed_names, donated, readonly,
                 acc[n] = g if m == 0 else acc[n] + g
             for n in fwd_fetches:
                 fetch_parts[n].append(env[n])
+            # forward-written persistables (batch-norm moving stats, streaming
+            # metric accumulators) must chain across microbatches, not reset
+            # to base_env each time — the reference's section pipeline updates
+            # shared-scope persistables every microbatch
+            for n in written_persistable:
+                if n in env:
+                    base_env[n] = env[n]
             last_env = env
         env = last_env
         for n in acc_names:
@@ -416,7 +423,7 @@ class Executor:
         feed_sig = tuple(
             (n, feed_arrays[n].shape, str(feed_arrays[n].dtype)) for n in feed_names
         )
-        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        key = (program._uid, program._version, feed_sig, tuple(fetch_names))
         entry = self._cache.get(key)
         if entry is None:
             donated, readonly, written_persistable, ops = plan_step(

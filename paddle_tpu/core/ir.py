@@ -17,6 +17,7 @@ keeping Fluid's central idea that training features are program transforms.
 
 import contextlib
 import copy
+import itertools
 import json
 
 import numpy as np
@@ -325,11 +326,16 @@ class Program:
     (reference: paddle/fluid/framework/program_desc.h:30,
     reference: python/paddle/fluid/framework.py:3602)."""
 
+    # monotonic per-process program ids: compile caches key on this instead
+    # of id(program), which CPython reuses after GC
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self._rng_op_counter = 0
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self._version = 0
+        self._uid = next(Program._uid_counter)
         self._seed = 0
         self.random_seed = 0
         self._is_distributed = False
@@ -386,6 +392,7 @@ class Program:
             }
         )
         p._attrs = dict(self._attrs)
+        p._uid = next(Program._uid_counter)
         p.blocks = []
         old_params = {
             v.name for v in self.global_block().vars.values() if isinstance(v, Parameter)

@@ -8,6 +8,7 @@ goes through the PJRT client that jax exposes rather than the CUDA driver.
 """
 
 import functools
+import os
 
 
 class Place:
@@ -56,15 +57,81 @@ class TPUPlace(Place):
 
 @functools.lru_cache(maxsize=None)
 def _platforms():
+    import os
+
     import jax
 
+    if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        return {"cpu"}
     return {d.platform for d in jax.devices()}
 
 
 def _accelerator_devices():
+    import os
+
     import jax
 
+    if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+        # Honor the force-CPU escape hatch everywhere: a stalled TPU tunnel
+        # makes a bare jax.devices() hang, so never probe accelerators.
+        jax.config.update("jax_platforms", "cpu")
+        return []
     return [d for d in jax.devices() if d.platform != "cpu"]
+
+
+_PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform, getattr(d[0], 'device_kind', ''))"
+)
+
+
+def probe_accelerator(timeout=150, retries=2):
+    """Check — in a subprocess, so a hung backend cannot take this process
+    down — whether an accelerator backend initializes. A stalled TPU tunnel
+    makes a bare jax.devices() hang >10 min. Returns (ok, diagnostic)."""
+    import subprocess
+    import sys
+    import time
+
+    if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+        return False, "PADDLE_TPU_FORCE_CPU set"
+    last = ""
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(5)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"backend probe timed out after {timeout}s (attempt {attempt + 1})"
+            continue
+        out = proc.stdout.strip()
+        if proc.returncode == 0 and out and not out.startswith("cpu"):
+            return True, out
+        last = (proc.stderr.strip().splitlines() or [out or "no output"])[-1]
+    return False, last
+
+
+def force_cpu_platform():
+    """Pin this process to the CPU backend. Must run before the first backend
+    probe; the axon plugin ignores JAX_PLATFORMS so the config API is used."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_backend_or_cpu(timeout=150, retries=2):
+    """Probe the accelerator; on failure pin this process to CPU.
+    Returns (on_accelerator, diagnostic)."""
+    ok, diag = probe_accelerator(timeout=timeout, retries=retries)
+    if not ok:
+        force_cpu_platform()
+    return ok, diag
 
 
 def is_compiled_with_tpu():

@@ -212,6 +212,13 @@ class DatasetBase:
         self._emit_lengths = False
         self._loaded = False
         self._pad_to = {}
+        self._truncated_rows = {}
+        self._warned_truncate = set()
+
+    def truncated_row_counts(self):
+        """Per-slot count of rows whose tokens were dropped by pad_to
+        truncation (visible data loss, never silent)."""
+        return dict(self._truncated_rows)
 
     # -- configuration (reference: dataset.py DatasetBase) -----------------
     def set_batch_size(self, batch_size):
@@ -285,6 +292,22 @@ class DatasetBase:
                     if arr.shape[1] < want:
                         arr = np.pad(arr, [(0, 0), (0, want - arr.shape[1])])
                     elif arr.shape[1] > want:
+                        # truncation drops real tokens — make the data loss
+                        # visible (once per slot) instead of silent
+                        self._truncated_rows[s.name] = self._truncated_rows.get(
+                            s.name, 0
+                        ) + int(np.sum(lens > want))
+                        if s.name not in self._warned_truncate:
+                            self._warned_truncate.add(s.name)
+                            import warnings
+
+                            warnings.warn(
+                                f"slot '{s.name}': batch length {arr.shape[1]} "
+                                f"exceeds pad_to={want}; truncating (tokens are "
+                                "dropped — raise pad_to to keep them). "
+                                "Truncated-row counts accumulate in "
+                                "dataset.truncated_row_counts()."
+                            )
                         arr = arr[:, :want]
                 out[s.name] = arr
                 if self._emit_lengths and s.length < 0:

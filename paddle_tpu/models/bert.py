@@ -93,6 +93,16 @@ def multi_head_attention(x, attn_bias, cfg, name):
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     if getattr(cfg, "use_flash_attention", False):
+        if getattr(cfg, "attention_probs_dropout_prob", 0.0):
+            import warnings
+
+            warnings.warn(
+                "use_flash_attention=True skips attention-prob dropout "
+                f"(attention_probs_dropout_prob="
+                f"{cfg.attention_probs_dropout_prob} is ignored); set it to "
+                "0 or disable the flash path for identical regularization",
+                stacklevel=2,
+            )
         # attn_bias here is [B,1,1,S]; the fused op takes [B,S]
         flat_bias = fluid.layers.reshape(attn_bias, [0, attn_bias.shape[-1]])
         ctx = fluid.layers.scaled_dot_product_attention(

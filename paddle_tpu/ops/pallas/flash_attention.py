@@ -171,7 +171,11 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         S = q.shape[2]
         mask = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(mask[None, None], s, _NEG)
-    p = jnp.exp(s - lse[..., None])
+    # a fully-masked row has lse == _NEG, making exp(s - lse) blow up; its
+    # forward output was 0, so its gradient contribution must be 0 too
+    p = jnp.where(
+        (lse <= _NEG / 2)[..., None], 0.0, jnp.exp(s - lse[..., None])
+    )
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
     dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
     delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)

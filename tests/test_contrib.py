@@ -202,3 +202,36 @@ def test_pipeline_optimizer_matches_large_batch(rng):
     ref = run(False)
     got = run(True)
     np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_persistables_chain_across_microbatches(rng):
+    """Forward-written persistables (batch-norm moving stats) must see every
+    microbatch, chaining mb-to-mb like the reference's shared-scope section
+    pipeline — not reset so only the last microbatch's update survives."""
+    num_mb, mb_sz, feat = 4, 8, 3
+    momentum = 0.5
+    x = rng.rand(num_mb * mb_sz, feat).astype("float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [num_mb * mb_sz, feat])
+        h = fluid.layers.batch_norm(
+            xv, momentum=momentum, moving_mean_name="pipe_mm"
+        )
+        loss = fluid.layers.reduce_mean(h)
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.0), num_microbatches=num_mb
+        )
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": x}, fetch_list=[loss])
+        got = np.asarray(fluid.global_scope().find_var("pipe_mm"))
+
+    # reference: chain the moving-mean update through every microbatch
+    mm = np.zeros(feat, "float32")
+    for m in range(num_mb):
+        bmean = x[m * mb_sz:(m + 1) * mb_sz].mean(0)
+        mm = mm * momentum + bmean * (1 - momentum)
+    np.testing.assert_allclose(got, mm, rtol=1e-5, atol=1e-6)
