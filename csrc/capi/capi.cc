@@ -1,0 +1,316 @@
+// C ABI for the paddle_tpu inference predictor (see paddle_tpu_capi.h).
+//
+// reference: paddle/fluid/inference/capi/c_api.cc, pd_predictor.cc — the
+// same serve-from-C surface, TPU-native edition: this library embeds
+// CPython and drives paddle_tpu.inference.capi_bridge, which owns the
+// AOT-compiled XLA executables. Only primitive types cross the C↔Python
+// boundary (strings, ints, memoryviews, bytes).
+//
+// Threading: Py_Initialize happens once; afterwards the GIL is released and
+// every API call brackets itself with PyGILState_Ensure/Release, so the C
+// API is safe to call from any host thread (including Go runtime threads).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <dlfcn.h>
+#include <libgen.h>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "paddle_tpu_capi.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// repo root derived from this library's own path (csrc/capi/libcapi.so →
+// two directories up), so the embedded interpreter can import paddle_tpu
+// without the host process knowing where it lives
+std::string repo_root() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void*>(&PD_GetLastError), &info) &&
+      info.dli_fname) {
+    std::string p(info.dli_fname);
+    for (int i = 0; i < 3; ++i) {
+      auto pos = p.find_last_of('/');
+      if (pos == std::string::npos) break;
+      p.erase(pos);
+    }
+    return p;
+  }
+  return ".";
+}
+
+PyObject* g_bridge = nullptr;  // paddle_tpu.inference.capi_bridge
+
+bool ensure_python() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, [] {
+    bool initialized_here = false;
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      initialized_here = true;
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    // prepend the repo root so `import paddle_tpu` resolves
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* root = PyUnicode_FromString(repo_root().c_str());
+    if (sys_path && root) PyList_Insert(sys_path, 0, root);
+    Py_XDECREF(root);
+    g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (!g_bridge) {
+      set_error_from_python();
+    } else {
+      ok = true;
+    }
+    PyGILState_Release(g);
+    // When THIS library booted the interpreter, the boot thread still holds
+    // the GIL from Py_InitializeEx: drop it for the process lifetime so API
+    // calls (from any host thread) can re-take it. When loaded into an
+    // existing interpreter (e.g. ctypes), the host owns GIL discipline.
+    if (ok && initialized_here) PyEval_SaveThread();
+  });
+  return ok;
+}
+
+// call bridge.<fn>(args...); returns new reference or nullptr (error set)
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (!f) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+struct GIL {
+  PyGILState_STATE state;
+  GIL() : state(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+struct PD_AnalysisConfig {
+  std::string model_dir;
+  std::string prog_file;
+  std::string params_file;
+  bool use_tpu = true;
+  int device_id = 0;
+  bool ir_optim = true;
+  bool bf16 = false;
+};
+
+struct PD_Predictor {
+  PyObject* obj = nullptr;           // bridge Predictor
+  std::vector<std::string> inputs;   // cached names (stable char*)
+  std::vector<std::string> outputs;
+};
+
+extern "C" {
+
+PD_AnalysisConfig* PD_NewAnalysisConfig(void) { return new PD_AnalysisConfig; }
+
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* c) { delete c; }
+
+void PD_SetModel(PD_AnalysisConfig* c, const char* model_path,
+                 const char* params_path) {
+  if (params_path && *params_path) {
+    c->prog_file = model_path;
+    c->params_file = params_path;
+    c->model_dir.clear();
+  } else {
+    c->model_dir = model_path;
+    c->prog_file.clear();
+    c->params_file.clear();
+  }
+}
+
+void PD_EnableTPU(PD_AnalysisConfig* c, int device_id) {
+  c->use_tpu = true;
+  c->device_id = device_id;
+}
+
+void PD_DisableTPU(PD_AnalysisConfig* c) { c->use_tpu = false; }
+
+void PD_SwitchIrOptim(PD_AnalysisConfig* c, int enable) {
+  c->ir_optim = enable != 0;
+}
+
+void PD_EnableBf16(PD_AnalysisConfig* c) { c->bf16 = true; }
+
+static bool fill_names(PD_Predictor* p) {
+  for (int which = 0; which < 2; ++which) {
+    PyObject* names = bridge_call(which ? "output_names" : "input_names",
+                                  Py_BuildValue("(O)", p->obj));
+    if (!names) return false;
+    auto& dst = which ? p->outputs : p->inputs;
+    Py_ssize_t n = PyList_Size(names);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+      dst.push_back(s ? s : "");
+    }
+    Py_DECREF(names);
+  }
+  return true;
+}
+
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* c) {
+  if (!ensure_python()) return nullptr;
+  GIL gil;
+  PyObject* obj = bridge_call(
+      "new_predictor",
+      Py_BuildValue("(sssiiii)", c->model_dir.c_str(), c->prog_file.c_str(),
+                    c->params_file.c_str(), c->use_tpu ? 1 : 0, c->device_id,
+                    c->ir_optim ? 1 : 0, c->bf16 ? 1 : 0));
+  if (!obj) return nullptr;
+  auto* p = new PD_Predictor;
+  p->obj = obj;
+  if (!fill_names(p)) {
+    Py_DECREF(p->obj);
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+PD_Predictor* PD_ClonePredictor(const PD_Predictor* src) {
+  if (!ensure_python()) return nullptr;
+  GIL gil;
+  PyObject* obj =
+      bridge_call("clone_predictor", Py_BuildValue("(O)", src->obj));
+  if (!obj) return nullptr;
+  auto* p = new PD_Predictor;
+  p->obj = obj;
+  p->inputs = src->inputs;
+  p->outputs = src->outputs;
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (!p) return;
+  if (p->obj) {
+    GIL gil;
+    Py_DECREF(p->obj);
+  }
+  delete p;
+}
+
+int PD_GetInputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->inputs.size());
+}
+
+int PD_GetOutputNum(const PD_Predictor* p) {
+  return static_cast<int>(p->outputs.size());
+}
+
+const char* PD_GetInputName(const PD_Predictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->inputs.size())) return nullptr;
+  return p->inputs[i].c_str();
+}
+
+const char* PD_GetOutputName(const PD_Predictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->outputs.size())) return nullptr;
+  return p->outputs[i].c_str();
+}
+
+int PD_SetInput(PD_Predictor* p, const char* name, PD_DataType dtype,
+                const int64_t* shape, int ndim, const void* data) {
+  static const size_t kItem[] = {4, 4, 8, 1};
+  if (dtype < 0 || static_cast<size_t>(dtype) >= sizeof(kItem) / sizeof(*kItem)) {
+    g_last_error = "PD_SetInput: invalid PD_DataType";
+    return 1;
+  }
+  GIL gil;
+  size_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(shape[i]);
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(n * kItem[dtype]), PyBUF_READ);
+  PyObject* out = bridge_call(
+      "set_input",
+      Py_BuildValue("(OsiNN)", p->obj, name, static_cast<int>(dtype), shp, mv));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  GIL gil;
+  PyObject* out = bridge_call("run", Py_BuildValue("(O)", p->obj));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int PD_GetOutput(PD_Predictor* p, const char* name, PD_DataType* dtype,
+                 int64_t** shape, int* ndim, void** data, size_t* nbytes) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("get_output", Py_BuildValue("(Os)", p->obj, name));
+  if (!out) return 1;
+  int dt = 0;
+  PyObject *shp = nullptr, *raw = nullptr;
+  if (!PyArg_ParseTuple(out, "iOO", &dt, &shp, &raw)) {
+    set_error_from_python();
+    Py_DECREF(out);
+    return 1;
+  }
+  *dtype = static_cast<PD_DataType>(dt);
+  *ndim = static_cast<int>(PyTuple_Size(shp));
+  *shape = static_cast<int64_t*>(malloc(sizeof(int64_t) * (*ndim)));
+  for (int i = 0; i < *ndim; ++i) {
+    (*shape)[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
+    set_error_from_python();
+    free(*shape);
+    Py_DECREF(out);
+    return 1;
+  }
+  *data = malloc(static_cast<size_t>(len));
+  memcpy(*data, buf, static_cast<size_t>(len));
+  *nbytes = static_cast<size_t>(len);
+  Py_DECREF(out);
+  return 0;
+}
+
+void PD_Free(void* ptr) { free(ptr); }
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

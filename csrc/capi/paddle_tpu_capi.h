@@ -1,0 +1,71 @@
+/* C ABI for the paddle_tpu inference predictor.
+ *
+ * reference: paddle/fluid/inference/capi/paddle_c_api.h — same role
+ * (serve a saved inference model from C/Go hosts), re-based on the
+ * TPU-native predictor: the library embeds CPython, which drives the
+ * AOT-compiled XLA executables. Thread-safe: every call takes the GIL.
+ *
+ * Lifetime: buffers returned via PD_GetOutput are malloc'd; release them
+ * with PD_Free. All functions returning int use 0 = success, nonzero =
+ * failure (then PD_GetLastError() describes it).
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_UINT8 = 3,
+} PD_DataType;
+
+typedef struct PD_AnalysisConfig PD_AnalysisConfig;
+typedef struct PD_Predictor PD_Predictor;
+
+/* -- config (reference: pd_config.cc) ---------------------------------- */
+PD_AnalysisConfig* PD_NewAnalysisConfig(void);
+void PD_DeleteAnalysisConfig(PD_AnalysisConfig* config);
+/* model_dir layout (__model__/__params__): pass params_path = NULL.
+ * file layout: pass both paths. */
+void PD_SetModel(PD_AnalysisConfig* config, const char* model_path,
+                 const char* params_path);
+void PD_EnableTPU(PD_AnalysisConfig* config, int device_id);
+void PD_DisableTPU(PD_AnalysisConfig* config);
+void PD_SwitchIrOptim(PD_AnalysisConfig* config, int enable);
+void PD_EnableBf16(PD_AnalysisConfig* config);
+
+/* -- predictor (reference: pd_predictor.cc) ---------------------------- */
+PD_Predictor* PD_NewPredictor(const PD_AnalysisConfig* config);
+PD_Predictor* PD_ClonePredictor(const PD_Predictor* predictor);
+void PD_DeletePredictor(PD_Predictor* predictor);
+
+int PD_GetInputNum(const PD_Predictor* predictor);
+int PD_GetOutputNum(const PD_Predictor* predictor);
+/* returned name is owned by the predictor; valid until it is deleted */
+const char* PD_GetInputName(const PD_Predictor* predictor, int index);
+const char* PD_GetOutputName(const PD_Predictor* predictor, int index);
+
+/* copy `data` (dtype/shape as declared) into the named input slot */
+int PD_SetInput(PD_Predictor* predictor, const char* name, PD_DataType dtype,
+                const int64_t* shape, int ndim, const void* data);
+int PD_PredictorRun(PD_Predictor* predictor);
+/* fetch the named output: *data is malloc'd (PD_Free), *shape is malloc'd
+ * (PD_Free), *ndim / *dtype / *nbytes describe it */
+int PD_GetOutput(PD_Predictor* predictor, const char* name,
+                 PD_DataType* dtype, int64_t** shape, int* ndim, void** data,
+                 size_t* nbytes);
+
+void PD_Free(void* ptr);
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,36 @@
+// Demo/smoke host for the Go binding: load a model dir, run one batch.
+// usage: go run main.go <model_dir>
+package main
+
+import (
+	"fmt"
+	"os"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	cfg := paddle.NewConfig()
+	cfg.SetModel(os.Args[1], "")
+	cfg.DisableTPU()
+	cfg.SwitchIrOptim(true)
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		panic(err)
+	}
+	in := &paddle.Tensor{Shape: []int64{2, 6}, Data: make([]float32, 12)}
+	for i := range in.Data {
+		in.Data[i] = float32(i) * 0.1
+	}
+	if err := pred.SetInput(pred.InputNames()[0], in); err != nil {
+		panic(err)
+	}
+	if err := pred.Run(); err != nil {
+		panic(err)
+	}
+	out, err := pred.GetOutput(pred.OutputNames()[0])
+	if err != nil {
+		panic(err)
+	}
+	fmt.Println("ok", out.Shape, out.Data)
+}

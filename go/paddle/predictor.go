@@ -1,0 +1,167 @@
+// Package paddle: Go binding for the paddle_tpu inference C ABI.
+//
+// reference: go/paddle/predictor.go in the reference repo — the same
+// train-in-Python / serve-from-Go workflow, re-based on the TPU-native
+// predictor (the C library embeds CPython driving AOT-compiled XLA
+// executables; see csrc/capi/paddle_tpu_capi.h).
+//
+// Build: point cgo at csrc/capi, e.g.
+//
+//	CGO_CFLAGS="-I/path/to/repo/csrc/capi" \
+//	CGO_LDFLAGS="-L/path/to/repo/csrc/capi -lcapi -Wl,-rpath,/path/to/repo/csrc/capi" \
+//	go build ./...
+package paddle
+
+// #include <stdlib.h>
+// #include <paddle_tpu_capi.h>
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// DataType mirrors PD_DataType.
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int32
+	Int64
+	Uint8
+)
+
+// Config mirrors AnalysisConfig (reference: go/paddle/config.go).
+type Config struct {
+	c *C.PD_AnalysisConfig
+}
+
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_NewAnalysisConfig()}
+	runtime.SetFinalizer(cfg, func(c *Config) { C.PD_DeleteAnalysisConfig(c.c) })
+	return cfg
+}
+
+// SetModel points at a save_inference_model directory (params == "") or an
+// explicit (model file, params file) pair.
+func (cfg *Config) SetModel(model, params string) {
+	cm := C.CString(model)
+	defer C.free(unsafe.Pointer(cm))
+	if params == "" {
+		C.PD_SetModel(cfg.c, cm, nil)
+		return
+	}
+	cp := C.CString(params)
+	defer C.free(unsafe.Pointer(cp))
+	C.PD_SetModel(cfg.c, cm, cp)
+}
+
+func (cfg *Config) EnableTPU(deviceID int) { C.PD_EnableTPU(cfg.c, C.int(deviceID)) }
+func (cfg *Config) DisableTPU()            { C.PD_DisableTPU(cfg.c) }
+func (cfg *Config) SwitchIrOptim(on bool) {
+	v := C.int(0)
+	if on {
+		v = 1
+	}
+	C.PD_SwitchIrOptim(cfg.c, v)
+}
+func (cfg *Config) EnableBf16() { C.PD_EnableBf16(cfg.c) }
+
+// Tensor is a host-side value crossing the binding.
+type Tensor struct {
+	Shape []int64
+	Data  []float32 // Float32-only convenience surface; extend as needed
+}
+
+// Predictor mirrors the reference's paddle.Predictor.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+func lastError() error {
+	return fmt.Errorf("paddle_tpu: %s", C.GoString(C.PD_GetLastError()))
+}
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_NewPredictor(cfg.c)
+	if p == nil {
+		return nil, lastError()
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, func(p *Predictor) { C.PD_DeletePredictor(p.c) })
+	return pred, nil
+}
+
+// Clone shares weights and compiled executables (thread-per-predictor).
+func (p *Predictor) Clone() (*Predictor, error) {
+	c := C.PD_ClonePredictor(p.c)
+	if c == nil {
+		return nil, lastError()
+	}
+	twin := &Predictor{c: c}
+	runtime.SetFinalizer(twin, func(p *Predictor) { C.PD_DeletePredictor(p.c) })
+	return twin, nil
+}
+
+func (p *Predictor) InputNames() []string {
+	n := int(C.PD_GetInputNum(p.c))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+	}
+	return out
+}
+
+func (p *Predictor) OutputNames() []string {
+	n := int(C.PD_GetOutputNum(p.c))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
+	}
+	return out
+}
+
+func (p *Predictor) SetInput(name string, t *Tensor) error {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	rc := C.PD_SetInput(p.c, cn, C.PD_FLOAT32,
+		(*C.int64_t)(unsafe.Pointer(&t.Shape[0])), C.int(len(t.Shape)),
+		unsafe.Pointer(&t.Data[0]))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (p *Predictor) Run() error {
+	if C.PD_PredictorRun(p.c) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (p *Predictor) GetOutput(name string) (*Tensor, error) {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	var dt C.PD_DataType
+	var shape *C.int64_t
+	var ndim C.int
+	var data unsafe.Pointer
+	var nbytes C.size_t
+	if C.PD_GetOutput(p.c, cn, &dt, &shape, &ndim, &data, &nbytes) != 0 {
+		return nil, lastError()
+	}
+	defer C.PD_Free(unsafe.Pointer(shape))
+	defer C.PD_Free(data)
+	if dt != C.PD_FLOAT32 {
+		return nil, fmt.Errorf("paddle_tpu: output %q is not float32", name)
+	}
+	t := &Tensor{
+		Shape: make([]int64, int(ndim)),
+		Data:  make([]float32, int(nbytes)/4),
+	}
+	copy(t.Shape, unsafe.Slice((*int64)(unsafe.Pointer(shape)), int(ndim)))
+	copy(t.Data, unsafe.Slice((*float32)(data), int(nbytes)/4))
+	return t, nil
+}

@@ -48,6 +48,8 @@ from paddle_tpu import metrics
 from paddle_tpu import profiler
 from paddle_tpu import debugger
 from paddle_tpu import fleet
+from paddle_tpu import inference
+from paddle_tpu import passes
 
 
 class FetchHandler:

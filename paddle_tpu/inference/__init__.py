@@ -1,0 +1,29 @@
+"""Inference/serving stack: analysis passes + AOT-compiled predictor.
+
+TPU-native replacement for the reference's 27k-LoC inference engine
+(reference: paddle/fluid/inference/api/analysis_predictor.h:47,
+paddle_inference_api.h): where the reference rewrote the graph with 30+
+fusion passes and ran it op-by-op through a NaiveExecutor, here the analysis
+passes are semantic rewrites (DCE, test-mode, bf16, constant folding) and
+the whole pruned program is AOT-lowered to ONE XLA executable per input
+shape — fusion, layout, and scheduling are XLA's job. Zero-copy means feeds
+go straight to device buffers and weights stay device-resident across calls.
+
+C/Go bindings over this module live in csrc/capi and go/paddle.
+"""
+
+from paddle_tpu.inference.predictor import (
+    Config,
+    PrecisionType,
+    Predictor,
+    Tensor,
+    create_predictor,
+)
+
+__all__ = [
+    "Config",
+    "PrecisionType",
+    "Predictor",
+    "Tensor",
+    "create_predictor",
+]

@@ -1,0 +1,38 @@
+"""Build helper for the inference C ABI (csrc/capi/capi.cc).
+
+`build_capi()` compiles libcapi.so (embedding CPython) on first use via the
+same compile-on-demand machinery as the other native components, and returns
+its path for C/Go hosts to link against. reference:
+paddle/fluid/inference/capi/CMakeLists.txt (there: part of the superbuild).
+"""
+
+import os
+import sysconfig
+
+from paddle_tpu.utils.native import _CSRC, load_native
+
+
+def python_embed_flags():
+    """Compiler/linker flags to embed this interpreter."""
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ldver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    return [
+        f"-I{inc}",
+        f"-L{libdir}",
+        f"-lpython{ldver}",
+        f"-Wl,-rpath,{libdir}",
+        "-ldl",
+    ]
+
+
+def build_capi():
+    """Compile (if stale) and return the path to libcapi.so."""
+    load_native("capi", extra_flags=python_embed_flags())
+    return os.path.join(_CSRC, "capi", "libcapi.so")
+
+
+def header_path():
+    return os.path.join(_CSRC, "capi", "paddle_tpu_capi.h")

@@ -1,0 +1,68 @@
+"""Python side of the inference C ABI (csrc/capi/capi.cc).
+
+The C library embeds CPython and calls ONLY the flat functions here with
+primitive types (str/int/bool/memoryview/bytes) — keeping the C side small
+and the conversion logic testable from Python.
+reference: paddle/fluid/inference/capi/c_api.cc + pd_predictor.cc (there the
+C API wrapped the C++ predictor directly; here it bridges to the Python
+predictor that owns the XLA executables).
+"""
+
+import numpy as np
+
+from paddle_tpu.inference.predictor import Config, Predictor
+
+_DTYPES = ["float32", "int32", "int64", "uint8"]  # index = PD_DataType enum
+
+
+def new_predictor(model_dir, prog_file, params_file, use_tpu, device_id,
+                  ir_optim, bf16):
+    if prog_file:
+        config = Config(prog_file, params_file)
+    else:
+        config = Config(model_dir)
+    if use_tpu:
+        config.enable_tpu(device_id)
+    else:
+        config.disable_tpu()
+    config.switch_ir_optim(bool(ir_optim))
+    if bf16:
+        config.enable_bf16()
+    return Predictor(config)
+
+
+def clone_predictor(pred):
+    return pred.clone()
+
+
+def input_names(pred):
+    return pred.get_input_names()
+
+
+def output_names(pred):
+    return pred.get_output_names()
+
+
+def set_input(pred, name, dtype_idx, shape, data):
+    """`data` is a memoryview over the caller's buffer; copy out of it
+    immediately — the C caller may free it after this returns."""
+    arr = np.frombuffer(data, dtype=_DTYPES[dtype_idx]).reshape(shape).copy()
+    pred.get_input_handle(name).copy_from_cpu(arr)
+
+
+def run(pred):
+    pred.run()
+    return True
+
+
+def get_output(pred, name):
+    """Returns (dtype_enum, shape_tuple, raw_bytes)."""
+    arr = np.ascontiguousarray(pred.get_output_handle(name).copy_to_cpu())
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    dt = str(arr.dtype)
+    if dt not in _DTYPES:
+        raise TypeError(f"output '{name}' has non-C-ABI dtype {dt}")
+    return _DTYPES.index(dt), tuple(arr.shape), arr.tobytes()

@@ -1,0 +1,484 @@
+"""AnalysisPredictor analog: load → analyze → AOT-compile → zero-copy serve.
+
+reference: paddle/fluid/inference/api/analysis_predictor.h:47 (class
+AnalysisPredictor), paddle_api.h (PaddlePredictor/ZeroCopyTensor),
+paddle_analysis_config.h (AnalysisConfig). The reference pipeline was
+load → 30+ ir fusion passes → NaiveExecutor op loop with zero-copy scope
+tensors. The TPU-native pipeline is load → semantic passes (passes.py) →
+jax.jit AOT lowering of the WHOLE pruned program into one XLA executable per
+input-shape bucket; weights live on device across calls, feeds are
+device_put once, outputs stay on device until copy_to_cpu.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.utils.enforce import EnforceError, enforce
+
+__all__ = ["Config", "PrecisionType", "Predictor", "Tensor", "create_predictor"]
+
+
+class PrecisionType:
+    """reference: paddle_api.h PaddleDType/Precision. kHalf maps to bf16 —
+    the TPU's native low-precision dtype."""
+
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "bfloat16"
+    Int8 = "int8"  # accepted; executed as bf16 (no TPU int8 matmul path here)
+
+
+class Config:
+    """reference: paddle/fluid/inference/api/paddle_analysis_config.h:61
+    (AnalysisConfig). Construction mirrors the reference: Config(model_dir)
+    for the __model__/__params__ layout, or Config(prog_file, params_file)."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        if model_dir is not None and params_file is not None:
+            self._prog_file = model_dir
+            self._params_file = params_file
+            self._model_dir = os.path.dirname(model_dir)
+        else:
+            self._model_dir = model_dir
+            self._prog_file = None
+            self._params_file = None
+        self._use_tpu = True
+        self._device_id = 0
+        self._ir_optim = True
+        self._memory_optim = True
+        self._precision = PrecisionType.Float32
+        self._passes = None  # None = default pipeline
+        self._deleted_passes = set()
+        self._options = {}
+
+    # -- model location (reference: AnalysisConfig::SetModel — updates only
+    # the paths; previously configured options must survive) ---------------
+    def set_model(self, model_dir_or_prog, params_file=None):
+        if model_dir_or_prog is not None and params_file is not None:
+            self._prog_file = model_dir_or_prog
+            self._params_file = params_file
+            self._model_dir = os.path.dirname(model_dir_or_prog)
+        else:
+            self._model_dir = model_dir_or_prog
+            self._prog_file = None
+            self._params_file = None
+
+    def model_dir(self):
+        return self._model_dir
+
+    # -- device (reference: EnableUseGpu/DisableGpu — re-targeted to TPU) --
+    def enable_tpu(self, device_id=0):
+        self._use_tpu = True
+        self._device_id = device_id
+
+    def disable_tpu(self):
+        self._use_tpu = False
+
+    def use_tpu(self):
+        return self._use_tpu
+
+    # GPU-era spellings kept callable for porting ease
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self.enable_tpu(device_id)
+
+    def disable_gpu(self):
+        self.disable_tpu()
+
+    # -- analysis (reference: SwitchIrOptim / pass_builder) ----------------
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        """Donation-based buffer reuse inside the executable (XLA owns the
+        actual memory plan; reference: EnableMemoryOptim)."""
+        self._memory_optim = x
+
+    def enable_bf16(self):
+        """Serve matmul/conv regions in bfloat16 (the reference's
+        EnableMkldnnBfloat16/TensorRT-fp16 analog on TPU)."""
+        self._precision = PrecisionType.Bfloat16
+
+    def set_precision(self, precision):
+        self._precision = precision
+
+    def precision(self):
+        return self._precision
+
+    def delete_pass(self, name):
+        """reference: pass_builder()->DeletePass."""
+        self._deleted_passes.add(name)
+
+    def set_passes(self, names):
+        self._passes = list(names)
+
+    def analysis_passes(self):
+        if self._passes is not None:
+            names = list(self._passes)
+        else:
+            names = ["strip_debug_ops", "flip_test_mode",
+                     "dead_code_elimination", "fold_constants"]
+            if self._precision == PrecisionType.Float32:
+                pass
+            else:
+                names.append("bf16_cast")
+        return [n for n in names if n not in self._deleted_passes]
+
+    # -- parity shims (accepted, no TPU meaning) ---------------------------
+    def set_cpu_math_library_num_threads(self, n):
+        self._options["cpu_math_threads"] = n
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        self._options["use_feed_fetch_ops"] = x
+
+    def switch_specify_input_names(self, x=True):
+        self._options["specify_input_names"] = x
+
+
+class Tensor:
+    """Zero-copy I/O handle (reference: paddle_api.h ZeroCopyTensor:
+    copy_from_cpu/copy_to_cpu/Reshape). Input handles hold the next feed;
+    output handles hold the last run's device array (fetched lazily)."""
+
+    def __init__(self, name, var, place):
+        self.name = name
+        self._var = var
+        self._place = place
+        self._value = None  # np array (pending feed) or jax array (output)
+        self._declared_shape = None  # set by reshape()
+
+    def shape(self):
+        if self._value is not None:
+            return list(np.shape(self._value))
+        return list(self._var.shape) if self._var is not None else []
+
+    def reshape(self, shape):
+        """Declare the upcoming feed's shape (reference: ZeroCopyTensor::
+        Reshape): the next copy_from_cpu may then pass a flat buffer, which
+        is viewed through this shape (and thereby selects the compile
+        bucket, since buckets key on the concrete feed shapes)."""
+        self._declared_shape = list(shape)
+
+    def copy_from_cpu(self, data):
+        arr = np.ascontiguousarray(data)
+        if self._declared_shape is not None and (
+            list(arr.shape) != self._declared_shape
+        ):
+            arr = arr.reshape(self._declared_shape)
+        self._value = arr
+
+    def share_external_data(self, data):
+        """Zero-copy variant: keep the caller's buffer (no copy here; the
+        single host→device transfer happens inside run())."""
+        self._value = np.asarray(data)
+
+    def copy_to_cpu(self):
+        enforce(self._value is not None, f"tensor '{self.name}' has no value")
+        return np.asarray(self._value)
+
+    def value(self):
+        return self._value
+
+
+class Predictor:
+    """reference: analysis_predictor.h:47. Loads the inference program,
+    runs the analysis pipeline, and serves through AOT-compiled XLA
+    executables keyed on input shapes. clone() shares weights and the
+    compile cache (reference: AnalysisPredictor::Clone shares params via the
+    parent scope)."""
+
+    def __init__(self, config, _shared=None):
+        import jax
+
+        from paddle_tpu.core.places import CPUPlace, TPUPlace
+
+        self._config = config
+        self._place = (
+            TPUPlace(config._device_id) if config._use_tpu else CPUPlace()
+        )
+        if _shared is not None:
+            # clone: share scope (weights), program, and compiled cache
+            (self._program, self._feed_names, self._fetch_names,
+             self._scope, self._cache, self._analysis_stats) = _shared
+        else:
+            self._scope = Scope()
+            self._program, self._feed_names, self._fetch_names = self._load()
+            self._analysis_stats = {}
+            if config.ir_optim():
+                self._analyze()
+            self._cache = {}
+        self._inputs = {}
+        self._outputs = {}
+        block = self._program.global_block()
+        for n in self._feed_names:
+            self._inputs[n] = Tensor(n, block._find_var_recursive(n), self._place)
+        for n in self._fetch_names:
+            self._outputs[n] = Tensor(n, block._find_var_recursive(n), self._place)
+
+    # -- loading (reference: AnalysisPredictor::LoadProgramDesc/Parameters) -
+    def _load(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.ir import Program
+        from paddle_tpu.io import _read_combined
+
+        cfg = self._config
+        if cfg._prog_file:
+            model_path, params_path = cfg._prog_file, cfg._params_file
+        else:
+            enforce(cfg._model_dir, "Config has no model location")
+            model_path = os.path.join(cfg._model_dir, "__model__")
+            params_path = os.path.join(cfg._model_dir, "__params__")
+        enforce(os.path.exists(model_path), f"{model_path} not found")
+        with open(model_path, "rb") as f:
+            desc = json.loads(f.read().decode("utf-8"))
+        program = Program.from_bytes(
+            json.dumps(
+                {k: v for k, v in desc.items()
+                 if k not in ("feed_var_names", "fetch_var_names")}
+            ).encode()
+        )
+        feed_names = desc.get("feed_var_names", [])
+        fetch_names = desc.get("fetch_var_names", [])
+        dev = self._place.jax_device()
+        for name, arr in _read_combined(params_path).items():
+            # weights go device-resident ONCE; every run() reuses them
+            self._scope.set(name, jax.device_put(jnp.asarray(arr), dev))
+        return program, feed_names, fetch_names
+
+    # -- analysis (reference: AnalysisPredictor::OptimizeInferenceProgram) -
+    def _analyze(self):
+        from paddle_tpu.passes import PassContext, PassManager
+
+        ctx = PassContext(
+            scope=self._scope,
+            feed_names=self._feed_names,
+            fetch_names=self._fetch_names,
+            bf16_white_list=self._config._options.get("bf16_white_list"),
+            bf16_black_list=self._config._options.get("bf16_black_list"),
+        )
+        pm = PassManager(self._config.analysis_passes())
+        self._program = pm.run(self._program, ctx)
+        if self._config.precision() != PrecisionType.Float32:
+            self._fold_param_casts()
+        self._analysis_stats = ctx.stats
+
+    def _fold_param_casts(self):
+        """Pre-cast device weights that only flow through a leading cast op,
+        deleting the cast from the program — bf16 weights then live on
+        device at half the HBM footprint and no per-call cast runs."""
+        import jax.numpy as jnp
+
+        block = self._program.global_block()
+        kept = []
+        folded_srcs = []
+        for op in block.ops:
+            if op.type == "cast":
+                src = op.inputs.get("X", [None])[0]
+                dst = op.outputs.get("Out", [None])[0]
+                var = block._find_var_recursive(src) if src else None
+                if (
+                    var is not None
+                    and var.persistable
+                    and self._scope.has_var(src)
+                    and src not in self._feed_names
+                ):
+                    w = self._scope.find_var(src)
+                    self._scope.set(
+                        dst, jnp.asarray(w).astype(op.attrs.get("out_dtype"))
+                    )
+                    dvar = block._find_var_recursive(dst)
+                    if dvar is not None:
+                        dvar.persistable = True
+                    folded_srcs.append(src)
+                    continue
+            kept.append(op)
+        if len(kept) != len(block.ops):
+            block.ops = kept
+            # drop an original-precision weight only when NOTHING still reads
+            # it (tied weights may feed another op directly, e.g. a lookup
+            # table shared with an MLM output matmul)
+            still_read = {
+                n
+                for b in self._program.blocks
+                for op in b.ops
+                for n in op.input_names()
+            } | set(self._fetch_names)
+            self._scope.erase([n for n in folded_srcs if n not in still_read])
+            self._program._bump_version()
+
+    # -- surface (reference: GetInputNames/GetOutputNames/GetInputTensor) --
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        enforce(name in self._inputs, f"no input named '{name}'")
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        enforce(name in self._outputs, f"no output named '{name}'")
+        return self._outputs[name]
+
+    # reference spellings
+    get_input_tensor = get_input_handle
+    get_output_tensor = get_output_handle
+
+    def get_input_tensor_shape(self):
+        block = self._program.global_block()
+        out = {}
+        for n in self._feed_names:
+            v = block._find_var_recursive(n)
+            out[n] = list(v.shape) if v is not None else []
+        return out
+
+    # -- execution (reference: AnalysisPredictor::ZeroCopyRun) -------------
+    def run(self, inputs=None):
+        """Run one inference. Either set input handles first (zero-copy
+        style) and call run(), or pass `inputs` as {name: np.ndarray} /
+        [np.ndarray, ...] (reference: PaddlePredictor::Run). Returns the
+        list of output np.ndarrays AND fills the output handles."""
+        import jax
+
+        if inputs is not None:
+            if isinstance(inputs, dict):
+                for n, v in inputs.items():
+                    self.get_input_handle(n).copy_from_cpu(v)
+            else:
+                enforce(
+                    len(inputs) == len(self._feed_names),
+                    f"expected {len(self._feed_names)} inputs, "
+                    f"got {len(inputs)}",
+                )
+                for n, v in zip(self._feed_names, inputs):
+                    self._inputs[n].copy_from_cpu(v)
+        feed_vals = []
+        for n in self._feed_names:
+            v = self._inputs[n].value()
+            enforce(v is not None, f"input '{n}' was never set")
+            feed_vals.append(np.asarray(v))
+        sig = tuple((v.shape, str(v.dtype)) for v in feed_vals)
+        executable, scope_names = self._compiled(sig)
+        dev = self._place.jax_device()
+        feed_dev = [jax.device_put(v, dev) for v in feed_vals]
+        weights = [self._scope.find_var(n) for n in scope_names]
+        outs = executable(tuple(feed_dev), tuple(weights))
+        results = []
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n]._value = o
+            results.append(np.asarray(o))
+        return results
+
+    # compatibility alias (reference: ZeroCopyRun)
+    def zero_copy_run(self):
+        self.run()
+        return True
+
+    def _compiled(self, sig):
+        """AOT-compile the pruned program for one input-shape bucket
+        (reference: the predictor's first-run engine build; here it's an
+        explicit jax .lower().compile() so serving never retraces)."""
+        hit = self._cache.get(sig)
+        if hit is not None:
+            return hit
+        import jax
+
+        from paddle_tpu.core.executor import _interpret_block, plan_step
+
+        block = self._program.global_block()
+        donated, readonly, _w, live = plan_step(
+            block, self._feed_names, self._fetch_names, self._scope,
+            use_donation=False,
+        )
+        scope_names = donated + readonly
+        feed_names, fetch_names = self._feed_names, self._fetch_names
+
+        def fn(feed_vals, scope_vals):
+            env = dict(zip(feed_names, feed_vals))
+            env.update(zip(scope_names, scope_vals))
+            _interpret_block(block, env, jax.random.PRNGKey(0), ops=live)
+            return [env[n] for n in fetch_names]
+
+        dev = self._place.jax_device()
+        feed_structs = tuple(
+            jax.ShapeDtypeStruct(s, d) for s, d in sig
+        )
+        weight_structs = tuple(
+            jax.ShapeDtypeStruct(
+                np.shape(self._scope.find_var(n)),
+                getattr(
+                    self._scope.find_var(n),
+                    "dtype",
+                    np.asarray(self._scope.find_var(n)).dtype,
+                ),
+            )
+            for n in scope_names
+        )
+        executable = (
+            jax.jit(fn)
+            .lower(feed_structs, weight_structs)
+            .compile()
+        )
+        self._cache[sig] = (executable, scope_names)
+        return self._cache[sig]
+
+    # -- management --------------------------------------------------------
+    def clone(self):
+        """Share weights + compiled executables; independent I/O handles
+        (reference: AnalysisPredictor::Clone — thread-per-predictor
+        serving)."""
+        return Predictor(
+            self._config,
+            _shared=(self._program, self._feed_names, self._fetch_names,
+                     self._scope, self._cache, self._analysis_stats),
+        )
+
+    def get_serialized_program(self):
+        """reference: AnalysisPredictor::GetSerializedProgram."""
+        return self._program.to_bytes()
+
+    def save_optim_model(self, dirname):
+        """Persist the analyzed program + (possibly precision-cast) weights
+        (reference: AnalysisPredictor::SaveOptimModel)."""
+        os.makedirs(dirname, exist_ok=True)
+        desc = json.loads(self._program.to_bytes().decode("utf-8"))
+        desc["feed_var_names"] = self._feed_names
+        desc["fetch_var_names"] = self._fetch_names
+        with open(os.path.join(dirname, "__model__"), "wb") as f:
+            f.write(json.dumps(desc).encode("utf-8"))
+        from paddle_tpu.io import _write_combined
+
+        block = self._program.global_block()
+        arrays = {}
+        for n in sorted(self._scope.var_names()):
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                arrays[n] = np.asarray(self._scope.find_var(n))
+        _write_combined(os.path.join(dirname, "__params__"), arrays)
+
+    def analysis_stats(self):
+        """Per-pass statistics from the analysis pipeline (debugging aid)."""
+        return dict(self._analysis_stats)
+
+    def clear_intermediate_tensor(self):
+        """reference: AnalysisPredictor::ClearIntermediateTensor. XLA owns
+        intermediates inside the executable; nothing survives a run."""
+
+    def try_shrink_memory(self):
+        """Drop compiled executables for unused shape buckets."""
+        self._cache.clear()
+        return True
+
+
+def create_predictor(config):
+    """reference: CreatePaddlePredictor<AnalysisConfig> /
+    paddle_infer::CreatePredictor."""
+    return Predictor(config)

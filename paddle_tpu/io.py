@@ -49,10 +49,21 @@ def _gather_vars(program, predicate, scope):
 def _write_combined(path, arrays):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     names = sorted(arrays)
+    payload = {}
+    bf16_names = []
+    for i, n in enumerate(names):
+        a = np.asarray(arrays[n])
+        if str(a.dtype) == "bfloat16":
+            # numpy's npz format can't represent ml_dtypes.bfloat16 (it
+            # degrades to void16); store the raw bits as uint16 + a tag
+            a = a.view(np.uint16)
+            bf16_names.append(n)
+        payload[f"arr_{i}"] = a
     np.savez(
         path,
         __names__=np.array(names, dtype=object),
-        **{f"arr_{i}": arrays[n] for i, n in enumerate(names)},
+        __bf16__=np.array(bf16_names, dtype=object),
+        **payload,
     )
 
 
@@ -61,7 +72,18 @@ def _read_combined(path):
     enforce(os.path.exists(real), f"params file {path} not found")
     with np.load(real, allow_pickle=True) as data:
         names = [str(n) for n in data["__names__"]]
-        return {n: data[f"arr_{i}"] for i, n in enumerate(names)}
+        bf16 = (
+            {str(n) for n in data["__bf16__"]} if "__bf16__" in data else set()
+        )
+        out = {}
+        for i, n in enumerate(names):
+            a = data[f"arr_{i}"]
+            if n in bf16:
+                import ml_dtypes
+
+                a = a.view(ml_dtypes.bfloat16)
+            out[n] = a
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,249 @@
+"""General IR pass framework: named program-rewrite passes + a pass manager.
+
+TPU-native analog of the reference's ir/ pass infrastructure
+(reference: paddle/fluid/framework/ir/pass.h:40 Pass::Apply,
+paddle/fluid/inference/analysis/ir_pass_manager.cc:36 IRPassManager) — but
+where the reference needed 126 passes (fusion, layout, memory reuse), XLA
+owns fusion/layout/scheduling here, so the passes that remain are the
+*semantic* program rewrites: dead-code elimination, test-mode flipping,
+precision casts, quantization. AMP (amp/decorator.py) and QAT
+(contrib/quantize.py) use the same rewrite style; inference/ composes these
+through a PassManager.
+
+A pass is a callable `(Program, PassContext) -> Program` registered by name.
+Passes may mutate in place and return the same Program, or return a new one.
+"""
+
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = [
+    "register_pass",
+    "get_pass",
+    "PassContext",
+    "PassManager",
+]
+
+_PASS_REGISTRY = {}
+
+
+def register_pass(name):
+    """Decorator: register a pass callable under `name`
+    (reference: paddle/fluid/framework/ir/pass.h REGISTER_PASS)."""
+
+    def deco(fn):
+        enforce(name not in _PASS_REGISTRY, f"pass '{name}' already registered")
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_pass(name):
+    enforce(name in _PASS_REGISTRY, f"no pass named '{name}'; have "
+            f"{sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name]
+
+
+class PassContext:
+    """Shared state passed to every pass: the scope holding parameters (so
+    weight-rewriting passes can transform values, not just the graph), the
+    fetch targets (for liveness), and free-form options."""
+
+    def __init__(self, scope=None, feed_names=(), fetch_names=(), **options):
+        self.scope = scope
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.options = options
+        self.stats = {}  # pass name -> info dict, for debugging/reporting
+
+    def opt(self, key, default=None):
+        return self.options.get(key, default)
+
+
+class PassManager:
+    """Apply a sequence of named passes (reference:
+    paddle/fluid/inference/analysis/ir_pass_manager.cc:36)."""
+
+    def __init__(self, pass_names):
+        self.pass_names = list(pass_names)
+        for n in self.pass_names:
+            get_pass(n)  # fail fast on unknown names
+
+    def run(self, program, ctx=None):
+        ctx = ctx or PassContext()
+        for name in self.pass_names:
+            out = get_pass(name)(program, ctx)
+            program = out if out is not None else program
+        return program
+
+
+# ---------------------------------------------------------------------------
+# built-in passes
+# ---------------------------------------------------------------------------
+
+
+@register_pass("dead_code_elimination")
+def _dce_pass(program, ctx):
+    """Drop ops that don't (transitively) feed a fetch and have no side
+    effects (reference: paddle/fluid/framework/prune.cc). Requires
+    ctx.fetch_names."""
+    from paddle_tpu.core.executor import live_ops
+
+    if not ctx.fetch_names:
+        return program
+    # only the global block: sub-blocks (cond/while bodies) carry their own
+    # liveness through the parent control-flow op, and pruning them against
+    # the TOP-LEVEL fetches would empty loop bodies
+    block = program.global_block()
+    live = live_ops(block, ctx.fetch_names)
+    live_set = {id(op) for op in live}
+    before = len(block.ops)
+    block.ops = [op for op in block.ops if id(op) in live_set]
+    removed = before - len(block.ops)
+    if removed:
+        program._bump_version()
+    ctx.stats["dead_code_elimination"] = {"removed_ops": removed}
+    return program
+
+
+@register_pass("flip_test_mode")
+def _flip_test_pass(program, ctx):
+    """Force is_test=True on every op that has a train/test behavior split
+    (dropout, batch_norm, ...) — the inference analog of clone(for_test)."""
+    from paddle_tpu.core.ir import _test_mode_attrs
+
+    flipped = 0
+    for block in program.blocks:
+        for op in block.ops:
+            if "is_test" in _test_mode_attrs(op.type):
+                if not op.attrs.get("is_test"):
+                    op.attrs["is_test"] = True
+                    flipped += 1
+    if flipped:
+        program._bump_version()
+    ctx.stats["flip_test_mode"] = {"flipped_ops": flipped}
+    return program
+
+
+@register_pass("bf16_cast")
+def _bf16_cast_pass(program, ctx):
+    """Cast MXU-friendly regions to bfloat16 for inference using the AMP
+    white/black lists (reference: the mkldnn/TensorRT precision passes, e.g.
+    paddle/fluid/inference/api/paddle_pass_builder.cc — re-targeted to the
+    TPU's native low-precision dtype). Weights feeding white-listed ops are
+    cast in the scope so the executable reads bf16 parameters directly."""
+    from paddle_tpu.amp.decorator import (
+        AutoMixedPrecisionLists,
+        rewrite_program_amp,
+    )
+
+    rewrite_program_amp(
+        program,
+        amp_lists=AutoMixedPrecisionLists(
+            custom_white_list=ctx.opt("bf16_white_list"),
+            custom_black_list=ctx.opt("bf16_black_list"),
+        ),
+        dest_dtype="bfloat16",
+    )
+    ctx.stats["bf16_cast"] = {"enabled": True}
+    return program
+
+
+@register_pass("fold_constants")
+def _fold_constants_pass(program, ctx):
+    """Evaluate fetch-independent constant subgraphs (ops whose inputs are
+    all produced by earlier constant ops, starting from fill_constant) once
+    at analysis time and replace them with scope-resident values
+    (reference: paddle/fluid/framework/ir/ constant-folding behavior; XLA
+    also folds, but folding here shrinks the traced program and lets later
+    passes see literal values). Requires ctx.scope."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import OpRegistry
+
+    if ctx.scope is None:
+        return program
+    block = program.global_block()
+    const_vals = {}
+    folded_ops = []
+    feed_set = set(ctx.feed_names)
+    for op in block.ops:
+        ins = [n for ns in op.inputs.values() for n in ns]
+        foldable = (op.type == "fill_constant" and not ins) or (
+            ins and all(n in const_vals for n in ins)
+        )
+        if foldable and OpRegistry.has(op.type):
+            op_def = OpRegistry.get(op.type)
+            foldable = not op_def.stateful and not any(
+                n in feed_set for n in op.output_names()
+            )
+        elif foldable:
+            foldable = False
+        if not foldable:
+            # a non-folded op overwriting a tracked var invalidates its
+            # constant value — later reads must NOT see the stale fold
+            for n in op.output_names():
+                const_vals.pop(n, None)
+            continue
+        try:
+            env = {
+                slot: [const_vals[n] for n in names]
+                for slot, names in op.inputs.items()
+            }
+            out = op_def.lower(env, dict(op.attrs))
+        except Exception:
+            out = None
+        ok = out is not None
+        new_vals = {}
+        if ok:
+            for slot, names in op.outputs.items():
+                vals = out.get(slot)
+                if vals is None or len(vals) != len(names):
+                    ok = False
+                    break
+                for n, v in zip(names, vals):
+                    new_vals[n] = jnp.asarray(v)
+        if ok:
+            const_vals.update(new_vals)
+            folded_ops.append(op)
+        else:
+            # evaluation failed: the op runs at serve time and overwrites its
+            # outputs — drop any stale constant tracking for them
+            for n in op.output_names():
+                const_vals.pop(n, None)
+    if folded_ops:
+        folded_set = {id(op) for op in folded_ops}
+        # only fold ops whose outputs aren't ALSO written by non-folded ops
+        block.ops = [op for op in block.ops if id(op) not in folded_set]
+        # keep only constants still read by the remaining program
+        still_read = {
+            n for op in block.ops for n in op.input_names()
+        } | set(ctx.fetch_names)
+        for n, v in const_vals.items():
+            if n in still_read:
+                ctx.scope.set(n, v)
+                var = block._find_var_recursive(n)
+                if var is not None:
+                    var.persistable = True
+        program._bump_version()
+    ctx.stats["fold_constants"] = {
+        "folded_ops": len(folded_ops),
+        "materialized": int(
+            sum(1 for n in const_vals if ctx.scope.has_var(n))
+        ),
+    }
+    return program
+
+
+@register_pass("strip_debug_ops")
+def _strip_debug_pass(program, ctx):
+    """Remove print/assert instrumentation for serving builds."""
+    removed = 0
+    for block in program.blocks:
+        before = len(block.ops)
+        block.ops = [op for op in block.ops if op.type not in ("print",)]
+        removed += before - len(block.ops)
+    if removed:
+        program._bump_version()
+    ctx.stats["strip_debug_ops"] = {"removed_ops": removed}
+    return program

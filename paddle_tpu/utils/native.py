@@ -43,9 +43,11 @@ def load_native(component, source=None, extra_flags=()):
             # launch_procs workers may race to build the same component, and
             # dlopen of a half-written .so is a crash
             tmp = f"{out}.{os.getpid()}.tmp"
+            # extra_flags go AFTER the source so -l libraries resolve
+            # symbols the object actually references (link order matters)
             cmd = [
                 "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                *extra_flags, "-o", tmp, src,
+                "-o", tmp, src, *extra_flags,
             ]
             try:
                 proc = subprocess.run(

@@ -1,0 +1,86 @@
+/* Standalone C host for the inference C ABI: load a saved model, run one
+ * batch, print the outputs. Compiled + executed by tests/test_capi.py.
+ * usage: capi_smoke <model_dir> <batch> <feat> */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) return 2;
+  const char* model_dir = argv[1];
+  int batch = atoi(argv[2]);
+  int feat = atoi(argv[3]);
+
+  PD_AnalysisConfig* cfg = PD_NewAnalysisConfig();
+  PD_SetModel(cfg, model_dir, NULL);
+  PD_DisableTPU(cfg);
+  PD_SwitchIrOptim(cfg, 1);
+
+  PD_Predictor* pred = PD_NewPredictor(cfg);
+  if (!pred) {
+    fprintf(stderr, "NewPredictor failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("inputs=%d outputs=%d\n", PD_GetInputNum(pred),
+         PD_GetOutputNum(pred));
+
+  float* x = (float*)malloc(sizeof(float) * batch * feat);
+  for (int i = 0; i < batch * feat; ++i) x[i] = (float)(i % 7) * 0.25f - 0.5f;
+  int64_t shape[2] = {batch, feat};
+  if (PD_SetInput(pred, PD_GetInputName(pred, 0), PD_FLOAT32, shape, 2, x)) {
+    fprintf(stderr, "SetInput failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  if (PD_PredictorRun(pred)) {
+    fprintf(stderr, "Run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  PD_DataType dt;
+  int64_t* oshape;
+  int ndim;
+  void* data;
+  size_t nbytes;
+  if (PD_GetOutput(pred, PD_GetOutputName(pred, 0), &dt, &oshape, &ndim,
+                   &data, &nbytes)) {
+    fprintf(stderr, "GetOutput failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("dtype=%d ndim=%d\n", (int)dt, ndim);
+  size_t n = nbytes / 4;
+  float* out = (float*)data;
+  printf("values:");
+  for (size_t i = 0; i < n; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+
+  /* clone must share weights and produce identical results */
+  PD_Predictor* twin = PD_ClonePredictor(pred);
+  if (!twin) {
+    fprintf(stderr, "Clone failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  PD_SetInput(twin, PD_GetInputName(twin, 0), PD_FLOAT32, shape, 2, x);
+  PD_PredictorRun(twin);
+  PD_DataType dt2;
+  int64_t* oshape2;
+  int ndim2;
+  void* data2;
+  size_t nbytes2;
+  PD_GetOutput(twin, PD_GetOutputName(twin, 0), &dt2, &oshape2, &ndim2,
+               &data2, &nbytes2);
+  float* out2 = (float*)data2;
+  int same = nbytes2 == nbytes;
+  for (size_t i = 0; same && i < n; ++i) same = out[i] == out2[i];
+  printf("clone_match=%d\n", same);
+
+  PD_Free(oshape);
+  PD_Free(data);
+  PD_Free(oshape2);
+  PD_Free(data2);
+  free(x);
+  PD_DeletePredictor(twin);
+  PD_DeletePredictor(pred);
+  PD_DeleteAnalysisConfig(cfg);
+  return same ? 0 : 1;
+}

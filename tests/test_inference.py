@@ -1,0 +1,207 @@
+"""Inference stack: analysis passes, Predictor round trip, C API.
+
+Mirrors the reference's inference test strategy (reference:
+paddle/fluid/inference/tests/api/ — train a model, save, load through the
+predictor, compare against the trainer's own forward).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def _train_and_save(tmpdir, rng, steps=15):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        y = fluid.data("y", [-1, 1])
+        h = fluid.layers.fc(x, 16, act="relu")
+        drop = fluid.layers.dropout(h, 0.3)  # must flip to test mode
+        pred = fluid.layers.fc(drop, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(pred, y)
+        )
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        W = rng.randn(8, 1).astype("float32")
+        for _ in range(steps):
+            xb = rng.randn(16, 8).astype("float32")
+            yb = (xb @ W).astype("float32")
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        model_dir = os.path.join(str(tmpdir), "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_program=main)
+        # reference outputs straight from the training program
+        infer = main.clone(for_test=True)
+        xq = rng.randn(4, 8).astype("float32")
+        ref = np.asarray(
+            exe.run(infer, feed={"x": xq, "y": np.zeros((4, 1), "float32")},
+                    fetch_list=[pred])[0]
+        )
+    return model_dir, xq, ref
+
+
+def test_predictor_round_trip(tmp_path, rng):
+    from paddle_tpu import inference
+
+    model_dir, xq, ref = _train_and_save(tmp_path, rng)
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    pred = inference.create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    assert len(pred.get_output_names()) == 1
+
+    # handle-style (zero-copy) API
+    pred.get_input_handle("x").copy_from_cpu(xq)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # list-style API
+    out2 = pred.run([xq])[0]
+    np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_aot_cache_and_shape_buckets(tmp_path, rng):
+    from paddle_tpu import inference
+
+    model_dir, xq, _ = _train_and_save(tmp_path, rng)
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    pred = inference.create_predictor(config)
+    pred.run([xq])
+    assert len(pred._cache) == 1
+    pred.run([rng.randn(9, 8).astype("float32")])
+    assert len(pred._cache) == 2  # new batch bucket compiled
+    pred.run([xq])
+    assert len(pred._cache) == 2  # bucket reused, no retrace
+    assert pred.try_shrink_memory()
+    assert len(pred._cache) == 0
+
+
+def test_predictor_clone_shares_weights(tmp_path, rng):
+    from paddle_tpu import inference
+
+    model_dir, xq, ref = _train_and_save(tmp_path, rng)
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    p1 = inference.create_predictor(config)
+    p2 = p1.clone()
+    assert p1._scope is p2._scope
+    out = p2.run([xq])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # I/O handles are independent (thread-per-predictor serving)
+    p1.get_input_handle("x").copy_from_cpu(np.zeros((4, 8), "float32"))
+    assert p2.get_input_handle("x").value() is not None  # from prior run
+    assert not np.array_equal(
+        np.asarray(p1.get_input_handle("x").value()),
+        np.asarray(p2.get_input_handle("x").value()),
+    )
+
+
+def test_predictor_bf16(tmp_path, rng):
+    from paddle_tpu import inference
+
+    model_dir, xq, ref = _train_and_save(tmp_path, rng)
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    config.enable_bf16()
+    pred = inference.create_predictor(config)
+    out = pred.run([xq])[0]
+    # bf16 has ~3 decimal digits; loose tolerance
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-2)
+    # param casts folded: some weight now lives in scope as bfloat16
+    dts = {
+        str(getattr(pred._scope.find_var(n), "dtype", ""))
+        for n in pred._scope.var_names()
+    }
+    assert "bfloat16" in dts
+
+
+def test_predictor_save_optim_model(tmp_path, rng):
+    from paddle_tpu import inference
+
+    model_dir, xq, ref = _train_and_save(tmp_path, rng)
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    pred = inference.create_predictor(config)
+    opt_dir = os.path.join(str(tmp_path), "optim")
+    pred.save_optim_model(opt_dir)
+    config2 = inference.Config(opt_dir)
+    config2.disable_tpu()
+    config2.switch_ir_optim(False)  # already analyzed
+    pred2 = inference.create_predictor(config2)
+    out = pred2.run([xq])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pass_framework():
+    from paddle_tpu.passes import PassContext, PassManager, get_pass, register_pass
+
+    with pytest.raises(Exception):
+        get_pass("no_such_pass")
+
+    calls = []
+
+    @register_pass("_test_probe_pass")
+    def probe(program, ctx):
+        calls.append(ctx.opt("tag"))
+        return program
+
+    main = Program()
+    pm = PassManager(["_test_probe_pass"])
+    pm.run(main, PassContext(tag="hello"))
+    assert calls == ["hello"]
+    # duplicate registration must fail fast
+    with pytest.raises(Exception):
+        register_pass("_test_probe_pass")(lambda p, c: p)
+
+
+def test_dce_pass_drops_dead_ops(rng):
+    from paddle_tpu.passes import PassContext, PassManager
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [4, 4])
+        live = fluid.layers.scale(x, scale=2.0)
+        _dead = fluid.layers.scale(x, scale=3.0)  # unfetched
+    n_before = len(main.global_block().ops)
+    ctx = PassContext(feed_names=["x"], fetch_names=[live.name])
+    PassManager(["dead_code_elimination"]).run(main, ctx)
+    assert len(main.global_block().ops) < n_before
+    assert ctx.stats["dead_code_elimination"]["removed_ops"] >= 1
+
+
+def test_fold_constants_pass(rng):
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.passes import PassContext, PassManager
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [4, 2])
+        c = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+        c2 = fluid.layers.scale(c, scale=2.0)  # constant chain: 6.0
+        out = fluid.layers.matmul(x, c2)
+    scope = Scope()
+    ctx = PassContext(scope=scope, feed_names=["x"], fetch_names=[out.name])
+    PassManager(["fold_constants"]).run(main, ctx)
+    assert ctx.stats["fold_constants"]["folded_ops"] >= 2
+    assert scope.has_var(c2.name)
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var(c2.name)), np.full((2, 2), 6.0, "float32")
+    )
+    # program still computes correctly through the executor
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        xb = rng.randn(4, 2).astype("float32")
+        got = np.asarray(
+            exe.run(main, feed={"x": xb}, fetch_list=[out])[0]
+        )
+    np.testing.assert_allclose(got, xb @ np.full((2, 2), 6.0), rtol=1e-5)
