@@ -104,7 +104,10 @@ def resolve_op_def(op_type):
         if OpRegistry.has(base_type):
             base = OpRegistry.get(base_type)
             lower = base.grad if base.grad is not None else make_generic_grad_lowering(base)
-            gdef = OpDef(op_type, lower, stateful=base.stateful)
+            gdef = OpDef(
+                op_type, lower, stateful=base.stateful,
+                needs_block=base.needs_block,
+            )
             _GRAD_DEF_CACHE[op_type] = gdef
             return gdef
     raise EnforceError(f"op {op_type} is not registered")

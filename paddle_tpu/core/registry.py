@@ -37,6 +37,7 @@ class OpDef:
         nondiff_inputs=(),
         stateful=False,
         needs_base_rng=False,
+        needs_block=False,
     ):
         self.type = type
         self.lower = lower
@@ -50,6 +51,11 @@ class OpDef:
         # ops replaying other ops (recompute_segment_grad) get the step's
         # UNFOLDED rng key so they can reproduce per-op folds exactly
         self.needs_base_rng = needs_base_rng
+        # ops running sub-blocks through the interpreter (recurrent) get the
+        # enclosing Block injected as attrs['_ctx_block'] at execution time —
+        # the sub_block attr is an index that only resolves against the
+        # program actually being run (survives Program.clone)
+        self.needs_block = needs_block
 
     def lowering(self, use_pallas=True):
         if use_pallas and self.pallas is not None:
@@ -82,7 +88,7 @@ class OpRegistry:
         return sorted(cls._ops)
 
 
-def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False):
+def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False, needs_block=False):
     """Decorator form:  @register_op("relu")  def _(ins, attrs): ..."""
 
     def deco(fn):
@@ -96,6 +102,7 @@ def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(
                 nondiff_inputs=nondiff_inputs,
                 stateful=stateful,
                 needs_base_rng=needs_base_rng,
+                needs_block=needs_block,
             )
         )
         return fn

@@ -1,5 +1,7 @@
 from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import *  # noqa: F401,F403
+from paddle_tpu.layers.rnn import *  # noqa: F401,F403
+from paddle_tpu.layers import rnn  # noqa: F401
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers import collective  # noqa: F401

@@ -1,0 +1,391 @@
+"""Recurrent layers: fused LSTM/GRU stacks and StaticRNN.
+
+API parity with the reference's RNN surface
+(reference: python/paddle/fluid/layers/rnn.py:3049 lstm,
+python/paddle/fluid/layers/control_flow.py StaticRNN,
+python/paddle/fluid/layers/nn.py dynamic_lstm/dynamic_gru) redesigned for
+the TPU: padded [batch, seq, feat] tensors + optional sequence_length
+replace LoD ragged batching, and every variant lowers onto `lax.scan`
+(ops/rnn.py) instead of per-timestep kernels.
+"""
+
+import numpy as np
+
+from paddle_tpu.core.ir import default_main_program
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = ["lstm", "gru", "dynamic_lstm", "dynamic_gru", "StaticRNN"]
+
+
+def lstm(input, init_h, init_c, hidden_size, num_layers=1, is_bidirec=False,
+         sequence_length=None, param_attr=None, bias_attr=None, name=None):
+    """Fused multi-layer (bi)LSTM (reference: python/paddle/fluid/layers/
+    rnn.py:3049 — there a cuDNN call over [seq, batch, in]; here batch-major
+    [batch, seq, in] feeding the lax.scan `lstm` op).
+
+    init_h/init_c: [num_layers * num_directions, batch, hidden_size].
+    Returns (out [B, S, H*D], last_h, last_c).
+    """
+    helper = LayerHelper("lstm", name=name)
+    dtype = input.dtype
+    n_dir = 2 if is_bidirec else 1
+    in_sizes = [int(input.shape[-1])] + [hidden_size * n_dir] * (num_layers - 1)
+    shapes = {}
+    ws = {"WeightIh": [], "WeightHh": [], "Bias": []}
+    from paddle_tpu.param_attr import ParamAttr
+
+    for layer in range(num_layers):
+        for d in range(n_dir):
+            i = layer * n_dir + d
+            for slot, shape, is_bias in (
+                ("WeightIh", [in_sizes[layer], 4 * hidden_size], False),
+                ("WeightHh", [hidden_size, 4 * hidden_size], False),
+                ("Bias", [4 * hidden_size], True),
+            ):
+                attr = ParamAttr._to_attr(bias_attr if is_bias else param_attr)
+                if attr and attr.name:
+                    attr = ParamAttr(name=f"{attr.name}.{slot}.{i}",
+                                     initializer=attr.initializer)
+                p = helper.create_parameter(
+                    attr, shape=shape, dtype=dtype, is_bias=is_bias
+                )
+                ws[slot].append(p)
+    # output shapes set explicitly: generic inference can't unify a
+    # dynamic-batch input with fixed-batch initial states
+    B = input.shape[0] if input.shape else -1
+    S = input.shape[1] if input.shape else -1
+    out = helper.block.create_var(
+        name=helper.name + ".out", dtype=dtype,
+        shape=[B, S, hidden_size * n_dir],
+    )
+    last_h = helper.block.create_var(
+        name=helper.name + ".last_h", dtype=dtype,
+        shape=[num_layers * n_dir, B, hidden_size],
+    )
+    last_c = helper.block.create_var(
+        name=helper.name + ".last_c", dtype=dtype,
+        shape=[num_layers * n_dir, B, hidden_size],
+    )
+    inputs = {
+        "Input": [input.name],
+        "InitH": [init_h.name],
+        "InitC": [init_c.name],
+        "WeightIh": [p.name for p in ws["WeightIh"]],
+        "WeightHh": [p.name for p in ws["WeightHh"]],
+        "Bias": [p.name for p in ws["Bias"]],
+    }
+    if sequence_length is not None:
+        inputs["SequenceLength"] = [sequence_length.name]
+    helper.append_op(
+        "lstm",
+        inputs,
+        {"Out": [out.name], "LastH": [last_h.name], "LastC": [last_c.name]},
+        {"num_layers": num_layers, "is_bidirec": is_bidirec,
+         "hidden_size": hidden_size},
+    )
+    return out, last_h, last_c
+
+
+def gru(input, init_h, hidden_size, num_layers=1, is_bidirec=False,
+        sequence_length=None, param_attr=None, bias_attr=None, name=None):
+    """Fused multi-layer (bi)GRU (TPU analog of reference
+    paddle/fluid/operators/gru_op.h batched over padded tensors).
+    Returns (out [B, S, H*D], last_h)."""
+    helper = LayerHelper("gru", name=name)
+    dtype = input.dtype
+    n_dir = 2 if is_bidirec else 1
+    in_sizes = [int(input.shape[-1])] + [hidden_size * n_dir] * (num_layers - 1)
+    ws = {"WeightIh": [], "WeightHh": [], "BiasIh": [], "BiasHh": []}
+    from paddle_tpu.param_attr import ParamAttr
+
+    for layer in range(num_layers):
+        for d in range(n_dir):
+            i = layer * n_dir + d
+            for slot, shape, is_bias in (
+                ("WeightIh", [in_sizes[layer], 3 * hidden_size], False),
+                ("WeightHh", [hidden_size, 3 * hidden_size], False),
+                ("BiasIh", [3 * hidden_size], True),
+                ("BiasHh", [3 * hidden_size], True),
+            ):
+                attr = ParamAttr._to_attr(bias_attr if is_bias else param_attr)
+                if attr and attr.name:
+                    attr = ParamAttr(name=f"{attr.name}.{slot}.{i}",
+                                     initializer=attr.initializer)
+                p = helper.create_parameter(
+                    attr, shape=shape, dtype=dtype, is_bias=is_bias
+                )
+                ws[slot].append(p)
+    B = input.shape[0] if input.shape else -1
+    S = input.shape[1] if input.shape else -1
+    out = helper.block.create_var(
+        name=helper.name + ".out", dtype=dtype,
+        shape=[B, S, hidden_size * n_dir],
+    )
+    last_h = helper.block.create_var(
+        name=helper.name + ".last_h", dtype=dtype,
+        shape=[num_layers * n_dir, B, hidden_size],
+    )
+    inputs = {
+        "Input": [input.name],
+        "InitH": [init_h.name],
+        "WeightIh": [p.name for p in ws["WeightIh"]],
+        "WeightHh": [p.name for p in ws["WeightHh"]],
+        "BiasIh": [p.name for p in ws["BiasIh"]],
+        "BiasHh": [p.name for p in ws["BiasHh"]],
+    }
+    if sequence_length is not None:
+        inputs["SequenceLength"] = [sequence_length.name]
+    helper.append_op(
+        "gru",
+        inputs,
+        {"Out": [out.name], "LastH": [last_h.name]},
+        {"num_layers": num_layers, "is_bidirec": is_bidirec,
+         "hidden_size": hidden_size},
+    )
+    return out, last_h
+
+
+def dynamic_lstm(input, size, sequence_length=None, param_attr=None,
+                 bias_attr=None, name=None):
+    """Single-layer LSTM over a padded batch; parity-named after the
+    reference's LoD-driven dynamic_lstm (reference: python/paddle/fluid/
+    layers/nn.py dynamic_lstm). `size` is 4*hidden (reference convention).
+    Variable lengths come from `sequence_length` [B] instead of LoD offsets.
+    Returns (hidden [B, S, H], cell_last [B, H])."""
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    hidden_size = size // 4
+    B_sym = input.shape[0]
+    zeros = tensor_layers.fill_constant_batch_size_like(
+        input, shape=[1, -1, hidden_size], dtype=input.dtype, value=0.0,
+        input_dim_idx=0, output_dim_idx=1,
+    )
+    out, last_h, last_c = lstm(
+        input, zeros, zeros, hidden_size, num_layers=1,
+        sequence_length=sequence_length, param_attr=param_attr,
+        bias_attr=bias_attr, name=name,
+    )
+    # last_c is [num_layers * num_dirs = 1, B, H]; the documented contract
+    # is cell_last [B, H]
+    return out, tensor_layers.reshape(last_c, [-1, hidden_size])
+
+
+def dynamic_gru(input, size, sequence_length=None, param_attr=None,
+                bias_attr=None, name=None):
+    """Single-layer GRU over a padded batch (reference parity:
+    python/paddle/fluid/layers/nn.py dynamic_gru; `size` is hidden size).
+    Returns hidden [B, S, H]."""
+    from paddle_tpu.layers import tensor as tensor_layers
+
+    zeros = tensor_layers.fill_constant_batch_size_like(
+        input, shape=[1, -1, size], dtype=input.dtype, value=0.0,
+        input_dim_idx=0, output_dim_idx=1,
+    )
+    out, _ = gru(
+        input, zeros, size, num_layers=1, sequence_length=sequence_length,
+        param_attr=param_attr, bias_attr=bias_attr, name=name,
+    )
+    return out
+
+
+class StaticRNN:
+    """Define an RNN cell over a time-major [T, B, ...] sequence by writing
+    its step inside a `with rnn.step():` block
+    (reference: python/paddle/fluid/layers/control_flow.py StaticRNN).
+
+    with rnn.step():
+        x_t = rnn.step_input(x)          # [T, B, I] -> [B, I]
+        prev = rnn.memory(init=h0)       # [B, H] carried state
+        h = fluid.layers.fc(input=x_t, size=H, ...)  # any graph ops
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()                           # [T, B, H]
+
+    Lowered to ONE `recurrent` op scanning the step block (ops/rnn.py), so
+    the whole unroll is a lax.scan in the compiled step — not the
+    reference's per-step nested-Executor (recurrent_op.h:189).
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.program = default_main_program()
+        self._step_inputs = []   # (outer_name, inner_name)
+        self._memories = []      # [outer_init_name]
+        self._mem_inner = []     # inner mem var names
+        self._mem_next = {}      # inner mem name -> inner updated name
+        self._outputs = []       # inner names to stack
+        self._entered = False
+        self._seq_len = None
+
+    # -- step context --------------------------------------------------
+    class _Step:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn.parent_idx = self.rnn.program.current_block_idx
+            self.rnn.sub_block = self.rnn.program._create_block()
+            self.rnn._entered = True
+            return self.rnn
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            self.rnn.program._rollback()
+            if exc_type is None:
+                self.rnn._complete()
+            return False
+
+    def step(self):
+        return StaticRNN._Step(self)
+
+    # -- builder API ---------------------------------------------------
+    def step_input(self, x):
+        enforce(self._entered, "step_input must be called inside rnn.step()")
+        enforce(
+            x.shape and len(x.shape) >= 2,
+            "StaticRNN step input must be [T, B, ...] time-major",
+        )
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        inner = self.sub_block.create_var(
+            name=f"{self.helper.name}.step_in_{len(self._step_inputs)}",
+            shape=list(x.shape[1:]),
+            dtype=x.dtype,
+        )
+        self._step_inputs.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        enforce(self._entered, "memory must be called inside rnn.step()")
+        if init is None:
+            enforce(
+                batch_ref is not None and shape is not None,
+                "StaticRNN.memory needs init= or (shape=, batch_ref=)",
+            )
+            # The boot memory lives OUTSIDE the step block. batch_ref is
+            # usually the step_input result (a sub-block var, the standard
+            # fluid idiom) — swap it for its outer [T, B, ...] source, whose
+            # batch sits one axis later
+            # ref_batch_dim_idx names the batch axis of the TIME-MAJOR
+            # sequence (default 1 for [T, B, ...]), matching the reference
+            ref_var, ref_idx = batch_ref, ref_batch_dim_idx
+            for outer_name, inner_name in self._step_inputs:
+                if batch_ref.name == inner_name:
+                    ref_var = self.program.block(self.parent_idx)._find_var_recursive(outer_name)
+                    break
+            else:
+                enforce(
+                    self.program.block(self.parent_idx)._find_var_recursive(
+                        batch_ref.name
+                    ) is not None,
+                    "StaticRNN.memory batch_ref must be a step_input result "
+                    "or a variable visible outside the step block, got "
+                    f"{batch_ref.name}",
+                )
+            from paddle_tpu.layers import tensor as tensor_layers
+
+            cur = self.program.current_block_idx
+            self.program._rollback()
+            try:
+                init = tensor_layers.fill_constant_batch_size_like(
+                    ref_var,
+                    shape=[-1] + list(shape[1:] if len(shape) > 1 else shape),
+                    dtype=ref_var.dtype,
+                    value=init_value,
+                    input_dim_idx=ref_idx,
+                    output_dim_idx=init_batch_dim_idx,
+                )
+            finally:
+                # re-enter the step block
+                self.program.current_block_idx = cur
+        inner = self.sub_block.create_var(
+            name=f"{self.helper.name}.mem_{len(self._memories)}",
+            shape=list(init.shape) if init.shape else None,
+            dtype=init.dtype,
+        )
+        self._memories.append(init.name)
+        self._mem_inner.append(inner.name)
+        return inner
+
+    def update_memory(self, mem, var):
+        enforce(self._entered, "update_memory must be inside rnn.step()")
+        self._mem_next[mem.name] = var.name
+
+    def step_output(self, o):
+        enforce(self._entered, "step_output must be inside rnn.step()")
+        self._outputs.append((o.name, o.dtype, o.shape))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- completion ----------------------------------------------------
+    def _complete(self):
+        enforce(self._step_inputs,
+                "StaticRNN needs at least one step_input (it defines the "
+                "sequence length the step block scans over)")
+        for m in self._mem_inner:
+            enforce(
+                m in self._mem_next,
+                f"StaticRNN memory {m} was never update_memory()'d",
+            )
+        parent = self.program.block(self.parent_idx)
+        # external reads: sub-block reads neither produced in the sub-block
+        # nor step inputs/memories, resolvable in an enclosing scope
+        produced = set(n for _, n in self._step_inputs) | set(self._mem_inner)
+        ex = []
+        for sop in self.sub_block.ops:
+            for n in sop.input_names():
+                if n in produced or n in ex:
+                    continue
+                if parent._find_var_recursive(n) is not None:
+                    ex.append(n)
+            produced.update(sop.output_names())
+        self._ex_names = ex
+
+        outs = []
+        for name, dtype, shape in self._outputs:
+            full_shape = [self._seq_len] + list(shape or [])
+            outs.append(
+                parent.create_var(
+                    name=f"{self.helper.name}.out_{len(outs)}",
+                    shape=full_shape,
+                    dtype=dtype,
+                )
+            )
+        lasts = [
+            parent.create_var(
+                name=f"{self.helper.name}.last_{i}", shape=None, dtype="float32"
+            )
+            for i in range(len(self._memories))
+        ]
+        parent.append_op(
+            "recurrent",
+            {
+                "X": [outer for outer, _ in self._step_inputs],
+                "Init": list(self._memories),
+                "Ex": list(ex),
+            },
+            {
+                "Out": [o.name for o in outs],
+                "LastState": [l.name for l in lasts],
+            },
+            {
+                "sub_block": self.sub_block.idx,
+                "inner_input_vars": [n for _, n in self._step_inputs],
+                "state_inner_vars": list(self._mem_inner),
+                "state_next_vars": [
+                    self._mem_next[m] for m in self._mem_inner
+                ],
+                "step_output_vars": [n for n, _, _ in self._outputs],
+                "ex_vars": list(ex),
+            },
+        )
+        self._result_vars = outs
+
+    def __call__(self):
+        enforce(hasattr(self, "_result_vars"), "StaticRNN not completed")
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return self._result_vars

@@ -8,6 +8,7 @@ from paddle_tpu.layer_helper import LayerHelper
 __all__ = [
     "data",
     "fill_constant",
+    "fill_constant_batch_size_like",
     "zeros",
     "ones",
     "zeros_like",
@@ -95,6 +96,30 @@ def zeros(shape, dtype="float32", name=None):
 
 def ones(shape, dtype="float32", name=None):
     return fill_constant(shape, dtype, 1.0, name=name)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    """reference: python/paddle/fluid/layers/tensor.py
+    fill_constant_batch_size_like — `shape[output_dim_idx]` is replaced by
+    `input.shape[input_dim_idx]` at run time."""
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "fill_constant_batch_size_like",
+        {"Input": [input.name]},
+        {"Out": [out.name]},
+        {
+            "shape": list(shape),
+            "dtype": dtype,
+            "value": value,
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+    )
+    return out
 
 
 def zeros_like(x, name=None):

@@ -13,3 +13,4 @@ from paddle_tpu.ops import tensor  # noqa: F401
 from paddle_tpu.ops import optimizers  # noqa: F401
 from paddle_tpu.ops import control_flow  # noqa: F401
 from paddle_tpu.ops import recompute  # noqa: F401
+from paddle_tpu.ops import rnn  # noqa: F401

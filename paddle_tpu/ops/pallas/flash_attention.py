@@ -156,34 +156,242 @@ def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    """Backward from saved log-sum-exp (standard flash-attention gradient;
-    jnp form — XLA tiles the [S, S] recompute per head)."""
-    q, k, v, bias, out, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * sm_scale
-    if bias is not None:
-        s = s + bias[:, None, None, :]
-    if causal:
-        S = q.shape[2]
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask[None, None], s, _NEG)
-    # a fully-masked row has lse == _NEG, making exp(s - lse) blow up; its
-    # forward output was 0, so its gradient contribution must be 0 too
-    p = jnp.where(
-        (lse <= _NEG / 2)[..., None], 0.0, jnp.exp(s - lse[..., None])
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dbias_ref, *, sm_scale, causal, block_q,
+                     block_k, seq_len):
+    """One (batch*head, KV block) program: stream Q blocks, accumulate
+    dk/dv (+ per-head dbias) for this KV block. Scores are recomputed from
+    the saved LSE, so nothing O(S^2) ever reaches HBM."""
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    kT_scaled = k * sm_scale
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
     )
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
-    ds = p * (dp - delta[..., None])
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * sm_scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * sm_scale
-    dbias = jnp.sum(ds, axis=(1, 2)) if bias is not None else None
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias)
+
+    def body(i, carry):
+        dk, dv, dbias = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, kT_scaled, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(cols <= rows, s, _NEG)
+        # fully-masked rows have lse == _NEG: their fwd output was 0, so
+        # their gradient contribution must be 0, not exp(s - _NEG)
+        p = jnp.where(
+            (lse <= _NEG / 2)[:, None], 0.0, jnp.exp(s - lse[:, None])
+        )  # (BQ, BK)
+        dv_new = dv + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        dbias_new = dbias + ds.sum(axis=0)
+        return dk_new, dv_new, dbias_new
+
+    dk0 = jnp.zeros((block_k, k_ref.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    db0 = jnp.zeros((block_k,), jnp.float32)
+    nq = seq_len // block_q
+    if causal:
+        # only Q blocks at or after this KV block contribute
+        start = (j * block_k) // block_q
+        dk, dv, dbias = jax.lax.fori_loop(start, nq, body, (dk0, dv0, db0))
+    else:
+        dk, dv, dbias = jax.lax.fori_loop(0, nq, body, (dk0, dv0, db0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if dbias_ref is not None:
+        dbias_ref[0, 0] = dbias
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, causal, block_q, block_k, seq_len):
+    """One (batch*head, Q block) program: stream KV blocks, accumulate dq."""
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    rows = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, _NEG)
+        p = jnp.where(
+            (lse <= _NEG / 2)[:, None], 0.0, jnp.exp(s - lse[:, None])
+        )
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    nk = seq_len // block_k
+    if causal:
+        nk_eff = jnp.minimum((i + 1) * block_q // block_k
+                             + (1 if block_q % block_k else 0), nk)
+        dq = jax.lax.fori_loop(0, nk_eff, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, nk, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    """Blocked Pallas backward from the saved log-sum-exp (FlashAttention-2
+    split: a dk/dv kernel gridded over KV blocks and a dq kernel gridded over
+    Q blocks). Memory stays O(S · block) per program — the round-2 jnp
+    backward materialized the full [B,H,S,S] score matrix in HBM."""
+    q, k, v, bias, out, lse = res
+    B, H, S, D = q.shape
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    bh = B * H
+    # delta = rowsum(dO * O) — cheap elementwise reduce, leave it to XLA
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B, H, S)
+    q3, k3, v3 = (t.reshape(bh, S, D) for t in (q, k, v))
+    g3 = g.reshape(bh, S, D)
+    lse3 = lse.reshape(bh, 1, S)
+    delta3 = delta.reshape(bh, 1, S)
+    kw = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    full = lambda: pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0), **kw)
+    row = lambda: pl.BlockSpec((1, 1, S), lambda b, i: (b, 0, 0), **kw)
+    has_bias = bias is not None
+    if has_bias:
+        bias_bh = jnp.broadcast_to(
+            bias.reshape(B, 1, S), (B, H, S)
+        ).reshape(bh, 1, S).astype(jnp.float32)
+
+    # ---- dk/dv (+ per-bh dbias) --------------------------------------
+    kv_block = lambda: pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), **kw)
+    in_specs = [full(), kv_block(), kv_block()]
+    args = [q3, k3, v3]
+    if has_bias:
+        in_specs.append(row())
+        args.append(bias_bh)
+    in_specs += [full(), row(), row()]
+    args += [g3, lse3, delta3]
+    kv_out_specs = [
+        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), **kw),
+        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), **kw),
+    ]
+    kv_out_shapes = [
+        jax.ShapeDtypeStruct((bh, S, D), k.dtype),
+        jax.ShapeDtypeStruct((bh, S, D), v.dtype),
+    ]
+    if has_bias:
+        kv_out_specs.append(
+            pl.BlockSpec((1, 1, bk), lambda b, j: (b, 0, j), **kw)
+        )
+        kv_out_shapes.append(jax.ShapeDtypeStruct((bh, 1, S), jnp.float32))
+
+    def dkdv_kernel(*refs):
+        if has_bias:
+            (q_r, k_r, v_r, b_r, g_r, l_r, d_r, dk_r, dv_r, db_r) = refs
+        else:
+            (q_r, k_r, v_r, g_r, l_r, d_r, dk_r, dv_r) = refs
+            b_r, db_r = None, None
+        _bwd_dkdv_kernel(
+            q_r, k_r, v_r, b_r, g_r, l_r, d_r, dk_r, dv_r, db_r,
+            sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk,
+            seq_len=S,
+        )
+
+    outs = pl.pallas_call(
+        dkdv_kernel,
+        grid=(bh, S // bk),
+        in_specs=in_specs,
+        out_specs=kv_out_specs,
+        out_shape=kv_out_shapes,
+        interpret=interpret,
+    )(*args)
+    dk3, dv3 = outs[0], outs[1]
+    dbias = (
+        outs[2].reshape(B, H, S).sum(axis=1) if has_bias else None
+    )
+
+    # ---- dq ----------------------------------------------------------
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), **kw),
+        full(), full(),
+    ]
+    dq_args = [q3, k3, v3]
+    if has_bias:
+        dq_in_specs.append(row())
+        dq_args.append(bias_bh)
+    dq_in_specs += [
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), **kw),
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i), **kw),
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i), **kw),
+    ]
+    dq_args += [g3, lse3, delta3]
+
+    def dq_kernel(*refs):
+        if has_bias:
+            (q_r, k_r, v_r, b_r, g_r, l_r, d_r, dq_r) = refs
+        else:
+            (q_r, k_r, v_r, g_r, l_r, d_r, dq_r) = refs
+            b_r = None
+        _bwd_dq_kernel(
+            q_r, k_r, v_r, b_r, g_r, l_r, d_r, dq_r,
+            sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk,
+            seq_len=S,
+        )
+
+    dq3 = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, S // bq),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), **kw),
+        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        interpret=interpret,
+    )(*dq_args)
+
+    return (
+        dq3.reshape(B, H, S, D),
+        dk3.reshape(B, H, S, D),
+        dv3.reshape(B, H, S, D),
+        dbias,
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,368 @@
+"""RNN family tests: fused LSTM/GRU ops, StaticRNN -> recurrent op.
+
+Modeled on the reference's RNN op tests
+(reference: python/paddle/fluid/tests/unittests/test_lstm_op.py,
+test_gru_op.py, test_recurrent_op.py) — numpy references + numeric-gradient
+checks on the padded+lengths representation.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+from op_test import OpTest
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, h0, c0, w_ih, w_hh, b, lengths=None):
+    B, S, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    outs = np.zeros((B, S, H), dtype=np.float32)
+    for t in range(S):
+        gates = x[:, t] @ w_ih + h @ w_hh + b
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = sigmoid(i), sigmoid(f), sigmoid(o)
+        g = np.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * np.tanh(c_new)
+        if lengths is not None:
+            alive = (t < lengths)[:, None]
+            h = np.where(alive, h_new, h)
+            c = np.where(alive, c_new, c)
+            outs[:, t] = np.where(alive, h_new, 0.0)
+        else:
+            h, c = h_new, c_new
+            outs[:, t] = h_new
+    return outs, h, c
+
+
+def np_gru(x, h0, w_ih, w_hh, b_ih, b_hh, lengths=None):
+    B, S, _ = x.shape
+    h = h0.copy()
+    outs = np.zeros((B, S, h.shape[-1]), dtype=np.float32)
+    for t in range(S):
+        gx = x[:, t] @ w_ih + b_ih
+        gh = h @ w_hh + b_hh
+        xr, xz, xn = np.split(gx, 3, axis=-1)
+        hr, hz, hn = np.split(gh, 3, axis=-1)
+        r, z = sigmoid(xr + hr), sigmoid(xz + hz)
+        n = np.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        if lengths is not None:
+            alive = (t < lengths)[:, None]
+            h = np.where(alive, h_new, h)
+            outs[:, t] = np.where(alive, h_new, 0.0)
+        else:
+            h = h_new
+            outs[:, t] = h_new
+    return outs, h
+
+
+class TestLSTMOp(OpTest):
+    op_type = "lstm"
+
+    def setup(self, rng, lengths=None):
+        B, S, I, H = 3, 5, 4, 6
+        x = rng.randn(B, S, I).astype("float32")
+        h0 = rng.randn(1, B, H).astype("float32")
+        c0 = rng.randn(1, B, H).astype("float32")
+        w_ih = (rng.randn(I, 4 * H) * 0.3).astype("float32")
+        w_hh = (rng.randn(H, 4 * H) * 0.3).astype("float32")
+        b = (rng.randn(4 * H) * 0.1).astype("float32")
+        out, hl, cl = np_lstm(x, h0[0], c0[0], w_ih, w_hh, b, lengths)
+        self.inputs = {
+            "Input": [("x", x)],
+            "InitH": [("h0", h0)],
+            "InitC": [("c0", c0)],
+            "WeightIh": [("w_ih", w_ih)],
+            "WeightHh": [("w_hh", w_hh)],
+            "Bias": [("b", b)],
+        }
+        if lengths is not None:
+            self.inputs["SequenceLength"] = [("lens", lengths)]
+        self.outputs = {
+            "Out": [("out", out)],
+            "LastH": [("last_h", hl[None])],
+            "LastC": [("last_c", cl[None])],
+        }
+        self.attrs = {"num_layers": 1, "is_bidirec": False, "hidden_size": 6}
+
+
+def test_lstm_op_output(rng):
+    t = TestLSTMOp()
+    t.setup(rng)
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_op_masked(rng):
+    t = TestLSTMOp()
+    t.setup(rng, lengths=np.array([5, 2, 3], dtype="int64"))
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_op_grad(rng):
+    t = TestLSTMOp()
+    t.setup(rng)
+    t.check_grad(["x", "w_ih", "w_hh"], "out", max_relative_error=0.02)
+
+
+class TestGRUOp(OpTest):
+    op_type = "gru"
+
+    def setup(self, rng, lengths=None):
+        B, S, I, H = 3, 4, 4, 5
+        x = rng.randn(B, S, I).astype("float32")
+        h0 = rng.randn(1, B, H).astype("float32")
+        w_ih = (rng.randn(I, 3 * H) * 0.3).astype("float32")
+        w_hh = (rng.randn(H, 3 * H) * 0.3).astype("float32")
+        b_ih = (rng.randn(3 * H) * 0.1).astype("float32")
+        b_hh = (rng.randn(3 * H) * 0.1).astype("float32")
+        out, hl = np_gru(x, h0[0], w_ih, w_hh, b_ih, b_hh, lengths)
+        self.inputs = {
+            "Input": [("x", x)],
+            "InitH": [("h0", h0)],
+            "WeightIh": [("w_ih", w_ih)],
+            "WeightHh": [("w_hh", w_hh)],
+            "BiasIh": [("b_ih", b_ih)],
+            "BiasHh": [("b_hh", b_hh)],
+        }
+        if lengths is not None:
+            self.inputs["SequenceLength"] = [("lens", lengths)]
+        self.outputs = {"Out": [("out", out)], "LastH": [("last_h", hl[None])]}
+        self.attrs = {"num_layers": 1, "is_bidirec": False, "hidden_size": 5}
+
+
+def test_gru_op_output(rng):
+    t = TestGRUOp()
+    t.setup(rng)
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_gru_op_masked(rng):
+    t = TestGRUOp()
+    t.setup(rng, lengths=np.array([4, 1, 3], dtype="int64"))
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_gru_op_grad(rng):
+    t = TestGRUOp()
+    t.setup(rng)
+    t.check_grad(["x", "w_ih"], "out", max_relative_error=0.02)
+
+
+def test_lstm_layer_bidirectional(rng):
+    """2-layer biLSTM through the layer API: shape + determinism check."""
+    B, S, I, H = 2, 6, 3, 4
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, S, I])
+        h0 = fluid.layers.fill_constant([4, B, H], "float32", 0.0)
+        c0 = fluid.layers.fill_constant([4, B, H], "float32", 0.0)
+        out, lh, lc = fluid.layers.lstm(
+            x, h0, c0, hidden_size=H, num_layers=2, is_bidirec=True
+        )
+        loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(B, S, I).astype("float32")}
+    o1, l1 = exe.run(main, feed=feed, fetch_list=[out, loss])
+    assert o1.shape == (B, S, 2 * H)
+    o2 = exe.run(main, feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_lstm_layer_trains(rng):
+    """Gradients flow through the fused lstm op into its weights."""
+    B, S, I, H = 4, 5, 3, 4
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, S, I])
+        y = fluid.data("y", shape=[-1, 1])
+        h0 = fluid.layers.fill_constant([1, B, H], "float32", 0.0)
+        c0 = fluid.layers.fill_constant([1, B, H], "float32", 0.0)
+        out, _, _ = fluid.layers.lstm(x, h0, c0, hidden_size=H)
+        last = fluid.layers.slice(out, axes=[1], starts=[S - 1], ends=[S])
+        pred = fluid.layers.fc(
+            fluid.layers.reshape(last, [0, H]), size=1, num_flatten_dims=1
+        )
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "x": rng.randn(B, S, I).astype("float32"),
+        "y": rng.randn(B, 1).astype("float32"),
+    }
+    losses = [
+        float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+        for _ in range(8)
+    ]
+    assert losses[-1] < losses[0], losses
+
+
+def test_static_rnn_matches_manual(rng):
+    """StaticRNN fc cell == manually unrolled same-weight computation."""
+    T, B, I, H = 4, 3, 5, 6
+    x_np = rng.randn(T, B, I).astype("float32")
+    h0_np = rng.randn(B, H).astype("float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[T, B, I])
+        h0 = fluid.data("h0", shape=[B, H])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            hid = fluid.layers.fc(
+                input=fluid.layers.concat([x_t, prev], axis=1),
+                size=H,
+                act="tanh",
+                param_attr=fluid.ParamAttr(name="cell_w"),
+                bias_attr=fluid.ParamAttr(name="cell_b"),
+                num_flatten_dims=1,
+            )
+            rnn.update_memory(prev, hid)
+            rnn.step_output(hid)
+        out = rnn()
+        loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, w, b = exe.run(
+        main,
+        feed={"x": x_np, "h0": h0_np},
+        fetch_list=[out, "cell_w", "cell_b"],
+    )
+    h = h0_np
+    expect = np.zeros((T, B, H), dtype=np.float32)
+    for t in range(T):
+        h = np.tanh(np.concatenate([x_np[t], h], axis=1) @ w + b)
+        expect[t] = h
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_static_rnn_gradients(rng):
+    """Numeric-vs-analytic gradient through the recurrent op (scan vjp)."""
+    T, B, I, H = 3, 2, 3, 3
+    x_np = (rng.randn(T, B, I) * 0.5).astype("float32")
+    h0_np = np.zeros((B, H), dtype="float32")
+
+    def build():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.data("x", shape=[T, B, I])
+            x.stop_gradient = False
+            h0 = fluid.data("h0", shape=[B, H])
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                x_t = rnn.step_input(x)
+                prev = rnn.memory(init=h0)
+                hid = fluid.layers.fc(
+                    input=fluid.layers.concat([x_t, prev], axis=1),
+                    size=H,
+                    act="tanh",
+                    param_attr=fluid.ParamAttr(name="w"),
+                    bias_attr=False,
+                    num_flatten_dims=1,
+                )
+                rnn.update_memory(prev, hid)
+                rnn.step_output(hid)
+            out = rnn()
+            loss = fluid.layers.mean(out)
+            grads = fluid.gradients(loss, [x])
+        return main, startup, loss, grads
+
+    main, startup, loss, grads = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": x_np, "h0": h0_np}
+    analytic = np.asarray(
+        exe.run(main, feed=feed, fetch_list=[grads[0].name])[0]
+    )
+
+    delta = 1e-3
+    numeric = np.zeros_like(x_np)
+    flat = x_np.reshape(-1)
+    for i in range(flat.size):
+        for sgn in (1, -1):
+            f = flat.copy()
+            f[i] += sgn * delta
+            r = exe.run(
+                main,
+                feed={"x": f.reshape(x_np.shape), "h0": h0_np},
+                fetch_list=[loss],
+            )
+            numeric.reshape(-1)[i] += sgn * float(np.asarray(r[0])[0])
+    numeric /= 2 * delta
+    np.testing.assert_allclose(analytic, numeric, rtol=0.02, atol=1e-4)
+
+
+def test_dynamic_lstm_gru_layers(rng):
+    B, S, I = 3, 4, 5
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, S, I])
+        lens = fluid.data("lens", shape=[-1], dtype="int64")
+        h, c = fluid.layers.dynamic_lstm(x, size=16, sequence_length=lens)
+        g = fluid.layers.dynamic_gru(x, size=6, sequence_length=lens)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(
+        main,
+        feed={
+            "x": rng.randn(B, S, I).astype("float32"),
+            "lens": np.array([4, 2, 1], dtype="int64"),
+        },
+        fetch_list=[h, g],
+    )
+    assert out[0].shape == (B, S, 4)
+    assert out[1].shape == (B, S, 6)
+    # padded region beyond each sequence's length must be zero
+    assert np.allclose(out[0][1, 2:], 0) and np.allclose(out[1][2, 1:], 0)
+
+
+def test_static_rnn_memory_batch_ref(rng):
+    """memory(shape=, batch_ref=step_input_result) — the standard fluid
+    idiom: the boot memory's batch comes from the outer sequence."""
+    T, B, I, H = 3, 4, 5, 6
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[T, B, I])
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(shape=[-1, H], batch_ref=x_t, init_value=0.5)
+            nxt = fluid.layers.elementwise_add(
+                fluid.layers.fc(x_t, size=H, num_flatten_dims=1,
+                                bias_attr=False), prev
+            )
+            rnn.update_memory(prev, nxt)
+            rnn.step_output(nxt)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run(
+        main, feed={"x": rng.randn(T, B, I).astype("float32")},
+        fetch_list=[out],
+    )[0]
+    assert got.shape == (T, B, H)
+
+
+def test_static_rnn_memory_only_rejected(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        h0 = fluid.data("h0", shape=[4, 6])
+        rnn = fluid.layers.StaticRNN()
+        with pytest.raises(Exception, match="step_input"):
+            with rnn.step():
+                prev = rnn.memory(init=h0)
+                rnn.update_memory(prev, prev)
+                rnn.step_output(prev)

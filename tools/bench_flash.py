@@ -1,0 +1,85 @@
+"""Microbench: Pallas flash attention (fwd+bwd) vs unfused jnp attention.
+
+Run on the real chip; prints one JSON line per (seq_len, variant) so the
+long-sequence scaling of the fused kernel is visible (the round-2 jnp
+backward was O(S^2) in HBM and this documents the replacement's win).
+"""
+
+import functools
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def unfused(q, k, v, causal):
+    scale = 1 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        S = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def bench(fn, args, iters=20):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, H, D = 4, 12, 64
+    causal = True
+    for S in (512, 1024, 2048, 4096):
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)).astype(
+                jnp.bfloat16
+            )
+            for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal) ** 2).sum()
+
+        def loss_unfused(q, k, v):
+            return (unfused(q, k, v, causal) ** 2).sum()
+
+        grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        grad_unfused = jax.jit(jax.grad(loss_unfused, argnums=(0, 1, 2)))
+
+        # attention FLOPs fwd+bwd ~ 2 matmuls fwd + 5 bwd (dq,dk,dv,dp,recompute)
+        flops = 7 * 2 * B * H * S * S * D * (0.5 if causal else 1.0)
+        for name, fn in (("flash_pallas", grad_flash),
+                         ("unfused_jnp", grad_unfused)):
+            try:
+                dt, g = bench(fn, (q, k, v))
+                err = None
+            except Exception as e:  # OOM at long S for the unfused path
+                dt, err = None, str(e)[:160]
+            rec = {"seq_len": S, "variant": name}
+            if dt is not None:
+                rec["ms"] = round(1000 * dt, 2)
+                rec["tflops"] = round(flops / dt / 1e12, 1)
+            else:
+                rec["error"] = err
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
