@@ -27,6 +27,27 @@ from paddle_tpu.utils.enforce import EnforceError, enforce
 from paddle_tpu.utils.flags import flags
 
 
+def _to_global(arr, sharding):
+    """Commit a host value to a (possibly multi-process) mesh sharding.
+
+    Single-process meshes take the fast device_put path. In a
+    multi-controller job (the reference's multi-trainer NCCL world,
+    SURVEY §2.8) the mesh spans processes, where numpy inputs must become
+    global jax.Arrays explicitly; every process feeds the same full-size
+    value, and each host materializes only its addressable shards."""
+    if isinstance(arr, jax.Array) and arr.sharding == sharding:
+        return arr  # steady state: the previous step's output, already global
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(arr, sharding)
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        # global -> global reshard (supported device_put path)
+        return jax.device_put(arr, sharding)
+    np_arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        np_arr.shape, sharding, lambda idx: np_arr[idx]
+    )
+
+
 class BuildStrategy:
     """Accepted for API parity (reference: paddle/fluid/framework/details/
     build_strategy.h:37). Fusion/memory-opt toggles are XLA's job; the
@@ -211,23 +232,29 @@ class CompiledProgram:
                 out_shardings=out_shardings,
                 donate_argnums=((1,) if donated else ()),
             )
-            entry = (compiled, donated, readonly, written, scope_shardings)
+            entry = (
+                compiled, donated, readonly, written, scope_shardings,
+                tuple(feed_shardings),
+            )
             self._cache[key] = entry
-        compiled, donated, readonly, written, scope_shardings = entry
+        compiled, donated, readonly, written, scope_shardings = entry[:5]
         missing = [n for n in donated + readonly if not scope.has_var(n)]
         if missing:
             raise EnforceError(
                 f"variables {missing} not initialized in scope "
                 f"(run the startup program first?)"
             )
-        feed_vals = tuple(feed_arrays[n] for n in feed_names)
+        feed_vals = tuple(
+            _to_global(feed_arrays[n], sh)
+            for n, sh in zip(feed_names, entry[5])
+        )
         # commit scope inputs to their mesh shardings so first-step vs
         # steady-state layouts match — same fix as Executor._run_compiled
         donated_vals = tuple(
-            jax.device_put(scope.find_var(n), scope_shardings[n]) for n in donated
+            _to_global(scope.find_var(n), scope_shardings[n]) for n in donated
         )
         readonly_vals = tuple(
-            jax.device_put(scope.find_var(n), scope_shardings[n]) for n in readonly
+            _to_global(scope.find_var(n), scope_shardings[n]) for n in readonly
         )
         rng_key = exe._next_rng_key(self._program)
         with warnings.catch_warnings():

@@ -43,8 +43,13 @@ _OPT_CODES = {"sgd": 0, "adagrad": 1}
 
 class PSDistributedStrategy:
     """reference: incubate/fleet/parameter_server/distribute_transpiler/
-    distributed_strategy.py (Sync/Async/Geo). geo_sgd is accepted but maps
-    to async (delta-sync staleness is subsumed by merge_steps batching)."""
+    distributed_strategy.py (Sync/Async/Geo).
+
+    mode="geo" is GEO-SGD delta-sync (reference: python/paddle/fluid/
+    transpiler/geo_sgd_transpiler.py): dense parameters train LOCALLY with
+    the full optimizer; every `merge_steps` steps the worker pushes
+    (param - param_at_last_sync) / worker_num into the server's global
+    copy and pulls the merged result. Sparse tables stay server-side."""
 
     def __init__(self, mode="sync", sparse_lr=0.1, merge_steps=4):
         enforce(mode in ("sync", "async", "half_async", "geo"), f"bad mode {mode}")
@@ -88,7 +93,10 @@ class PSWorker:
     step itself is a single XLA computation — overlap comes from the async
     Communicator and the DataLoader's prefetch thread."""
 
-    def __init__(self, exe, client, tables, strategy):
+    GEO_DENSE_TABLE = 1 << 30  # reserved dense table id for geo delta-sync
+
+    def __init__(self, exe, client, tables, strategy, program=None,
+                 worker_num=1, is_first_worker=True):
         from paddle_tpu.distributed.ps import Communicator
 
         self._exe = exe
@@ -99,6 +107,73 @@ class PSWorker:
         self._comm = Communicator(
             client, mode=mode, merge_steps=strategy.merge_steps
         )
+        self._geo = strategy.mode == "geo"
+        self._geo_params = []
+        self._geo_snapshot = None
+        self._geo_step = 0
+        self._worker_num = max(int(worker_num), 1)
+        if self._geo and program is not None:
+            self._geo_params = [p.name for p in program.all_parameters()]
+            if self._geo_params:
+                total = self._geo_total_size(program)
+                if is_first_worker:
+                    # create (zero) + seed the global copy with this
+                    # worker's init params; creating on every worker would
+                    # wipe the seed (create replaces the table)
+                    client.create_table(
+                        self.GEO_DENSE_TABLE, dense_size=total,
+                        is_dense=True, optimizer=0,
+                    )
+                    vec = self._concat_params()
+                    client.push_dense(self.GEO_DENSE_TABLE, -vec, 1.0)
+                if self._worker_num > 1:
+                    client.barrier(self._worker_num)
+                if is_first_worker:
+                    self._geo_snapshot = self._concat_params()
+                else:
+                    # startup broadcast: every worker starts from worker 0's
+                    # init (reference: geo_sgd startup param sync)
+                    merged = client.pull_dense(self.GEO_DENSE_TABLE)
+                    self._scatter_params(merged)
+                    self._geo_snapshot = merged
+
+    def _geo_total_size(self, program):
+        return sum(
+            int(np.prod(p.shape)) for p in program.all_parameters()
+        )
+
+    def _concat_params(self, scope=None):
+        from paddle_tpu.core.scope import global_scope
+
+        scope = scope or global_scope()
+        return np.concatenate([
+            np.asarray(scope.find_var(n), dtype=np.float32).reshape(-1)
+            for n in self._geo_params
+        ])
+
+    def _scatter_params(self, vec, scope=None):
+        from paddle_tpu.core.scope import global_scope
+
+        scope = scope or global_scope()
+        off = 0
+        for n in self._geo_params:
+            cur = np.asarray(scope.find_var(n))
+            size = cur.size
+            scope.set(
+                n, vec[off:off + size].reshape(cur.shape).astype(cur.dtype)
+            )
+            off += size
+
+    def _geo_sync(self, scope=None):
+        """Delta push + fresh pull (reference: geo_sgd_transpiler.py — there
+        send_vars of deltas to the pserver's sum table)."""
+        cur = self._concat_params(scope)
+        delta = (cur - self._geo_snapshot) / self._worker_num
+        # server runs param -= lr * grad; lr = -1 turns the push into +=
+        self._client.push_dense(self.GEO_DENSE_TABLE, delta, -1.0)
+        merged = self._client.pull_dense(self.GEO_DENSE_TABLE)
+        self._scatter_params(merged, scope)
+        self._geo_snapshot = merged
 
     def run(self, program, feed, fetch_list=None, scope=None):
         fetch_list = list(fetch_list or [])
@@ -122,10 +197,23 @@ class PSWorker:
                 t["table_id"], pulled[tname], np.asarray(g),
                 self._strategy.sparse_lr,
             )
+        if self._geo and self._geo_params:
+            self._geo_step += 1
+            if self._geo_step % self._strategy.merge_steps == 0:
+                self._geo_sync(scope)
+                self._geo_pending = 0
+            else:
+                self._geo_pending = getattr(self, "_geo_pending", 0) + 1
         return out[:n_user]
 
     def flush(self):
         self._comm.flush()
+        # geo: ship the tail of the last partial merge window — without
+        # this, local progress since the last merge_steps boundary never
+        # reaches the server's global copy
+        if self._geo and getattr(self, "_geo_pending", 0):
+            self._geo_sync()
+            self._geo_pending = 0
 
     def stop(self):
         self._comm.stop()
@@ -185,7 +273,11 @@ class _PSFleet(Fleet):
         program = program or self._origin_program
         tables = getattr(program, "_sparse_tables", {})
         self._worker_obj = PSWorker(
-            exe, self._client, tables, self._strategy or PSDistributedStrategy()
+            exe, self._client, tables,
+            self._strategy or PSDistributedStrategy(),
+            program=program,
+            worker_num=max(self.worker_num(), 1),
+            is_first_worker=self.worker_index() <= 0,
         )
         return self._worker_obj
 

@@ -105,6 +105,19 @@ class Config:
         self._precision = PrecisionType.Bfloat16
 
     def set_precision(self, precision):
+        if precision == PrecisionType.Int8:
+            # no silent mode degradation: the caller asked for an int8
+            # engine (reference: TensorRT int8 calibration path) and gets
+            # bf16 execution instead — say so loudly
+            import warnings
+
+            warnings.warn(
+                "PrecisionType.Int8 requested but this build serves bf16: "
+                "there is no int8 matmul path here (weights are not "
+                "quantized). Use contrib.quantize QAT for int8-simulated "
+                "training, or set Bfloat16 to silence this warning.",
+                stacklevel=2,
+            )
         self._precision = precision
 
     def precision(self):

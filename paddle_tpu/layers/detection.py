@@ -1,0 +1,172 @@
+"""Detection layer API (reference: python/paddle/fluid/layers/detection.py).
+
+Thin builders over ops/detection.py; outputs are fixed-shape (NMS returns a
+keep_top_k slate + count instead of a variable-length LoD tensor).
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "iou_similarity",
+    "box_coder",
+    "box_clip",
+    "prior_box",
+    "anchor_generator",
+    "yolo_box",
+    "multiclass_nms",
+    "bipartite_match",
+]
+
+
+def _one_out(helper, op, inputs, attrs, out_slot="Out", dtype="float32"):
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(op, inputs, {out_slot: [out.name]}, attrs)
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    """reference: python/paddle/fluid/layers/detection.py iou_similarity."""
+    helper = LayerHelper("iou_similarity", name=name)
+    return _one_out(
+        helper, "iou_similarity", {"X": [x.name], "Y": [y.name]}, {}
+    )
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """reference: python/paddle/fluid/layers/detection.py box_coder."""
+    helper = LayerHelper("box_coder", name=name)
+    inputs = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            attrs["variance"] = [float(v) for v in prior_box_var]
+        else:
+            inputs["PriorBoxVar"] = [prior_box_var.name]
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op("box_coder", inputs, {"OutputBox": [out.name]}, attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "box_clip",
+        {"Input": [input.name], "ImInfo": [im_info.name]},
+        {"Output": [out.name]},
+        {},
+    )
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """reference: python/paddle/fluid/layers/detection.py prior_box."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "prior_box",
+        {"Input": [input.name], "Image": [image.name]},
+        {"Boxes": [boxes.name], "Variances": [variances.name]},
+        {
+            "min_sizes": list(min_sizes),
+            "max_sizes": list(max_sizes or []),
+            "aspect_ratios": list(aspect_ratios),
+            "variances": list(variance),
+            "flip": flip,
+            "clip": clip,
+            "step_w": steps[0],
+            "step_h": steps[1],
+            "offset": offset,
+        },
+    )
+    return boxes, variances
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """reference: python/paddle/fluid/layers/detection.py anchor_generator."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "anchor_generator",
+        {"Input": [input.name]},
+        {"Anchors": [anchors.name], "Variances": [variances.name]},
+        {
+            "anchor_sizes": list(anchor_sizes or [64.0, 128.0, 256.0, 512.0]),
+            "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+            "variances": list(variance),
+            "stride": list(stride or [16.0, 16.0]),
+            "offset": offset,
+        },
+    )
+    return anchors, variances
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None):
+    """reference: python/paddle/fluid/layers/detection.py yolo_box."""
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolo_box",
+        {"X": [x.name], "ImgSize": [img_size.name]},
+        {"Boxes": [boxes.name], "Scores": [scores.name]},
+        {
+            "anchors": list(anchors),
+            "class_num": class_num,
+            "conf_thresh": conf_thresh,
+            "downsample_ratio": downsample_ratio,
+            "clip_bbox": clip_bbox,
+        },
+    )
+    return boxes, scores
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    """Fixed-slate NMS: Out [B, keep_top_k, 6] (label, score, box), label=-1
+    marks empty slots; NumDetections [B]
+    (reference: python/paddle/fluid/layers/detection.py multiclass_nms —
+    LoD output there; static slate here, see ops/detection.py)."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "multiclass_nms",
+        {"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        {"Out": [out.name], "NumDetections": [num.name]},
+        {
+            "score_threshold": score_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "nms_threshold": nms_threshold,
+            "normalized": normalized,
+            "background_label": background_label,
+        },
+    )
+    return out, num
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference: python/paddle/fluid/layers/detection.py bipartite_match."""
+    helper = LayerHelper("bipartite_match", name=name)
+    ids = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        "bipartite_match",
+        {"DistMat": [dist_matrix.name]},
+        {"ColToRowMatchIndices": [ids.name], "ColToRowMatchDist": [dist.name]},
+        {},
+    )
+    return ids, dist

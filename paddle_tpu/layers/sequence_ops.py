@@ -1,0 +1,194 @@
+"""Sequence layer API over padded tensors + lengths
+(reference: python/paddle/fluid/layers/sequence_lod.py — there LoD-driven;
+here every function takes an optional `length` [B] tensor, SURVEY §5.7).
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_reverse",
+    "sequence_expand_as",
+    "sequence_concat",
+    "sequence_slice",
+    "sequence_enumerate",
+    "sequence_erase",
+    "sequence_mask",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_conv",
+    "sequence_first_step",
+    "sequence_last_step",
+]
+
+
+def _seq_inputs(x, length):
+    ins = {"X": [x.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    return ins
+
+
+def _one(helper, op, ins, attrs, dtype, slot="Out"):
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(op, ins, {slot: [out.name]}, attrs)
+    return out
+
+
+def sequence_pool(input, pool_type, length=None, name=None):
+    """reference: python/paddle/fluid/layers/sequence_lod.py sequence_pool."""
+    helper = LayerHelper("sequence_pool", name=name)
+    return _one(
+        helper, "sequence_pool", _seq_inputs(input, length),
+        {"pooltype": pool_type.upper()}, input.dtype,
+    )
+
+
+def sequence_first_step(input, length=None, name=None):
+    return sequence_pool(input, "FIRST", length, name)
+
+
+def sequence_last_step(input, length=None, name=None):
+    return sequence_pool(input, "LAST", length, name)
+
+
+def sequence_softmax(input, length=None, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    return _one(
+        helper, "sequence_softmax", _seq_inputs(input, length), {},
+        input.dtype,
+    )
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    return _one(
+        helper, "sequence_reverse", _seq_inputs(x, length), {}, x.dtype, "Y"
+    )
+
+
+def sequence_expand_as(x, y, length=None, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    ins = {"X": [x.name], "Y": [y.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    return _one(helper, "sequence_expand_as", ins, {}, x.dtype)
+
+
+def sequence_concat(input, lengths=None, name=None):
+    """Row-wise concatenation; returns (out, out_length)."""
+    helper = LayerHelper("sequence_concat", name=name)
+    ins = {"X": [v.name for v in input]}
+    if lengths is not None:
+        ins["Length"] = [v.name for v in lengths]
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sequence_concat", ins,
+        {"Out": [out.name], "OutLength": [out_len.name]}, {},
+    )
+    return out, out_len
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    return _one(
+        helper, "sequence_slice",
+        {"X": [input.name], "Offset": [offset.name], "Length": [length.name]},
+        {}, input.dtype,
+    )
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    return _one(
+        helper, "sequence_enumerate", _seq_inputs(input, length),
+        {"win_size": win_size, "pad_value": pad_value}, input.dtype,
+    )
+
+
+def sequence_erase(input, tokens, length=None, name=None):
+    """Returns (out, new_length)."""
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sequence_erase", _seq_inputs(input, length),
+        {"Out": [out.name], "OutLength": [out_len.name]},
+        {"tokens": list(tokens)},
+    )
+    return out, out_len
+
+
+def sequence_mask(x, maxlen, dtype="int64", name=None):
+    """reference: python/paddle/fluid/layers/sequence_lod.py sequence_mask.
+    maxlen must be a static int on TPU."""
+    helper = LayerHelper("sequence_mask", name=name)
+    return _one(
+        helper, "sequence_mask", {"X": [x.name]},
+        {"maxlen": int(maxlen), "out_dtype": dtype}, dtype, "Y"
+    )
+
+
+def sequence_pad(x, pad_value=0.0, length=None, name=None):
+    """Returns (out, length)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sequence_pad", _seq_inputs(x, length),
+        {"Out": [out.name], "Length": [out_len.name]},
+        {"pad_value": float(pad_value)},
+    )
+    return out, out_len
+
+
+def sequence_unpad(x, length=None, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    return _one(
+        helper, "sequence_unpad", _seq_inputs(x, length), {}, x.dtype
+    )
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, length=None,
+                  param_attr=None, bias_attr=None, act=None, name=None):
+    """Context-window projection (reference: python/paddle/fluid/layers/
+    sequence_lod.py sequence_conv)."""
+    from paddle_tpu.utils.enforce import enforce
+
+    enforce(
+        filter_stride == 1,
+        "sequence_conv supports filter_stride=1 only (the op lowering is "
+        "stride-1; a strided variant would change the output length)",
+    )
+    helper = LayerHelper(
+        "sequence_conv", param_attr=param_attr, bias_attr=bias_attr,
+        act=act, name=name,
+    )
+    feat = int(input.shape[-1])
+    w = helper.create_parameter(
+        helper.param_attr, shape=[filter_size * feat, num_filters],
+        dtype=input.dtype,
+    )
+    ins = _seq_inputs(input, length)
+    ins["Filter"] = [w.name]
+    start = (
+        padding_start
+        if padding_start is not None
+        else -((filter_size - 1) // 2)
+    )
+    out = _one(
+        helper, "sequence_conv", ins,
+        {"contextLength": filter_size, "contextStart": start,
+         "contextStride": filter_stride},
+        input.dtype,
+    )
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_filters], dtype=input.dtype,
+            is_bias=True,
+        )
+        out = helper.append_bias_op(out, b, axis=2)
+    return helper.append_activation(out)

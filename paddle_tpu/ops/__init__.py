@@ -14,3 +14,5 @@ from paddle_tpu.ops import optimizers  # noqa: F401
 from paddle_tpu.ops import control_flow  # noqa: F401
 from paddle_tpu.ops import recompute  # noqa: F401
 from paddle_tpu.ops import rnn  # noqa: F401
+from paddle_tpu.ops import sequence  # noqa: F401
+from paddle_tpu.ops import detection  # noqa: F401

@@ -1,0 +1,352 @@
+"""Detection ops: anchors/priors, box transforms, IoU, NMS, YOLO decode.
+
+The reference's detection library (reference: paddle/fluid/operators/
+detection/ — multiclass_nms_op.cc, yolo_box_op.h, prior_box_op.h,
+box_coder_op.h, iou_similarity_op.h, bipartite_match_op.cc) is host-side
+C++ with dynamic-length outputs. TPU-native redesign: everything is
+fixed-shape and vectorized — NMS returns a fixed keep_top_k slate with a
+validity mask and -1 labels for empty slots instead of a variable-length
+LoD tensor, so the whole post-processing graph stays on-device under XLA.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+_NEG = -1e9
+
+
+def _iou(a, b):
+    """Pairwise IoU. a: [N, 4], b: [M, 4] in (x1, y1, x2, y2)."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", nondiff_inputs=("X", "Y"))
+def _iou_similarity(ins, attrs):
+    """reference: paddle/fluid/operators/detection/iou_similarity_op.h."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    if x.ndim == 3:  # batched [B, N, 4]
+        out = jax.vmap(_iou)(x, y)
+    else:
+        out = _iou(x, y)
+    return {"Out": [out]}
+
+
+@register_op("box_clip", nondiff_inputs=("ImInfo",))
+def _box_clip(ins, attrs):
+    """Clip boxes to image bounds (reference: box_clip_op.h). ImInfo rows are
+    (height, width, scale)."""
+    boxes = first(ins, "Input")
+    im = first(ins, "ImInfo")
+    h = im[..., 0:1] - 1.0
+    w = im[..., 1:2] - 1.0
+    if boxes.ndim == 3:
+        h = h[:, None]
+        w = w[:, None]
+    x1 = jnp.clip(boxes[..., 0::4], 0, w)
+    y1 = jnp.clip(boxes[..., 1::4], 0, h)
+    x2 = jnp.clip(boxes[..., 2::4], 0, w)
+    y2 = jnp.clip(boxes[..., 3::4], 0, h)
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(boxes.shape)
+    return {"Output": [out]}
+
+
+@register_op("box_coder", nondiff_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ins, attrs):
+    """Encode/decode boxes against priors
+    (reference: paddle/fluid/operators/detection/box_coder_op.h)."""
+    prior = first(ins, "PriorBox")  # [M, 4]
+    pvar = maybe(ins, "PriorBoxVar")
+    target = first(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    var = (
+        pvar
+        if pvar is not None
+        else jnp.asarray(attrs.get("variance", [1.0, 1.0, 1.0, 1.0]),
+                         jnp.float32)
+    )
+    if code_type.startswith("encode"):
+        # target [N, 4] against every prior -> [N, M, 4]
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.clip(tw[:, None] / pw[None, :], 1e-8))
+        dh = jnp.log(jnp.clip(th[:, None] / ph[None, :], 1e-8))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        v = var if var.ndim == 2 else var.reshape(1, -1)
+        out = out / v[None, :, :] if var.ndim == 2 else out / v[None]
+    else:  # decode: target [N, M, 4] deltas (or [M, 4])
+        t = target if target.ndim == 3 else target[None]
+        v = var if var.ndim == 2 else var.reshape(1, 1, -1)
+        t = t * (v if v.ndim == 3 else var[None, :, :])
+        cx = t[..., 0] * pw[None, :] + pcx[None, :]
+        cy = t[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(t[..., 2]) * pw[None, :]
+        h = jnp.exp(t[..., 3]) * ph[None, :]
+        out = jnp.stack(
+            [cx - w * 0.5, cy - h * 0.5,
+             cx + w * 0.5 - one, cy + h * 0.5 - one], axis=-1
+        )
+        if target.ndim == 2:
+            out = out[0]
+    return {"OutputBox": [out]}
+
+
+@register_op("prior_box", nondiff_inputs=("Input", "Image"))
+def _prior_box(ins, attrs):
+    """SSD prior boxes per feature-map cell
+    (reference: paddle/fluid/operators/detection/prior_box_op.h)."""
+    feat = first(ins, "Input")  # [B, C, H, W]
+    img = first(ins, "Image")  # [B, C, IH, IW]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for a in attrs.get("aspect_ratios", [1.0]):
+        a = float(a)
+        if not any(abs(a - e) < 1e-6 for e in ars):
+            ars.append(a)
+            if attrs.get("flip", True):
+                ars.append(1.0 / a)
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    step_w = attrs.get("step_w", 0.0) or IW / W
+    step_h = attrs.get("step_h", 0.0) or IH / H
+    offset = attrs.get("offset", 0.5)
+    cx = (jnp.arange(W) + offset) * step_w
+    cy = (jnp.arange(H) + offset) * step_h
+    widths, heights = [], []
+    for k, ms in enumerate(min_sizes):
+        for ar in ars:
+            widths.append(ms * (ar ** 0.5))
+            heights.append(ms / (ar ** 0.5))
+        if max_sizes:
+            s = (ms * max_sizes[k]) ** 0.5
+            widths.append(s)
+            heights.append(s)
+    wv = jnp.asarray(widths, jnp.float32)
+    hv = jnp.asarray(heights, jnp.float32)
+    P = wv.shape[0]
+    gx = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    gy = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    boxes = jnp.stack(
+        [
+            (gx - wv / 2) / IW,
+            (gy - hv / 2) / IH,
+            (gx + wv / 2) / IW,
+            (gy + hv / 2) / IH,
+        ],
+        axis=-1,
+    )
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    variances = jnp.broadcast_to(var, (H, W, P, 4))
+    return {"Boxes": [boxes], "Variances": [variances]}
+
+
+@register_op("yolo_box", nondiff_inputs=("X", "ImgSize"))
+def _yolo_box(ins, attrs):
+    """Decode YOLOv3 head output to boxes+scores
+    (reference: paddle/fluid/operators/detection/yolo_box_op.h)."""
+    x = first(ins, "X")  # [B, A*(5+C), H, W]
+    img_size = first(ins, "ImgSize")  # [B, 2] (h, w)
+    anchors = attrs["anchors"]  # flat [w0, h0, w1, h1, ...]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    clip_bbox = attrs.get("clip_bbox", True)
+    B, _, H, W = x.shape
+    A = len(anchors) // 2
+    aw = jnp.asarray(anchors[0::2], jnp.float32)
+    ah = jnp.asarray(anchors[1::2], jnp.float32)
+    x = x.reshape(B, A, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / H
+    in_h = H * downsample
+    in_w = W * downsample
+    bw = jnp.exp(x[:, :, 2]) * aw[None, :, None, None] / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah[None, :, None, None] / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    keep = conf > conf_thresh
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, A * H * W, 4)
+    scores = jnp.where(keep[:, :, None], probs, 0.0)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(B, A * H * W, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+def _nms_single_class(iou_full, scores, iou_threshold, top_k):
+    """Greedy NMS over one class given the PRECOMPUTED pairwise IoU of all
+    boxes (shared across classes — boxes are class-independent, only the
+    score order differs). Returns (scores, idx) of the top_k slate,
+    suppressed entries scored -inf. Static shapes, lax.fori_loop."""
+    N = scores.shape[0]
+    top_k = min(top_k, N)
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    iou = iou_full[order][:, order]
+
+    def body(i, alive):
+        # if candidate i is alive, kill everything it overlaps
+        kill = (iou[i] > iou_threshold) & (jnp.arange(N) > i)
+        return jnp.where(alive[i], alive & ~kill, alive)
+
+    alive = jax.lax.fori_loop(0, N, body, jnp.ones((N,), bool))
+    kept_scores = jnp.where(alive, s, _NEG)
+    sel = jnp.argsort(-kept_scores)[:top_k]
+    return kept_scores[sel], order[sel]
+
+
+@register_op("multiclass_nms", nondiff_inputs=("BBoxes", "Scores"))
+def _multiclass_nms(ins, attrs):
+    """Fixed-slate multiclass NMS (reference: multiclass_nms_op.cc).
+
+    The reference emits a variable-length LoD result; here the output is
+    Out [B, keep_top_k, 6] rows (label, score, x1, y1, x2, y2) with label=-1
+    for empty slots, plus NumDetections [B] — the static-shape contract XLA
+    needs. score_threshold/nms_top_k/keep_top_k/nms_threshold as reference.
+    """
+    bboxes = first(ins, "BBoxes")  # [B, N, 4]
+    scores = first(ins, "Scores")  # [B, C, N]
+    score_thresh = attrs.get("score_threshold", 0.0)
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+    nms_top_k = attrs.get("nms_top_k", 400)
+    keep_top_k = attrs.get("keep_top_k", 100)
+    background = attrs.get("background_label", 0)
+    B, C, N = scores.shape
+
+    def per_image(boxes, sc):
+        iou_full = _iou(boxes, boxes)  # once per image, shared by classes
+        slates_s, slates_l, slates_b = [], [], []
+        for c in range(C):
+            if c == background:
+                continue
+            s = jnp.where(sc[c] > score_thresh, sc[c], _NEG)
+            ks, ki = _nms_single_class(iou_full, s, nms_thresh,
+                                       min(nms_top_k, N))
+            slates_s.append(ks)
+            slates_l.append(jnp.full(ks.shape, c, jnp.float32))
+            slates_b.append(boxes[ki])
+        all_s = jnp.concatenate(slates_s)
+        all_l = jnp.concatenate(slates_l)
+        all_b = jnp.concatenate(slates_b)
+        k = min(keep_top_k, all_s.shape[0])
+        sel = jnp.argsort(-all_s)[:k]
+        s = all_s[sel]
+        valid = s > max(score_thresh, _NEG / 2)
+        out = jnp.concatenate(
+            [
+                jnp.where(valid, all_l[sel], -1.0)[:, None],
+                jnp.where(valid, s, 0.0)[:, None],
+                jnp.where(valid[:, None], all_b[sel], 0.0),
+            ],
+            axis=1,
+        )
+        return out, valid.sum().astype(jnp.int64)
+
+    out, num = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "NumDetections": [num]}
+
+
+@register_op("bipartite_match", nondiff_inputs=("DistMat",))
+def _bipartite_match(ins, attrs):
+    """Greedy bipartite matching of columns to rows by descending distance
+    (reference: bipartite_match_op.cc BipartiteMatch). DistMat [N, M]:
+    rows = ground truth, cols = priors. Outputs per-col matched row ids
+    (-1 unmatched) and the match distance."""
+    dist = first(ins, "DistMat")
+
+    def match(d):
+        N, M = d.shape
+
+        def body(_, carry):
+            row_used, col_match, col_dist = carry
+            masked = jnp.where(row_used[:, None], _NEG, d)
+            masked = jnp.where(col_match[None, :] >= 0, _NEG, masked)
+            flat = jnp.argmax(masked)
+            r, c = flat // M, flat % M
+            best = masked[r, c]
+            do = best > _NEG / 2
+            row_used = row_used.at[r].set(row_used[r] | do)
+            col_match = col_match.at[c].set(
+                jnp.where(do, r, col_match[c])
+            )
+            col_dist = col_dist.at[c].set(
+                jnp.where(do, best, col_dist[c])
+            )
+            return row_used, col_match, col_dist
+
+        init = (
+            jnp.zeros((N,), bool),
+            jnp.full((M,), -1, jnp.int32),
+            jnp.zeros((M,), jnp.float32),
+        )
+        _, col_match, col_dist = jax.lax.fori_loop(0, N, body, init)
+        return col_match, col_dist
+
+    if dist.ndim == 3:
+        ids, d = jax.vmap(match)(dist)
+    else:
+        ids, d = match(dist)
+        ids, d = ids[None], d[None]
+    return {"ColToRowMatchIndices": [ids], "ColToRowMatchDist": [d]}
+
+
+@register_op("anchor_generator", nondiff_inputs=("Input",))
+def _anchor_generator(ins, attrs):
+    """RPN anchors per cell (reference: anchor_generator_op.h)."""
+    feat = first(ins, "Input")  # [B, C, H, W]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64.0])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    H, W = feat.shape[2], feat.shape[3]
+    ws = jnp.asarray(
+        [s * (1.0 / r) ** 0.5 for r in ratios for s in sizes], jnp.float32
+    )
+    hs = jnp.asarray(
+        [s * r ** 0.5 for r in ratios for s in sizes], jnp.float32
+    )
+    cx = (jnp.arange(W) + offset) * stride[0]
+    cy = (jnp.arange(H) + offset) * stride[1]
+    A = ws.shape[0]
+    gx = jnp.broadcast_to(cx[None, :, None], (H, W, A))
+    gy = jnp.broadcast_to(cy[:, None, None], (H, W, A))
+    anchors = jnp.stack(
+        [gx - ws / 2, gy - hs / 2, gx + ws / 2, gy + hs / 2], axis=-1
+    )
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    variances = jnp.broadcast_to(var, (H, W, A, 4))
+    return {"Anchors": [anchors], "Variances": [variances]}

@@ -1,0 +1,281 @@
+"""Sequence ops over padded [B, S, ...] tensors + per-row lengths.
+
+The reference's sequence_ops family operates on LoD ragged batches
+(reference: paddle/fluid/operators/sequence_ops/ — sequence_pool_op.h,
+sequence_softmax_op.h, sequence_expand_op.h, ...). On TPU, ragged offsets
+are hostile to static-shape XLA, so the whole family is re-based on the
+padded+lengths representation (SURVEY §5.7: "subsume LoD by dense
+padding+segment-ids"): every op takes a dense [B, S, ...] tensor and an
+optional integer Length [B]; masked positions do not contribute.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+from paddle_tpu.utils.enforce import EnforceError
+
+_NEG = -1e30
+
+
+def _len_mask(x, lengths, fill=0.0):
+    """[B, S] validity mask broadcast to x's rank; None lengths = all valid."""
+    B, S = x.shape[0], x.shape[1]
+    if lengths is None:
+        return jnp.ones((B, S), bool)
+    return jnp.arange(S)[None, :] < lengths.reshape(B, 1)
+
+
+def _bcast(mask, x):
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_pool", nondiff_inputs=("Length",))
+def _sequence_pool(ins, attrs):
+    """reference: paddle/fluid/operators/sequence_ops/sequence_pool_op.h.
+    pooltype in {SUM, AVERAGE, SQRT, MAX, LAST, FIRST}; output [B, ...]."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _len_mask(x, lengths)
+    m = _bcast(mask, x)
+    B, S = x.shape[0], x.shape[1]
+    n = (
+        jnp.full((B,), S, jnp.float32)
+        if lengths is None
+        else jnp.maximum(lengths.astype(jnp.float32), 1.0)
+    )
+    nb = n.reshape((B,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.where(m, x, 0).sum(axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.where(m, x, 0).sum(axis=1) / nb
+    elif ptype == "SQRT":
+        out = jnp.where(m, x, 0).sum(axis=1) / jnp.sqrt(nb)
+    elif ptype == "MAX":
+        out = jnp.where(m, x, _NEG).max(axis=1)
+    elif ptype == "LAST":
+        idx = (
+            jnp.full((B,), S - 1, jnp.int32)
+            if lengths is None
+            else jnp.maximum(lengths.astype(jnp.int32) - 1, 0)
+        )
+        out = jnp.take_along_axis(
+            x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise EnforceError(f"unknown pooltype {ptype}")
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("sequence_softmax", nondiff_inputs=("Length",))
+def _sequence_softmax(ins, attrs):
+    """Softmax over the valid prefix of each row
+    (reference: sequence_softmax_op.h — there per-LoD-span)."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    mask = _len_mask(x, lengths)
+    z = jnp.where(mask, x, _NEG)
+    out = jax.nn.softmax(z, axis=1)
+    return {"Out": [jnp.where(mask, out, 0.0).astype(x.dtype)]}
+
+
+@register_op("sequence_reverse", nondiff_inputs=("Length",))
+def _sequence_reverse(ins, attrs):
+    """Reverse each row's valid prefix, keeping padding in place
+    (reference: sequence_reverse_op.cc)."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    B, S = x.shape[0], x.shape[1]
+    if lengths is None:
+        return {"Y": [x[:, ::-1]]}
+    pos = jnp.arange(S)[None, :]
+    L = lengths.reshape(B, 1).astype(jnp.int32)
+    src = jnp.where(pos < L, L - 1 - pos, pos)
+    return {"Y": [jnp.take_along_axis(x, src.reshape((B, S) + (1,) * (x.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_expand_as", nondiff_inputs=("Length",))
+def _sequence_expand_as(ins, attrs):
+    """Tile each batch row across its row's full sequence axis
+    (reference: sequence_expand_as_op.h — x row i repeated len(y_i) times).
+    Padded form: X [B, ...] -> Out [B, S, ...] masked by Length."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    lengths = maybe(ins, "Length")
+    S = y.shape[1]
+    out = jnp.broadcast_to(
+        x[:, None], (x.shape[0], S) + tuple(x.shape[1:])
+    )
+    mask = _len_mask(out, lengths)
+    return {"Out": [jnp.where(_bcast(mask, out), out, 0).astype(x.dtype)]}
+
+
+@register_op("sequence_concat", nondiff_inputs=("Length",))
+def _sequence_concat(ins, attrs):
+    """Concatenate sequences row-wise: out row = x1_row[:l1] ++ x2_row[:l2],
+    padded to S1+S2 (reference: sequence_concat_op.h). Inputs X (list),
+    Length (matching list, optional => full)."""
+    xs = ins["X"]
+    lens = ins.get("Length")
+    B = xs[0].shape[0]
+    S_out = sum(x.shape[1] for x in xs)
+    feat = tuple(xs[0].shape[2:])
+    out = jnp.zeros((B, S_out) + feat, xs[0].dtype)
+    # scatter each source row at its running offset
+    offs = jnp.zeros((B,), jnp.int32)
+    pos_out = jnp.arange(S_out)
+    total = jnp.zeros((B,), jnp.int32)
+    for i, x in enumerate(xs):
+        S = x.shape[1]
+        L = (
+            jnp.full((B,), S, jnp.int32)
+            if lens is None
+            else lens[i].astype(jnp.int32)
+        )
+        # out[b, offs[b] + j] = x[b, j] for j < L[b]
+        src_idx = pos_out[None, :] - offs[:, None]  # [B, S_out]
+        valid = (src_idx >= 0) & (src_idx < L[:, None])
+        gathered = jnp.take_along_axis(
+            x,
+            jnp.clip(src_idx, 0, S - 1).reshape((B, S_out) + (1,) * (x.ndim - 2)),
+            axis=1,
+        )
+        out = jnp.where(_bcast(valid, out), gathered, out)
+        offs = offs + L
+        total = total + L
+    return {"Out": [out], "OutLength": [total.astype(jnp.int64)]}
+
+
+@register_op("sequence_slice", nondiff_inputs=("Offset", "Length"))
+def _sequence_slice(ins, attrs):
+    """Per-row slice [offset, offset+length) shifted to position 0
+    (reference: sequence_slice_op.h)."""
+    x = first(ins, "X")
+    offset = first(ins, "Offset").astype(jnp.int32).reshape(-1)
+    length = first(ins, "Length").astype(jnp.int32).reshape(-1)
+    B, S = x.shape[0], x.shape[1]
+    pos = jnp.arange(S)[None, :]
+    src = jnp.clip(pos + offset[:, None], 0, S - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape((B, S) + (1,) * (x.ndim - 2)), axis=1
+    )
+    mask = pos < length[:, None]
+    return {"Out": [jnp.where(_bcast(mask, out), out, 0).astype(x.dtype)]}
+
+
+@register_op("sequence_enumerate", nondiff_inputs=("X", "Length"))
+def _sequence_enumerate(ins, attrs):
+    """Sliding windows of ids: out[b, t] = x[b, t:t+win]
+    (reference: sequence_enumerate_op.h), pad_value past the row's end."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    B, S = x.shape[0], x.shape[1]
+    L = (
+        jnp.full((B, 1), S, jnp.int32)
+        if lengths is None
+        else lengths.reshape(B, 1).astype(jnp.int32)
+    )
+    pos = jnp.arange(S)[None, :]
+    cols = []
+    for k in range(win):
+        idx = jnp.clip(pos + k, 0, S - 1)
+        v = jnp.take_along_axis(x, idx, axis=1)
+        cols.append(jnp.where(pos + k < L, v, pad))
+    return {"Out": [jnp.stack(cols, axis=-1)]}
+
+
+@register_op("sequence_erase", nondiff_inputs=("X", "Length"))
+def _sequence_erase(ins, attrs):
+    """Remove listed tokens, compacting each row to the left
+    (reference: sequence_erase_op.h). Static-shape form: output keeps S
+    columns, compacted prefix + pad 0, plus the new lengths."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    B, S = x.shape[0], x.shape[1]
+    valid = _len_mask(x, lengths)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable-compact kept entries to the front of each row
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1)
+    pos = jnp.arange(S)[None, :]
+    out = jnp.where(pos < new_len[:, None], compacted, 0)
+    return {"Out": [out], "OutLength": [new_len.astype(jnp.int64)]}
+
+
+@register_op("sequence_mask", nondiff_inputs=("X",))
+def _sequence_mask(ins, attrs):
+    """Lengths -> [B, maxlen] 0/1 mask (reference: sequence_mask_op.h)."""
+    x = first(ins, "X").reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise EnforceError(
+            "sequence_mask needs a static maxlen attr on TPU (dynamic "
+            "output shapes cannot be compiled)"
+        )
+    from paddle_tpu.core.dtypes import to_numpy_dtype
+
+    dt = to_numpy_dtype(attrs.get("out_dtype", "int64"))
+    mask = jnp.arange(maxlen)[None, :] < x[:, None]
+    return {"Y": [mask.astype(dt)]}
+
+
+@register_op("sequence_pad", nondiff_inputs=("Length",))
+def _sequence_pad(ins, attrs):
+    """Already-padded input re-padded with an explicit value beyond each
+    row's length (reference: sequence_pad_op.h — there LoD->dense; here it
+    normalizes the padded region to pad_value and reports lengths)."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    pad_value = attrs.get("pad_value", 0.0)
+    mask = _len_mask(x, lengths)
+    out = jnp.where(_bcast(mask, x), x, pad_value)
+    B, S = x.shape[0], x.shape[1]
+    L = (
+        jnp.full((B,), S, jnp.int64)
+        if lengths is None
+        else lengths.astype(jnp.int64)
+    )
+    return {"Out": [out.astype(x.dtype)], "Length": [L]}
+
+
+@register_op("sequence_unpad", nondiff_inputs=("Length",))
+def _sequence_unpad(ins, attrs):
+    """Zero the padding (the static-shape analog of LoD unpad,
+    reference: sequence_unpad_op.h)."""
+    x = first(ins, "X")
+    lengths = maybe(ins, "Length")
+    mask = _len_mask(x, lengths)
+    return {"Out": [jnp.where(_bcast(mask, x), x, 0).astype(x.dtype)]}
+
+
+@register_op("sequence_conv", nondiff_inputs=("Length",))
+def _sequence_conv(ins, attrs):
+    """Context-window convolution over time (reference: sequence_conv_op.h):
+    each output position sees [t+start, t+start+ctx) rows stacked then
+    projected by Filter [ctx*feat, out]."""
+    x = first(ins, "X")
+    w = first(ins, "Filter")
+    lengths = maybe(ins, "Length")
+    ctx = attrs.get("contextLength", 3)
+    start = attrs.get("contextStart", -((ctx - 1) // 2))
+    B, S, F = x.shape
+    mask = _len_mask(x, lengths)
+    xz = jnp.where(mask[..., None], x, 0)
+    cols = []
+    pos = jnp.arange(S)
+    for k in range(ctx):
+        idx = pos + start + k
+        valid = (idx >= 0) & (idx < S)
+        g = xz[:, jnp.clip(idx, 0, S - 1), :]
+        cols.append(jnp.where(valid[None, :, None], g, 0))
+    stacked = jnp.concatenate(cols, axis=-1)  # [B, S, ctx*F]
+    out = jnp.einsum("bsf,fo->bso", stacked, w)
+    return {"Out": [jnp.where(mask[..., None], out, 0).astype(x.dtype)]}

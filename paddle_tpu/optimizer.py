@@ -809,13 +809,18 @@ class DGCMomentumOptimizer(MomentumOptimizer):
     dgc_op.cc; details/sparse_all_reduce_op_handle.h).
 
     The reference sparsifies gradients to top-k before NCCL allreduce to cut
-    communication. Under GSPMD the collective is compiler-inserted, so the
-    TPU translation keeps DGC's *semantics* — momentum correction + error
-    feedback (u/v accumulators) + magnitude selection with warmup sparsity
-    ramp — as one fused update op per parameter; the selection threshold is
-    a quantile (static shapes, no dynamic top-k). With sparsity ramping to
-    99.9%, each step applies only the largest accumulated updates, and the
-    residual carries over exactly as in the paper.
+    communication. Two TPU forms exist here:
+
+    * THIS optimizer (IR path): keeps DGC's update *semantics* — momentum
+      correction + error feedback (u/v accumulators) + magnitude selection
+      with warmup sparsity ramp — as one fused op per parameter. Under
+      single-program GSPMD the gradient allreduce is compiler-inserted and
+      dense, so this form regularizes like DGC but does NOT reduce traffic.
+    * parallel/dgc.py `dgc_allreduce`: the actual communication saving —
+      per-shard top-k selection + (index, value) all-gather under
+      shard_map, 2*k*n floats on the wire instead of the dense gradient.
+      Use it in shard_map/multi-process data-parallel training loops where
+      the exchange is under our control.
     """
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,

@@ -274,3 +274,44 @@ def test_ctr_ps_matches_local_embedding(rng):
     # dense (fc) params share init across builds (same seeds/order), sparse
     # tables are zero in both: trajectories must match closely
     np.testing.assert_allclose(ref_losses, ps_losses, rtol=2e-3, atol=2e-4)
+
+
+def test_geo_sgd_delta_sync(rng):
+    """GEO mode: dense params train locally, deltas merge via the server
+    every merge_steps (reference: python/paddle/fluid/transpiler/
+    geo_sgd_transpiler.py). Single worker: after each sync the server's
+    global copy equals the worker's params; training still converges."""
+    from paddle_tpu.fleet import parameter_server as psfleet
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+    from paddle_tpu.models import ctr
+
+    fleet = psfleet.fleet
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    main, startup, feeds, fetches = ctr.build_ctr_train(
+        num_slots=4, ids_per_slot=2, deep_dim=8, hidden=(16,), sparse_lr=0.2
+    )
+    strategy = psfleet.PSDistributedStrategy(mode="geo", merge_steps=3)
+    srv = fleet.init_server(port=0)
+    try:
+        fleet.init_worker(main)
+        fleet._strategy = strategy
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            worker = fleet.worker(exe, main)
+            assert worker._geo and worker._geo_params
+            losses = []
+            feed = ctr.synthetic_batch(rng, 64, num_slots=4, ids_per_slot=2)
+            for _ in range(10):  # deliberately NOT a multiple of merge_steps
+                out = worker.run(main, feed, fetch_list=[fetches[0]])
+                losses.append(float(out[0][0]))
+            worker.flush()  # ships the partial window tail (step 10)
+            # after flush the global dense copy matches the local params
+            merged = fleet._client.pull_dense(psfleet.PSWorker.GEO_DENSE_TABLE)
+            np.testing.assert_allclose(
+                merged, worker._concat_params(), rtol=1e-5, atol=1e-6
+            )
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+    finally:
+        fleet.stop_worker()
+        srv.stop()

@@ -1,0 +1,342 @@
+"""Sequence + detection op tests (padded+lengths representation).
+
+Modeled on the reference's test_sequence_pool.py / test_multiclass_nms_op.py
+/ test_yolo_box_op.py (reference: python/paddle/fluid/tests/unittests/).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+from op_test import OpTest
+
+
+# ---------------------------------------------------------------- sequence
+class TestSeqPool(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self, rng, ptype):
+        x = rng.randn(3, 5, 4).astype("float32")
+        lens = np.array([5, 2, 3], dtype="int64")
+        masked = [x[b, : lens[b]] for b in range(3)]
+        if ptype == "SUM":
+            exp = np.stack([m.sum(0) for m in masked])
+        elif ptype == "AVERAGE":
+            exp = np.stack([m.mean(0) for m in masked])
+        elif ptype == "SQRT":
+            exp = np.stack([m.sum(0) / np.sqrt(len(m)) for m in masked])
+        elif ptype == "MAX":
+            exp = np.stack([m.max(0) for m in masked])
+        elif ptype == "LAST":
+            exp = np.stack([m[-1] for m in masked])
+        else:
+            exp = np.stack([m[0] for m in masked])
+        self.inputs = {"X": [("x", x)], "Length": [("lens", lens)]}
+        self.outputs = {"Out": [("out", exp.astype("float32"))]}
+        self.attrs = {"pooltype": ptype}
+
+
+@pytest.mark.parametrize(
+    "ptype", ["SUM", "AVERAGE", "SQRT", "MAX", "LAST", "FIRST"]
+)
+def test_sequence_pool(rng, ptype):
+    t = TestSeqPool()
+    t.setup(rng, ptype)
+    t.check_output(atol=1e-5)
+
+
+def test_sequence_pool_grad(rng):
+    t = TestSeqPool()
+    t.setup(rng, "AVERAGE")
+    t.check_grad(["x"], "out", max_relative_error=0.01)
+
+
+def test_sequence_softmax(rng):
+    x = rng.randn(2, 4).astype("float32")
+    lens = np.array([4, 2], dtype="int64")
+    exp = np.zeros_like(x)
+    for b in range(2):
+        e = np.exp(x[b, : lens[b]] - x[b, : lens[b]].max())
+        exp[b, : lens[b]] = e / e.sum()
+
+    class T(OpTest):
+        op_type = "sequence_softmax"
+        inputs = {"X": [("x", x)], "Length": [("lens", lens)]}
+        outputs = {"Out": [("out", exp)]}
+
+    T().check_output(atol=1e-5)
+
+
+def test_sequence_reverse(rng):
+    x = np.arange(12).reshape(2, 6).astype("float32")
+    lens = np.array([4, 6], dtype="int64")
+    exp = x.copy()
+    exp[0, :4] = x[0, :4][::-1]
+    exp[1] = x[1][::-1]
+
+    class T(OpTest):
+        op_type = "sequence_reverse"
+        inputs = {"X": [("x", x)], "Length": [("lens", lens)]}
+        outputs = {"Y": [("y", exp)]}
+
+    T().check_output()
+
+
+def test_sequence_mask():
+    lens = np.array([1, 3, 0], dtype="int64")
+    exp = np.array(
+        [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]], dtype="int64"
+    )
+
+    class T(OpTest):
+        op_type = "sequence_mask"
+        inputs = {"X": [("x", lens)]}
+        outputs = {"Y": [("y", exp)]}
+        attrs = {"maxlen": 4, "out_dtype": "int64"}
+
+    T().check_output()
+
+
+def test_sequence_expand_as(rng):
+    x = rng.randn(2, 3).astype("float32")
+    y = np.zeros((2, 4, 3), dtype="float32")
+    lens = np.array([2, 4], dtype="int64")
+    exp = np.zeros((2, 4, 3), dtype="float32")
+    exp[0, :2] = x[0]
+    exp[1, :4] = x[1]
+
+    class T(OpTest):
+        op_type = "sequence_expand_as"
+        inputs = {"X": [("x", x)], "Y": [("y", y)], "Length": [("lens", lens)]}
+        outputs = {"Out": [("out", exp)]}
+
+    T().check_output()
+
+
+def test_sequence_concat(rng):
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(2, 2).astype("float32")
+    la = np.array([2, 3], dtype="int64")
+    lb = np.array([1, 2], dtype="int64")
+    exp = np.zeros((2, 5), dtype="float32")
+    exp[0, :2] = a[0, :2]
+    exp[0, 2:3] = b[0, :1]
+    exp[1, :3] = a[1, :3]
+    exp[1, 3:5] = b[1, :2]
+
+    class T(OpTest):
+        op_type = "sequence_concat"
+        inputs = {
+            "X": [("a", a), ("b", b)],
+            "Length": [("la", la), ("lb", lb)],
+        }
+        outputs = {
+            "Out": [("out", exp)],
+            "OutLength": [("outlen", np.array([3, 5], dtype="int64"))],
+        }
+
+    T().check_output()
+
+
+def test_sequence_erase():
+    x = np.array([[1, 2, 3, 2, 5], [2, 2, 7, 0, 0]], dtype="int64")
+    lens = np.array([5, 3], dtype="int64")
+    exp = np.array([[1, 3, 5, 0, 0], [7, 0, 0, 0, 0]], dtype="int64")
+
+    class T(OpTest):
+        op_type = "sequence_erase"
+        inputs = {"X": [("x", x)], "Length": [("lens", lens)]}
+        outputs = {
+            "Out": [("out", exp)],
+            "OutLength": [("outlen", np.array([3, 1], dtype="int64"))],
+        }
+        attrs = {"tokens": [2]}
+
+    T().check_output()
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4]], dtype="int64")
+    exp = np.array([[[1, 2], [2, 3], [3, 4], [4, 0]]], dtype="int64")
+
+    class T(OpTest):
+        op_type = "sequence_enumerate"
+        inputs = {"X": [("x", x)]}
+        outputs = {"Out": [("out", exp)]}
+        attrs = {"win_size": 2, "pad_value": 0}
+
+    T().check_output()
+
+
+def test_sequence_conv_layer(rng):
+    B, S, F = 2, 6, 3
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, S, F])
+        lens = fluid.data("lens", shape=[-1], dtype="int64")
+        y = fluid.layers.sequence_conv(x, num_filters=5, filter_size=3,
+                                       length=lens, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(
+        main,
+        feed={"x": rng.randn(B, S, F).astype("float32"),
+              "lens": np.array([6, 3], dtype="int64")},
+        fetch_list=[y],
+    )[0]
+    assert out.shape == (B, S, 5)
+    assert np.allclose(out[1, 3:], 0)  # masked tail stays zero
+
+
+# ---------------------------------------------------------------- detection
+def _iou_np(a, b):
+    xx1 = max(a[0], b[0]); yy1 = max(a[1], b[1])
+    xx2 = min(a[2], b[2]); yy2 = min(a[3], b[3])
+    inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity(rng):
+    x = np.abs(rng.rand(4, 4)).astype("float32")
+    x[:, 2:] = x[:, :2] + np.abs(rng.rand(4, 2)) + 0.1
+    y = np.abs(rng.rand(3, 4)).astype("float32")
+    y[:, 2:] = y[:, :2] + np.abs(rng.rand(3, 2)) + 0.1
+    exp = np.zeros((4, 3), dtype="float32")
+    for i in range(4):
+        for j in range(3):
+            exp[i, j] = _iou_np(x[i], y[j])
+
+    class T(OpTest):
+        op_type = "iou_similarity"
+        inputs = {"X": [("x", x)], "Y": [("y", y)]}
+        outputs = {"Out": [("out", exp)]}
+
+    T().check_output(atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps(rng):
+    # two heavily-overlapping boxes + one distinct: expect 2 detections
+    boxes = np.array(
+        [[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [20, 20, 30, 30]]],
+        dtype="float32",
+    )
+    scores = np.array([[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]], dtype="float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        b = fluid.data("b", shape=[1, 3, 4])
+        s = fluid.data("s", shape=[1, 2, 3])
+        out, num = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.1, nms_threshold=0.5, keep_top_k=5
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, n = exe.run(main, feed={"b": boxes, "s": scores},
+                   fetch_list=[out, num])
+    assert int(n[0]) == 2
+    kept = o[0][o[0][:, 0] >= 0]
+    assert kept.shape[0] == 2
+    # the highest-scoring overlapping box (score .9) and the distinct one
+    assert np.isclose(sorted(kept[:, 1])[-1], 0.9)
+    assert {tuple(r[2:4]) for r in kept} == {(0.0, 0.0), (20.0, 20.0)}
+
+
+def test_yolo_box_shapes(rng):
+    B, A, C, H, W = 2, 3, 4, 5, 5
+    x = rng.randn(B, A * (5 + C), H, W).astype("float32")
+    img = np.array([[320, 320], [160, 320]], dtype="int64")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", shape=[B, A * (5 + C), H, W])
+        iv = fluid.data("img", shape=[B, 2], dtype="int64")
+        boxes, scores = fluid.layers.yolo_box(
+            xv, iv, anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+            conf_thresh=0.0, downsample_ratio=32,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    b, s = exe.run(main, feed={"x": x, "img": img}, fetch_list=[boxes, scores])
+    assert b.shape == (B, A * H * W, 4)
+    assert s.shape == (B, A * H * W, C)
+    assert (b[0][:, 0] >= 0).all() and (b[0][:, 2] < 320).all()
+
+
+def test_prior_box_layer(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feat = fluid.data("feat", shape=[1, 8, 4, 4])
+        img = fluid.data("img", shape=[1, 3, 32, 32])
+        boxes, variances = fluid.layers.prior_box(
+            feat, img, min_sizes=[8.0], aspect_ratios=[1.0, 2.0], flip=True,
+            clip=True,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    b, v = exe.run(
+        main,
+        feed={"feat": rng.randn(1, 8, 4, 4).astype("float32"),
+              "img": rng.randn(1, 3, 32, 32).astype("float32")},
+        fetch_list=[boxes, variances],
+    )
+    assert b.shape == (4, 4, 3, 4)  # 1 min_size * (1 + 2 flipped ars)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert v.shape == b.shape
+
+
+def test_box_coder_roundtrip(rng):
+    """decode(encode(x)) == x for center-size coding."""
+    prior = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], dtype="float32")
+    target = np.array([[1, 1, 8, 8], [6, 7, 18, 22]], dtype="float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        p = fluid.data("p", shape=[2, 4])
+        t = fluid.data("t", shape=[2, 4])
+        enc = fluid.layers.box_coder(p, [1.0, 1.0, 1.0, 1.0], t,
+                                     code_type="encode_center_size")
+        dec = fluid.layers.box_coder(p, [1.0, 1.0, 1.0, 1.0], enc,
+                                     code_type="decode_center_size")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = exe.run(main, feed={"p": prior, "t": target}, fetch_list=[dec])[0]
+    # decode output is [N, M, 4]; the diagonal should reproduce targets
+    np.testing.assert_allclose(
+        np.stack([d[0, 0], d[1, 1]]), target, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_bipartite_match():
+    dist = np.array(
+        [[0.9, 0.1, 0.3], [0.2, 0.8, 0.4]], dtype="float32"
+    )
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        d = fluid.data("d", shape=[2, 3])
+        ids, md = fluid.layers.bipartite_match(d)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    i, m = exe.run(main, feed={"d": dist}, fetch_list=[ids, md])
+    assert i[0][0] == 0 and i[0][1] == 1 and i[0][2] == -1
+    np.testing.assert_allclose(m[0][:2], [0.9, 0.8], rtol=1e-5)
+
+
+def test_anchor_generator_layer(rng):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        feat = fluid.data("feat", shape=[1, 8, 3, 3])
+        anchors, variances = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0],
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    a, v = exe.run(
+        main, feed={"feat": rng.randn(1, 8, 3, 3).astype("float32")},
+        fetch_list=[anchors, variances],
+    )
+    assert a.shape == (3, 3, 1, 4)
+    # center cell anchor: center at (1.5*16)=24, square of size 32
+    np.testing.assert_allclose(a[1, 1, 0], [8, 8, 40, 40], atol=1e-4)
