@@ -257,11 +257,16 @@ class CompiledProgram:
             _to_global(scope.find_var(n), scope_shardings[n]) for n in readonly
         )
         rng_key = exe._next_rng_key(self._program)
+        from paddle_tpu.parallel.env import mesh_context
+
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
-            fetches, updates = compiled(
-                feed_vals, donated_vals, readonly_vals, rng_key
-            )
+            # mesh context: nested-shard_map ops (pipeline_stack) find the
+            # mesh during tracing, which happens inside this first call
+            with mesh_context(mesh):
+                fetches, updates = compiled(
+                    feed_vals, donated_vals, readonly_vals, rng_key
+                )
         for name, val in zip(written, updates):
             if val is not None:
                 scope.set(name, val)

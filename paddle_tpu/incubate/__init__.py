@@ -1,0 +1,1 @@
+from paddle_tpu.incubate import checkpoint  # noqa: F401

@@ -1,0 +1,212 @@
+"""Automatic checkpoint + resume: the recovery story (SURVEY §5.3).
+
+The reference's recovery is checkpoint-based, not elastic: pservers
+snapshot on checkpoint_notify (reference: paddle/fluid/operators/
+distributed_ops/checkpoint_notify_op.cc) and jobs restart from the last
+save (reference: python/paddle/fluid/io.py:405 _save_distributed
+_persistables). TPU-native version: JAX multi-host failure = job restart,
+so the unit of recovery is (persistable state + step counter) written
+ASYNCHRONOUSLY (device->host snapshot on the training thread, file IO on a
+background thread — the chip never waits for the disk) with an atomic
+`latest` pointer, plus `resume()` on restart.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu import io as pio
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = ["AutoCheckpoint", "HeartBeatMonitor"]
+
+
+class AutoCheckpoint:
+    """Periodic async checkpoints with auto-resume.
+
+        ckpt = AutoCheckpoint(exe, program, dirname, save_interval_steps=100)
+        start_step = ckpt.resume()          # 0 on a fresh run
+        for step in range(start_step, n):
+            exe.run(...)
+            ckpt.maybe_save(step)
+        ckpt.close()
+    """
+
+    def __init__(self, exe, program, dirname, save_interval_steps=100,
+                 max_to_keep=3, scope=None):
+        self._exe = exe
+        self._program = program
+        self._dir = dirname
+        self._interval = int(save_interval_steps)
+        self._keep = int(max_to_keep)
+        self._scope = scope
+        self._thread = None
+        self._lock = threading.Lock()
+        os.makedirs(dirname, exist_ok=True)
+
+    # -- save ----------------------------------------------------------
+    def _persistable_names(self):
+        return [
+            v.name
+            for v in self._program.global_block().vars.values()
+            if v.persistable
+        ]
+
+    def maybe_save(self, step, blocking=False):
+        if (step + 1) % self._interval:
+            return False
+        self.save(step, blocking=blocking)
+        return True
+
+    def save(self, step, blocking=False):
+        """Snapshot device state NOW (cheap: device->host copies), write
+        files on a background thread (the reference's checkpoint_notify is
+        likewise fire-and-forget from the trainer's view)."""
+        scope = self._scope or global_scope()
+        snap = {}
+        for n in self._persistable_names():
+            v = scope.find_var(n)
+            if v is not None:
+                snap[n] = np.asarray(v)
+        # one async writer at a time; a newer save supersedes a pending one
+        self._join()
+
+        def write():
+            d = os.path.join(self._dir, f"ckpt_{step}")
+            tmp = d + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, "state.npz"),
+                     **{k: v for k, v in snap.items()})
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, "time": time.time()}, f)
+            shutil.rmtree(d, ignore_errors=True)
+            os.replace(tmp, d)
+            # atomic latest pointer
+            ptr = os.path.join(self._dir, "latest.tmp")
+            with open(ptr, "w") as f:
+                f.write(f"ckpt_{step}")
+            os.replace(ptr, os.path.join(self._dir, "latest"))
+            self._gc()
+
+        if blocking:
+            write()
+        else:
+            self._thread = threading.Thread(target=write, daemon=True)
+            self._thread.start()
+
+    def _gc(self):
+        entries = os.listdir(self._dir)
+        # clear debris from a save killed mid-write
+        for d in entries:
+            if d.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self._dir, d), ignore_errors=True)
+        kept = sorted(
+            (d for d in entries
+             if d.startswith("ckpt_") and d.split("_", 1)[1].isdigit()),
+            key=lambda d: int(d.split("_", 1)[1]),
+        )
+        for d in kept[: -self._keep]:
+            shutil.rmtree(os.path.join(self._dir, d), ignore_errors=True)
+
+    def _join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- resume ----------------------------------------------------------
+    def resume(self):
+        """Restore the newest complete checkpoint into the scope; returns
+        the step AFTER the checkpointed one (0 on a fresh start)."""
+        ptr = os.path.join(self._dir, "latest")
+        if not os.path.exists(ptr):
+            return 0
+        with open(ptr) as f:
+            name = f.read().strip()
+        d = os.path.join(self._dir, name)
+        state_p = os.path.join(d, "state.npz")
+        meta_p = os.path.join(d, "meta.json")
+        if not (os.path.exists(state_p) and os.path.exists(meta_p)):
+            return 0
+        with open(meta_p) as f:
+            meta = json.load(f)
+        scope = self._scope or global_scope()
+        with np.load(state_p) as z:
+            for n in z.files:
+                scope.set(n, z[n])
+        return int(meta["step"]) + 1
+
+    def close(self):
+        self._join()
+
+
+class HeartBeatMonitor:
+    """Chief-side worker-lost detection over the PS heartbeat table
+    (reference: paddle/fluid/operators/distributed/heart_beat_monitor.h:54 —
+    UNINITED/RUNNING/COMPLETED per worker, lost workers logged).
+
+        mon = HeartBeatMonitor(client, worker_id=0, worker_num=2,
+                               timeout=5.0, on_lost=callback)
+        mon.start();  ...  mon.stop()
+    """
+
+    def __init__(self, client, worker_id, worker_num, timeout=30.0,
+                 period=1.0, on_lost=None):
+        self._client = client
+        self._id = int(worker_id)
+        self._n = int(worker_num)
+        self._timeout = float(timeout)
+        self._period = float(period)
+        self._on_lost = on_lost
+        self._stop = threading.Event()
+        self._thread = None
+        self._seen = set()
+        self.lost = set()
+
+    def _loop(self):
+        import logging
+
+        log = logging.getLogger("paddle_tpu.heartbeat")
+        start = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                ages = self._client.heartbeat(self._id)
+            except Exception as e:  # server gone: report and stop
+                log.warning("heartbeat RPC failed: %s", e)
+                break
+            self._seen.update(ages)
+            # a worker that NEVER heartbeats (died during startup) has no
+            # server entry — treat absence past the grace window as lost
+            # (the reference's UNINITED state, heart_beat_monitor.h:38)
+            elapsed = time.monotonic() - start
+            for wid in range(self._n):
+                if wid == self._id or wid in ages or wid in self._seen:
+                    continue
+                if elapsed > self._timeout:
+                    ages = dict(ages)
+                    ages[wid] = elapsed
+            for wid, age in ages.items():
+                if age > self._timeout and wid not in self.lost:
+                    self.lost.add(wid)
+                    log.warning(
+                        "worker %d LOST: no heartbeat for %.1fs "
+                        "(timeout %.1fs)", wid, age, self._timeout,
+                    )
+                    if self._on_lost is not None:
+                        self._on_lost(wid, age)
+            self._stop.wait(self._period)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

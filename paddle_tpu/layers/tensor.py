@@ -1,7 +1,13 @@
 """Tensor creation/manipulation layer functions
 (reference: python/paddle/fluid/layers/tensor.py)."""
 
+import builtins as _builtins
+
 from paddle_tpu.core.dtypes import convert_dtype
+
+# this module defines a `range` LAYER below, which shadows the builtin for
+# any module-level function that runs after import — keep the real one
+_builtin_range = _builtins.range
 from paddle_tpu.core.ir import default_main_program
 from paddle_tpu.layer_helper import LayerHelper
 
@@ -191,7 +197,8 @@ def split(input, num_or_sections, dim=-1, name=None):
         sections = list(num_or_sections)
         n_out = len(sections)
     outs = [
-        helper.create_variable_for_type_inference(input.dtype) for _ in range(n_out)
+        helper.create_variable_for_type_inference(input.dtype)
+        for _ in _builtin_range(n_out)
     ]
     helper.append_op(
         "split",
@@ -254,7 +261,7 @@ def unstack(x, axis=0, num=None, name=None):
     helper = LayerHelper("unstack", name=name)
     if num is None:
         num = x.shape[axis]
-    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(num)]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in _builtin_range(num)]
     helper.append_op(
         "unstack",
         {"X": [x.name]},

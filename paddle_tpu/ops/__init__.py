@@ -16,3 +16,4 @@ from paddle_tpu.ops import recompute  # noqa: F401
 from paddle_tpu.ops import rnn  # noqa: F401
 from paddle_tpu.ops import sequence  # noqa: F401
 from paddle_tpu.ops import detection  # noqa: F401
+from paddle_tpu.ops import pipeline  # noqa: F401

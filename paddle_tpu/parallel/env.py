@@ -17,6 +17,27 @@ import jax
 from jax.sharding import Mesh
 
 _bindings = {}
+_current_mesh = None
+
+
+@contextlib.contextmanager
+def mesh_context(mesh):
+    """Install the mesh a Program is being compiled against, so op
+    lowerings that build nested shard_map regions (ops/pipeline.py) can
+    find it. The analog of the reference's global DeviceContextPool —
+    device topology as ambient state (reference: paddle/fluid/platform/
+    device_context.h:331)."""
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield
+    finally:
+        _current_mesh = old
+
+
+def current_mesh():
+    return _current_mesh
 
 
 @contextlib.contextmanager

@@ -49,6 +49,20 @@ def main():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     worker = fleet.worker(exe, main_prog)
+    if os.environ.get("DIST_HEARTBEAT"):
+        import threading
+
+        def _beat():
+            while True:
+                try:
+                    fleet._client.heartbeat(fleet.worker_index())
+                except Exception:
+                    return
+                import time as _t
+
+                _t.sleep(0.5)
+
+        threading.Thread(target=_beat, daemon=True).start()
     rng = np.random.RandomState(123 + fleet.worker_index())
     # fixed batch per worker: convergence = memorization, the same
     # signal the reference's dist tests assert on short runs
