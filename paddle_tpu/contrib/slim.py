@@ -1,0 +1,272 @@
+"""Model-slimming toolkit: pruning + distillation (+ QAT via .quantize).
+
+reference: python/paddle/fluid/contrib/slim/ — prune/pruner.py
+(Pruner/StructurePruner with ratio/magnitude criteria), distillation/
+distiller.py (L2Distiller, SoftLabelDistiller, FSPDistiller building a
+merged teacher+student graph). TPU-native redesign: pruning is expressed as
+masked parameters (a persistable 0/1 mask multiplied into the weight inside
+the compiled step — sparsity XLA can fold), not host-side tensor surgery;
+distillation merges the teacher program into the student's with frozen
+teacher vars and emits the combined loss in ONE compiled step.
+"""
+
+import re
+
+import numpy as np
+
+from paddle_tpu.core.scope import global_scope
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils.enforce import enforce
+
+__all__ = [
+    "MagnitudePruner",
+    "StructuredPruner",
+    "sensitivity",
+    "merge_teacher_program",
+    "l2_distill_loss",
+    "soft_label_distill_loss",
+]
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+
+
+class MagnitudePruner:
+    """Unstructured magnitude pruning via weight masks
+    (reference: slim/prune/pruner.py Pruner.prune — ratio criterion).
+
+    apply() rewrites the program so every matched parameter W is replaced
+    by W * W@MASK at use sites (mask persistable, 0/1); update_masks()
+    recomputes masks from current magnitudes at the requested sparsity.
+    Masked weights keep training (the optimizer sees the dense gradient),
+    so iterative magnitude pruning schedules work.
+    """
+
+    def __init__(self, params=None, pattern=".*\\.w.*|.*w_.*"):
+        self._explicit = list(params) if params else None
+        self._pattern = re.compile(pattern)
+        self._masked = []  # (param name, mask name)
+
+    def _match(self, program):
+        if self._explicit is not None:
+            return [
+                p for p in program.all_parameters()
+                if p.name in self._explicit
+            ]
+        return [
+            p for p in program.all_parameters()
+            if self._pattern.fullmatch(p.name) and len(p.shape or []) >= 2
+        ]
+
+    def apply(self, program, startup_program):
+        """Insert `masked = W * mask` ops ahead of every consumer of W."""
+        block = program.global_block()
+        sblock = startup_program.global_block()
+        for p in self._match(program):
+            mask_name = p.name + "@MASK"
+            if any(m == mask_name for _, m in self._masked):
+                continue
+            block.create_var(
+                name=mask_name, shape=list(p.shape), dtype=p.dtype,
+                persistable=True, stop_gradient=True,
+            )
+            sv = sblock.create_var(
+                name=mask_name, shape=list(p.shape), dtype=p.dtype,
+                persistable=True,
+            )
+            sblock.append_op(
+                "fill_constant",
+                {},
+                {"Out": [mask_name]},
+                {"shape": list(p.shape), "dtype": p.dtype, "value": 1.0},
+            )
+            masked_name = p.name + "@PRUNED"
+            block.create_var(
+                name=masked_name, shape=list(p.shape), dtype=p.dtype,
+            )
+            # insert the mask-multiply right before the first consumer
+            first_use = None
+            for i, op in enumerate(block.ops):
+                if p.name in op.input_names():
+                    first_use = i
+                    break
+            insert_at = first_use if first_use is not None else len(block.ops)
+            block._insert_op(
+                insert_at,
+                "elementwise_mul",
+                {"X": [p.name], "Y": [mask_name]},
+                {"Out": [masked_name]},
+                {"axis": -1},
+            )
+            for op in block.ops:
+                if op.type == "elementwise_mul" and mask_name in op.input_names():
+                    continue
+                # never rewrite the optimizer region: its Param slot must
+                # read/write the RAW weight (W := W - lr*g), or pruned
+                # entries get re-zeroed every step and can never regrow
+                if op.attrs.get("op_role", 0) == 2:
+                    continue
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [
+                        masked_name if n == p.name else n for n in names
+                    ]
+            self._masked.append((p.name, mask_name))
+        program._bump_version()
+        return self
+
+    def update_masks(self, ratio, scope=None):
+        """Recompute every mask to zero the smallest-|w| `ratio` fraction."""
+        scope = scope or global_scope()
+        for pname, mname in self._masked:
+            w = np.asarray(scope.find_var(pname))
+            k = int(round(w.size * ratio))
+            mask = np.ones(w.size, dtype=w.dtype)
+            if k > 0:
+                # argsort (not a threshold compare): ties at the cut
+                # magnitude must not prune MORE than k entries
+                idx = np.argsort(np.abs(w).reshape(-1), kind="stable")[:k]
+                mask[idx] = 0
+            scope.set(mname, mask.reshape(w.shape))
+        return self
+
+    def sparsity(self, scope=None):
+        scope = scope or global_scope()
+        zeros = total = 0
+        for _, mname in self._masked:
+            m = np.asarray(scope.find_var(mname))
+            zeros += int((m == 0).sum())
+            total += m.size
+        return zeros / max(total, 1)
+
+
+class StructuredPruner(MagnitudePruner):
+    """Whole-row/column pruning by L1 norm along `axis`
+    (reference: slim/prune/pruner.py StructurePruner l1_norm criterion,
+    pruning_axis). Masks entire output channels so the zeroed structure is
+    removable at export time."""
+
+    def __init__(self, params=None, pattern=".*\\.w.*|.*w_.*", axis=1):
+        super().__init__(params, pattern)
+        self._axis = axis
+
+    def update_masks(self, ratio, scope=None):
+        scope = scope or global_scope()
+        for pname, mname in self._masked:
+            w = np.asarray(scope.find_var(pname))
+            ax = self._axis % w.ndim
+            reduce_axes = tuple(i for i in range(w.ndim) if i != ax)
+            norms = np.abs(w).sum(axis=reduce_axes)
+            k = int(round(norms.size * ratio))
+            mask = np.ones_like(w)
+            if k > 0:
+                idx = np.argsort(norms)[:k]
+                sl = [slice(None)] * w.ndim
+                sl[ax] = idx
+                mask[tuple(sl)] = 0
+            scope.set(mname, mask.astype(w.dtype))
+        return self
+
+
+def sensitivity(program, exe, feed, fetch_loss, pruner, ratios, scope=None):
+    """Per-ratio loss degradation map (reference: slim/prune/
+    auto_prune_strategy.py's sensitivity analysis, simplified): returns
+    {ratio: loss} with masks restored afterwards."""
+    scope = scope or global_scope()
+    saved = {
+        m: np.asarray(scope.find_var(m)) for _, m in pruner._masked
+    }
+    out = {}
+    for r in ratios:
+        pruner.update_masks(r, scope)
+        loss = exe.run(program, feed=feed, fetch_list=[fetch_loss])[0]
+        out[r] = float(np.asarray(loss).reshape(-1)[0])
+    for m, v in saved.items():
+        scope.set(m, v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# distillation
+# ---------------------------------------------------------------------------
+
+
+def merge_teacher_program(student_program, teacher_program, prefix="teacher/"):
+    """Copy the teacher's global block into the student program with all
+    vars renamed `prefix+name` and marked stop_gradient (frozen teacher —
+    reference: slim/distillation/distillation_strategy.py
+    _create_distillation_graph merges teacher into the student graph).
+    Teacher FEED vars keep the student's name when shapes match, so one
+    feed drives both nets. Returns {teacher var name -> merged name}."""
+    sblock = student_program.global_block()
+    tblock = teacher_program.global_block()
+    mapping = {}
+    student_feeds = {
+        v.name: v for v in sblock.vars.values() if getattr(v, "is_data", False)
+    }
+    for name, v in tblock.vars.items():
+        if getattr(v, "is_data", False) and name in student_feeds:
+            mapping[name] = name  # shared feed
+            continue
+        new = prefix + name
+        mapping[name] = new
+        if new not in sblock.vars:
+            nv = sblock.create_var(
+                name=new, shape=v.shape, dtype=v.dtype,
+                persistable=v.persistable, stop_gradient=True,
+            )
+    for op in tblock.ops:
+        sblock.append_op(
+            op.type,
+            {s: [mapping[n] for n in ns] for s, ns in op.inputs.items()},
+            {s: [mapping[n] for n in ns] for s, ns in op.outputs.items()},
+            dict(op.attrs),
+        )
+    student_program._bump_version()
+    return mapping
+
+
+def load_teacher_vars(exe, dirname, teacher_program, mapping, scope=None,
+                      prefix="teacher/"):
+    """Load saved teacher persistables into their prefixed names."""
+    from paddle_tpu import io as pio
+
+    state = pio.load_program_state(dirname)
+    scope = scope or global_scope()
+    for name, arr in state.items():
+        scope.set(mapping.get(name, prefix + name), arr)
+
+
+def l2_distill_loss(student_var, teacher_var, weight=1.0, name=None):
+    """reference: slim/distillation/distiller.py L2Distiller."""
+    import paddle_tpu as fluid
+
+    diff = fluid.layers.elementwise_sub(student_var, teacher_var)
+    return fluid.layers.scale(
+        fluid.layers.mean(fluid.layers.square(diff)), scale=float(weight)
+    )
+
+
+def soft_label_distill_loss(student_logits, teacher_logits,
+                            student_temperature=1.0,
+                            teacher_temperature=1.0, weight=1.0):
+    """reference: slim/distillation/distiller.py SoftLabelDistiller —
+    cross entropy of softened teacher probabilities against softened
+    student log-probs."""
+    import paddle_tpu as fluid
+
+    s = fluid.layers.softmax(
+        fluid.layers.scale(student_logits, scale=1.0 / student_temperature)
+    )
+    t = fluid.layers.softmax(
+        fluid.layers.scale(teacher_logits, scale=1.0 / teacher_temperature)
+    )
+    t.stop_gradient = True
+    ce = fluid.layers.reduce_sum(
+        fluid.layers.elementwise_mul(
+            t, fluid.layers.scale(fluid.layers.log(s), scale=-1.0)
+        ),
+        dim=[-1],
+    )
+    return fluid.layers.scale(fluid.layers.mean(ce), scale=float(weight))

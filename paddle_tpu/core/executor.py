@@ -436,6 +436,13 @@ class Executor:
     def _next_rng_key(self, program):
         seed = program.random_seed or 0
         self._rng_counter += 1
+        if flags.rng_impl != "threefry":
+            # rbg: hardware-RNG-backed bits on TPU - dropout-heavy steps
+            # stop paying threefry's ALU cost. Streams differ from threefry
+            # but the distribution is identical.
+            return jax.random.fold_in(
+                jax.random.key(seed, impl=flags.rng_impl), self._rng_counter
+            )
         return jax.random.fold_in(jax.random.PRNGKey(seed), self._rng_counter)
 
     # ------------------------------------------------------------------

@@ -12,6 +12,7 @@ back as padded [B, maxlen] arrays plus a `<name>.lens` int64 vector
 """
 
 import ctypes
+import os
 
 import numpy as np
 
@@ -19,6 +20,18 @@ from paddle_tpu.utils.enforce import enforce
 from paddle_tpu.utils.native import NativeBuildError, load_native
 
 __all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+def _fleet_rank_world(fleet):
+    if fleet is not None:
+        try:
+            return fleet.worker_index(), fleet.worker_num()
+        except Exception:
+            pass
+    return (
+        int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+    )
 
 
 class DatasetFactory:
@@ -328,14 +341,81 @@ class InMemoryDataset(DatasetBase):
         enforce(self._feed is not None, "load_into_memory first")
         self._feed.shuffle(seed)
 
-    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
-        """Single-host: equivalent to local_shuffle. Multi-host SPMD jobs
-        shard the *file list* per worker up front (each JAX process reads a
-        disjoint shard), so a cross-host record exchange — the reference's
-        PS-RPC global shuffle (reference: paddle/fluid/framework/
-        data_set.cc GlobalShuffle) — is unnecessary; per-shard shuffle plus
-        per-epoch file-list reshuffle gives the same mixing."""
-        self.local_shuffle(seed)
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0,
+                       exchange_dir=None, timeout=300):
+        """Cross-worker record exchange + local shuffle.
+
+        The reference moves records between workers over PS RPC
+        (reference: paddle/fluid/framework/data_set.cc GlobalShuffle); the
+        TPU build's exchange plane is the shared filesystem the fleet
+        already requires for checkpoints (the reference's own Gloo
+        rendezvous ran over HDFS paths): each worker hash-partitions its
+        raw records into per-destination files under `exchange_dir`,
+        barriers on done-markers, re-reads the partitions addressed to it,
+        then local-shuffles. Single worker (or no exchange_dir in a
+        single-process job) degrades to local_shuffle.
+        """
+        rank, world = _fleet_rank_world(fleet)
+        if world <= 1:
+            self.local_shuffle(seed)
+            return
+        enforce(
+            exchange_dir is not None,
+            "global_shuffle across workers needs exchange_dir= on a "
+            "shared filesystem",
+        )
+        import glob as _glob
+        import hashlib
+        import time as _time
+
+        # per-call epoch namespace: reusing one exchange_dir across epochs
+        # must not see the previous epoch's done-markers (instant barrier
+        # pass over half-written files) or clobber part files that ARE the
+        # current filelist
+        self._shuffle_epoch = getattr(self, "_shuffle_epoch", -1) + 1
+        exchange_dir = os.path.join(
+            exchange_dir, f"epoch_{self._shuffle_epoch}"
+        )
+        os.makedirs(exchange_dir, exist_ok=True)
+        outs = [
+            open(os.path.join(exchange_dir, f"part_src{rank}_dst{d}.txt"),
+                 "w")
+            for d in range(world)
+        ]
+        n_records = 0
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    h = hashlib.md5(
+                        (str(seed) + line).encode()
+                    ).digest()
+                    outs[int.from_bytes(h[:4], "little") % world].write(line)
+                    n_records += 1
+        for o in outs:
+            o.close()
+        with open(os.path.join(exchange_dir, f"done_{rank}"), "w") as f:
+            f.write(str(n_records))
+        deadline = _time.monotonic() + timeout
+        while True:
+            done = _glob.glob(os.path.join(exchange_dir, "done_*"))
+            if len(done) >= world:
+                break
+            enforce(
+                _time.monotonic() < deadline,
+                f"global_shuffle barrier timed out: {len(done)}/{world} "
+                "workers finished partitioning",
+            )
+            _time.sleep(0.1)
+        mine = sorted(
+            _glob.glob(os.path.join(exchange_dir, f"part_src*_dst{rank}.txt"))
+        )
+        self.set_filelist(mine)
+        self._feed = None
+        self._loaded = False
+        self._load()
+        self.local_shuffle(seed + rank)
 
     def release_memory(self):
         self._feed = None

@@ -1,1 +1,4 @@
 from paddle_tpu.incubate import checkpoint  # noqa: F401
+from paddle_tpu.incubate import data_generator  # noqa: F401
+from paddle_tpu.incubate import fleet_utils  # noqa: F401
+from paddle_tpu.incubate.fleet_utils import FleetUtil  # noqa: F401

@@ -47,6 +47,7 @@ class AutoCheckpoint:
         self._scope = scope
         self._thread = None
         self._lock = threading.Lock()
+        self._last_error = None
         os.makedirs(dirname, exist_ok=True)
 
     # -- save ----------------------------------------------------------
@@ -75,6 +76,11 @@ class AutoCheckpoint:
                 snap[n] = np.asarray(v)
         # one async writer at a time; a newer save supersedes a pending one
         self._join()
+        if self._last_error is not None:
+            err, self._last_error = self._last_error, None
+            raise RuntimeError(
+                f"previous async checkpoint write failed: {err}"
+            )
 
         def write():
             d = os.path.join(self._dir, f"ckpt_{step}")
@@ -94,10 +100,21 @@ class AutoCheckpoint:
             os.replace(ptr, os.path.join(self._dir, "latest"))
             self._gc()
 
+        def guarded():
+            try:
+                write()
+            except Exception as e:  # surfaced on the NEXT save/close
+                import logging
+
+                logging.getLogger("paddle_tpu.checkpoint").error(
+                    "async checkpoint write failed: %s", e
+                )
+                self._last_error = e
+
         if blocking:
             write()
         else:
-            self._thread = threading.Thread(target=write, daemon=True)
+            self._thread = threading.Thread(target=guarded, daemon=True)
             self._thread.start()
 
     def _gc(self):
@@ -143,6 +160,9 @@ class AutoCheckpoint:
 
     def close(self):
         self._join()
+        if self._last_error is not None:
+            err, self._last_error = self._last_error, None
+            raise RuntimeError(f"async checkpoint write failed: {err}")
 
 
 class HeartBeatMonitor:

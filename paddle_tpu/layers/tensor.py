@@ -31,6 +31,7 @@ __all__ = [
     "slice",
     "expand",
     "gather",
+    "batched_gather",
     "gather_nd",
     "scatter",
     "where",
@@ -291,6 +292,19 @@ def expand(x, expand_times, name=None):
         {"X": [x.name]},
         {"Out": [out.name]},
         {"expand_times": list(expand_times)},
+    )
+    return out
+
+
+def batched_gather(x, index, name=None):
+    """X [B, S, ...] + Index [B, P] -> [B, P, ...] (rows per batch)."""
+    helper = LayerHelper("batched_gather", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "batched_gather",
+        {"X": [x.name], "Index": [index.name]},
+        {"Out": [out.name]},
+        {},
     )
     return out
 

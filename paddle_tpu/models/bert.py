@@ -94,14 +94,17 @@ def multi_head_attention(x, attn_bias, cfg, name):
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     if getattr(cfg, "use_flash_attention", False):
         if getattr(cfg, "attention_probs_dropout_prob", 0.0):
-            import warnings
+            # enforcement, not silent degradation: the fused kernel does not
+            # apply attention-prob dropout, so refusing beats training a
+            # different model than configured
+            from paddle_tpu.utils.enforce import EnforceError
 
-            warnings.warn(
-                "use_flash_attention=True skips attention-prob dropout "
-                f"(attention_probs_dropout_prob="
-                f"{cfg.attention_probs_dropout_prob} is ignored); set it to "
-                "0 or disable the flash path for identical regularization",
-                stacklevel=2,
+            raise EnforceError(
+                "use_flash_attention=True cannot honor "
+                f"attention_probs_dropout_prob="
+                f"{cfg.attention_probs_dropout_prob}: the fused kernel "
+                "applies no attention-prob dropout. Set it to 0 (the "
+                "common large-model recipe) or disable the flash path."
             )
         # attn_bias here is [B,1,1,S]; the fused op takes [B,S]
         flat_bias = fluid.layers.reshape(attn_bias, [0, attn_bias.shape[-1]])
@@ -207,30 +210,53 @@ def _const_i64(arr, name):
     return out
 
 
-def build_bert_pretrain(cfg=None, seq_len=128, lr=1e-4, use_amp=False):
-    """BERT pretraining program: MLM + NSP losses
-    (feeds: input_ids, token_type_ids, input_mask, mlm_labels [-1 = unmasked],
-    nsp_labels). Returns (main, startup, feeds, fetches)."""
+def build_bert_pretrain(cfg=None, seq_len=128, lr=1e-4, use_amp=False,
+                        max_predictions_per_seq=None):
+    """BERT pretraining program: MLM + NSP losses.
+
+    Default feeds: input_ids, token_type_ids, input_mask, mlm_labels
+    [-1 = unmasked], nsp_labels. With `max_predictions_per_seq=P` the MLM
+    head projects ONLY the gathered masked positions (feeds
+    masked_positions [B, P] + mlm_labels [B, P], -1 padded) — the standard
+    pretraining recipe: the vocab projection shrinks from [B,S,V] to
+    [B,P,V], cutting the head's FLOPs and HBM by S/P (~6x at S=128, P=20).
+    Returns (main, startup, feeds, fetches)."""
     cfg = cfg or BertConfig.base()
     main = fluid.Program()
     startup = fluid.Program()
+    P = max_predictions_per_seq
     with fluid.program_guard(main, startup):
         input_ids = fluid.data("input_ids", shape=[-1, seq_len], dtype="int64")
         token_type_ids = fluid.data("token_type_ids", shape=[-1, seq_len], dtype="int64")
         input_mask = fluid.data("input_mask", shape=[-1, seq_len], dtype="int64")
-        mlm_labels = fluid.data("mlm_labels", shape=[-1, seq_len], dtype="int64")
+        if P:
+            masked_positions = fluid.data(
+                "masked_positions", shape=[-1, P], dtype="int64"
+            )
+            mlm_labels = fluid.data("mlm_labels", shape=[-1, P], dtype="int64")
+        else:
+            mlm_labels = fluid.data(
+                "mlm_labels", shape=[-1, seq_len], dtype="int64"
+            )
         nsp_labels = fluid.data("nsp_labels", shape=[-1, 1], dtype="int64")
 
         seq_out, pooled = bert_encoder(input_ids, token_type_ids, input_mask, cfg, seq_len)
 
-        # MLM head: transform + tied-ish output projection
-        mlm_t = _dense(seq_out, cfg.hidden_size, cfg, act="gelu", name="mlm_transform")
+        # MLM head: transform + output projection (gathered positions only
+        # when P is set)
+        mlm_in = (
+            fluid.layers.batched_gather(seq_out, masked_positions)
+            if P
+            else seq_out
+        )
+        n_pred = P or seq_len
+        mlm_t = _dense(mlm_in, cfg.hidden_size, cfg, act="gelu", name="mlm_transform")
         mlm_t = fluid.layers.layer_norm(mlm_t, begin_norm_axis=2, name="mlm_ln")
         mlm_logits = _dense(mlm_t, cfg.vocab_size, cfg, name="mlm_out")
         mlm_loss_tok = fluid.layers.softmax_with_cross_entropy(
-            mlm_logits, fluid.layers.reshape(mlm_labels, [0, seq_len, 1]),
+            mlm_logits, fluid.layers.reshape(mlm_labels, [0, n_pred, 1]),
             ignore_index=-1, axis=-1,
-        )  # [B, S, 1], zeros at ignored
+        )  # [B, n_pred, 1], zeros at ignored
         is_masked = fluid.layers.cast(
             fluid.layers.tensor.not_equal(
                 mlm_labels, fluid.layers.tensor.fill_constant([1], "int64", -1)
@@ -261,20 +287,40 @@ def build_bert_pretrain(cfg=None, seq_len=128, lr=1e-4, use_amp=False):
             opt = decorate(opt)
         opt.minimize(loss)
     feeds = [input_ids, token_type_ids, input_mask, mlm_labels, nsp_labels]
+    if P:
+        feeds.insert(3, masked_positions)
     return main, startup, feeds, [loss, mlm_loss, nsp_loss]
 
 
-def synthetic_batch(rng, batch, seq_len, cfg):
+def synthetic_batch(rng, batch, seq_len, cfg, max_predictions_per_seq=None):
     ids = rng.randint(0, cfg.vocab_size, (batch, seq_len)).astype("int64")
     types = np.zeros((batch, seq_len), dtype="int64")
     mask = np.ones((batch, seq_len), dtype="int64")
+    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
+    P = max_predictions_per_seq
+    if P:
+        positions = np.zeros((batch, P), dtype="int64")
+        labels = np.full((batch, P), -1, dtype="int64")
+        n_mask = min(P, max(1, seq_len // 7))
+        for b in range(batch):
+            pos = rng.choice(seq_len, n_mask, replace=False)
+            positions[b, :n_mask] = pos
+            labels[b, :n_mask] = ids[b, pos]
+            ids[b, pos] = 103  # [MASK]
+        return {
+            "input_ids": ids,
+            "token_type_ids": types,
+            "input_mask": mask,
+            "masked_positions": positions,
+            "mlm_labels": labels,
+            "nsp_labels": nsp,
+        }
     mlm = np.full((batch, seq_len), -1, dtype="int64")
     n_mask = max(1, seq_len // 7)
     for b in range(batch):
         pos = rng.choice(seq_len, n_mask, replace=False)
         mlm[b, pos] = ids[b, pos]
         ids[b, pos] = 103  # [MASK]
-    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
     return {
         "input_ids": ids,
         "token_type_ids": types,

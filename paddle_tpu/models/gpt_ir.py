@@ -34,21 +34,31 @@ class GPTIRConfig:
 
 
 def _causal_bias(seq_len):
-    mask = np.triu(np.full((seq_len, seq_len), -1e9, dtype="float32"), k=1)
+    """[1, 1, S, S] additive causal mask built IN-GRAPH from an O(S)
+    position vector (an O(S^2) assign_value attr would bloat the program
+    quadratically at long sequence lengths)."""
     from paddle_tpu.layer_helper import LayerHelper
 
-    helper = LayerHelper("causal_bias")
-    out = helper.block.create_var(
-        name=helper.name, shape=[1, 1, seq_len, seq_len], dtype="float32",
+    helper = LayerHelper("causal_pos")
+    pos = helper.block.create_var(
+        name=helper.name, shape=[seq_len], dtype="float32",
         stop_gradient=True,
     )
     helper.append_op(
         "assign_value",
         {},
-        {"Out": [out.name]},
-        {"shape": [1, 1, seq_len, seq_len], "dtype": "float32",
-         "values": mask.reshape(-1).tolist()},
+        {"Out": [pos.name]},
+        {"shape": [seq_len], "dtype": "float32",
+         "values": [float(i) for i in range(seq_len)]},
     )
+    rows = fluid.layers.reshape(pos, [seq_len, 1])
+    cols = fluid.layers.reshape(pos, [1, seq_len])
+    future = fluid.layers.cast(
+        fluid.layers.greater_than(cols, rows), "float32"
+    )  # 1 above the diagonal
+    bias = fluid.layers.scale(future, scale=-1e9)
+    out = fluid.layers.reshape(bias, [1, 1, seq_len, seq_len])
+    out.stop_gradient = True
     return out
 
 

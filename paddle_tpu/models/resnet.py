@@ -89,9 +89,11 @@ def resnet(input, class_dim=1000, depth=50):
     return logits
 
 
-def build_resnet_train(depth=50, class_dim=1000, image_shape=(3, 224, 224), lr=0.1):
+def build_resnet_train(depth=50, class_dim=1000, image_shape=(3, 224, 224),
+                       lr=0.1, use_amp=False):
     """Returns (main, startup, feeds, fetches) for ResNet training with
-    momentum + L2 decay (the reference recipe)."""
+    momentum + L2 decay (the reference recipe); use_amp runs convs/matmuls
+    in bf16 (amp white list)."""
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
@@ -107,6 +109,10 @@ def build_resnet_train(depth=50, class_dim=1000, image_shape=(3, 224, 224), lr=0
             momentum=0.9,
             regularization=fluid.regularizer.L2Decay(1e-4),
         )
+        if use_amp:
+            from paddle_tpu.amp import decorate
+
+            opt = decorate(opt)
         opt.minimize(loss)
     return main, startup, [img, label], [loss, acc]
 

@@ -55,10 +55,15 @@ def _assign_value(ins, attrs):
 @register_op("range", nondiff_inputs=("Start", "End", "Step"))
 def _range(ins, attrs):
     start, end, step = first(ins, "Start"), first(ins, "End"), first(ins, "Step")
-    # shapes must be static under XLA: require concrete python scalars
+    # shapes must be static under XLA: require concrete (constant) bounds;
+    # reshape to () first - jax refuses float() on [1]-shaped arrays
     return {
         "Out": [
-            jnp.arange(float(start), float(end), float(step)).astype(start.dtype)
+            jnp.arange(
+                float(jnp.reshape(start, ())),
+                float(jnp.reshape(end, ())),
+                float(jnp.reshape(step, ())),
+            ).astype(start.dtype)
         ]
     }
 
@@ -433,3 +438,16 @@ def _print(ins, attrs):
     x = first(ins, "In")
     jax.debug.print(attrs.get("message", "print") + ": {x}", x=x)
     return {"Out": [x]}
+
+
+@register_op("batched_gather", nondiff_inputs=("Index",))
+def _batched_gather(ins, attrs):
+    """Per-row gather along axis 1: X [B, S, ...] + Index [B, P] ->
+    [B, P, ...] (the masked-position gather BERT-style pretraining needs;
+    the reference reaches the same result with LoD + sequence ops)."""
+    x = first(ins, "X")
+    idx = first(ins, "Index").astype(jnp.int32)
+    idx_e = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.take_along_axis(
+        x, jnp.broadcast_to(idx_e, idx.shape + x.shape[2:]), axis=1
+    )]}

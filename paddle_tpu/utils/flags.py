@@ -75,4 +75,10 @@ define_flag("executor_log_level", 0, "VLOG level for executor tracing")
 define_flag("rpc_deadline", 180000, "PS RPC deadline ms")
 define_flag("rpc_retry_times", 3, "PS RPC retry count")
 define_flag("amp_dtype", "bfloat16", "low-precision dtype for AMP on TPU")
+define_flag(
+    "rng_impl", "threefry",
+    "PRNG implementation for stateful ops (dropout etc.): 'threefry' is "
+    "jax's default splittable generator; 'rbg' uses the TPU's hardware RNG "
+    "path - much cheaper bits, same distribution, different stream",
+)
 define_flag("allocator_strategy", "auto_growth", "host allocator strategy label")

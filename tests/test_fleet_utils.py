@@ -1,0 +1,173 @@
+"""fs shell, data_generator, FleetUtil, global_shuffle tests.
+
+reference: paddle/fluid/framework/io/fs.cc, incubate/data_generator/
+__init__.py:21, incubate/fleet/utils/fleet_util.py:40, data_set.cc
+GlobalShuffle.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.utils.fs import LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path / "a/b")
+    fs.mkdirs(d)
+    assert fs.is_exist(d) and fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    assert fs.ls_dir(d) == ["x.txt"]
+    fs.upload(f, str(tmp_path / "c/y.txt"))
+    assert fs.is_exist(str(tmp_path / "c/y.txt"))
+    fs.mv(f, os.path.join(d, "z.txt"))
+    assert fs.ls_dir(d) == ["z.txt"]
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_raises_without_hadoop():
+    from paddle_tpu.utils.enforce import EnforceError
+    from paddle_tpu.utils.fs import HDFSClient
+
+    c = HDFSClient(hadoop_home="/nonexistent")
+    if os.path.exists(c._hadoop):  # hadoop actually installed
+        pytest.skip("hadoop present")
+    with pytest.raises(EnforceError, match="hadoop"):
+        c.ls_dir("/")
+
+
+def test_data_generator_multislot_roundtrip(tmp_path):
+    """Generator output feeds straight into InMemoryDataset."""
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                toks = [int(x) for x in line.split()]
+                yield [("ids", toks), ("label", [toks[0] % 2])]
+
+            return it
+
+    g = G()
+    lines = ["1 2 3", "4 5", "7"]
+    out = g.run_from_memory(lines)
+    assert out[0] == "3 1 2 3 1 1"
+    assert out[1] == "2 4 5 1 0"
+
+    # through the dataset
+    data_file = tmp_path / "part-0"
+    data_file.write_text("\n".join(out) + "\n")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.data("ids", shape=[-1, -1], dtype="int64")
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(3)
+    ds.set_use_var([ids, label])
+    ds.set_filelist([str(data_file)])
+    ds.load_into_memory()
+    batches = list(ds._iter_batches())
+    assert batches[0]["label"].reshape(-1).tolist() == [1, 0, 1]
+
+
+def test_global_shuffle_exchanges_records(tmp_path):
+    """2 'workers' (threads with distinct rank env) exchange records via the
+    shared dir: afterwards each holds a hash partition of the UNION, every
+    record surviving exactly once."""
+    from paddle_tpu.dataset import InMemoryDataset
+
+    all_records = [f"1 {i} 1 {i % 2}" for i in range(40)]
+    files = []
+    for w in range(2):
+        p = tmp_path / f"in_{w}.txt"
+        p.write_text("\n".join(all_records[w * 20:(w + 1) * 20]) + "\n")
+        files.append(str(p))
+    exdir = str(tmp_path / "exchange")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.data("ids", shape=[-1, 1], dtype="int64")
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
+
+    class FakeFleet:
+        def __init__(self, rank):
+            self._rank = rank
+
+        def worker_index(self):
+            return self._rank
+
+        def worker_num(self):
+            return 2
+
+    results = {}
+
+    def run(rank):
+        ds = InMemoryDataset()
+        ds.set_batch_size(64)
+        ds.set_use_var([ids, label])
+        ds.set_filelist([files[rank]])
+        ds.load_into_memory()
+        ds.global_shuffle(FakeFleet(rank), exchange_dir=exdir, timeout=60)
+        got = []
+        for b in ds._iter_batches():
+            got.extend(int(v) for v in b["ids"].reshape(-1))
+        results[rank] = got
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    union = sorted(results[0] + results[1])
+    assert union == list(range(40))  # nothing lost, nothing duplicated
+    # both partitions non-trivial (hash split)
+    assert len(results[0]) > 5 and len(results[1]) > 5
+    # records actually MOVED across workers: each worker now holds ids from
+    # the other worker's original file
+    assert any(i >= 20 for i in results[0])
+    assert any(i < 20 for i in results[1])
+
+
+def test_fleet_util(tmp_path, rng):
+    from paddle_tpu.incubate import FleetUtil
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1], dtype="int64")
+        logits = fluid.layers.fc(x, size=2, num_flatten_dims=1)
+        prob = fluid.layers.softmax(logits)
+        auc_out, stats = fluid.layers.auc(prob, y, num_thresholds=255)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y)
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(64, 4).astype("float32"),
+            "y": rng.randint(0, 2, (64, 1)).astype("int64")}
+    exe.run(main, feed=feed, fetch_list=[auc_out])
+
+    util = FleetUtil()
+    auc = util.get_global_auc(stats[0], stats[1])
+    assert auc is not None and 0.0 <= auc <= 1.0
+
+    s = util.program_summary(main)
+    assert s["num_params"] >= 2 and s["num_ops"] > 3
+
+    util.save_program(main, str(tmp_path / "m"), executor=exe)
+    assert util.params_allclose(main, str(tmp_path / "m")) == {}
+    # perturb one param -> compare flags exactly it
+    scope = fluid.global_scope()
+    pname = main.all_parameters()[0].name
+    scope.set(pname, np.asarray(scope.find_var(pname)) + 1.0)
+    bad = util.params_allclose(main, str(tmp_path / "m"))
+    assert list(bad) == [pname]

@@ -21,7 +21,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build(cfg_kwargs, seq_len, use_amp):
+def _build(cfg_kwargs, seq_len, use_amp, max_pred=None):
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
 
@@ -29,23 +29,30 @@ def _build(cfg_kwargs, seq_len, use_amp):
     for k, v in cfg_kwargs.items():
         setattr(cfg, k, v)
     main, startup, feeds, fetches = bert.build_bert_pretrain(
-        cfg, seq_len=seq_len, lr=1e-4, use_amp=use_amp
+        cfg, seq_len=seq_len, lr=1e-4, use_amp=use_amp,
+        max_predictions_per_seq=max_pred,
     )
     return cfg, main, startup, fetches
 
 
 def run_variant(name, batch, seq_len, steps=10, use_amp=True,
-                trace_dir=None, **cfg_kwargs):
+                trace_dir=None, max_pred=None, rng_impl="threefry",
+                **cfg_kwargs):
     import jax
 
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
+    from paddle_tpu.utils.flags import flags
 
-    cfg, main, startup, fetches = _build(cfg_kwargs, seq_len, use_amp)
+    flags.rng_impl = rng_impl
+    cfg, main, startup, fetches = _build(cfg_kwargs, seq_len, use_amp,
+                                         max_pred)
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)
     rng = np.random.RandomState(0)
-    data = bert.synthetic_batch(rng, batch, seq_len, cfg)
+    data = bert.synthetic_batch(
+        rng, batch, seq_len, cfg, max_predictions_per_seq=max_pred
+    )
 
     for _ in range(2):  # compile + settle
         out = exe.run(main, feed=data, fetch_list=[fetches[0]],
@@ -84,27 +91,39 @@ def main():
     trace_dir = os.environ.get("PROFILE_TRACE_DIR")
     only = os.environ.get("PROFILE_ONLY")
 
+    P = max(1, seq // 7) + 1
     variants = [
-        ("baseline_amp_dropout", dict()),
-        ("no_dropout", dict(hidden_dropout_prob=0.0,
-                            attention_probs_dropout_prob=0.0)),
-        ("flash_no_dropout", dict(use_flash_attention=True,
-                                  hidden_dropout_prob=0.0,
-                                  attention_probs_dropout_prob=0.0)),
+        # the shipped bench config: flash + gathered head + rbg dropout
+        ("bench_config", dict(_max_pred=P, _rng="rbg",
+                              use_flash_attention=True,
+                              attention_probs_dropout_prob=0.0)),
+        # one knob off at a time
+        ("no_flash", dict(_max_pred=P, _rng="rbg")),
+        ("threefry", dict(_max_pred=P, _rng="threefry",
+                          use_flash_attention=True,
+                          attention_probs_dropout_prob=0.0)),
+        ("full_vocab_head", dict(_rng="rbg", use_flash_attention=True,
+                                 attention_probs_dropout_prob=0.0)),
+        # the round-2 configuration for the before/after line
+        ("r2_baseline", dict(_rng="threefry")),
     ]
     if os.environ.get("PROFILE_EXTRA"):
         variants += [
-            ("fp32", dict(_use_amp=False)),
-            ("flash", dict(use_flash_attention=True,
-                           attention_probs_dropout_prob=0.0)),
+            ("fp32", dict(_use_amp=False, _max_pred=P, _rng="rbg")),
+            ("no_dropout", dict(_max_pred=P, _rng="rbg",
+                                hidden_dropout_prob=0.0,
+                                attention_probs_dropout_prob=0.0)),
         ]
     for name, kw in variants:
         if only and only != name:
             continue
         use_amp = kw.pop("_use_amp", True)
+        max_pred = kw.pop("_max_pred", None)
+        rng_impl = kw.pop("_rng", "threefry")
         try:
             run_variant(name, batch, seq, use_amp=use_amp,
-                        trace_dir=trace_dir if name == "baseline_amp_dropout"
+                        max_pred=max_pred, rng_impl=rng_impl,
+                        trace_dir=trace_dir if name == "bench_config"
                         else None, **kw)
         except Exception as e:  # keep the table going past one bad variant
             print(json.dumps({"variant": name, "error": str(e)[:300]}),
