@@ -117,6 +117,66 @@ struct GIL {
   ~GIL() { PyGILState_Release(state); }
 };
 
+const size_t kDtypeItemSize[] = {4, 4, 8, 1};  // PD_DataType enum order
+
+// shared marshalling: bridge_fn(obj, name, dtype, shape, memoryview)
+int set_named_input(PyObject* obj, const char* bridge_fn, const char* name,
+                    int dtype, const int64_t* shape, int ndim,
+                    const void* data) {
+  if (dtype < 0 || static_cast<size_t>(dtype) >=
+                       sizeof(kDtypeItemSize) / sizeof(*kDtypeItemSize)) {
+    g_last_error = std::string(bridge_fn) + ": invalid PD_DataType";
+    return 1;
+  }
+  GIL gil;
+  size_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= static_cast<size_t>(shape[i]);
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* mv = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      static_cast<Py_ssize_t>(n * kDtypeItemSize[dtype]), PyBUF_READ);
+  PyObject* out = bridge_call(
+      bridge_fn, Py_BuildValue("(OsiNN)", obj, name, dtype, shp, mv));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+// shared unpacking of a bridge (dtype, shape, bytes) tuple into malloc'd
+// C buffers
+int unpack_tensor_tuple(PyObject* out, PD_DataType* dtype, int64_t** shape,
+                        int* ndim, void** data, size_t* nbytes) {
+  int dt = 0;
+  PyObject *shp = nullptr, *raw = nullptr;
+  if (!PyArg_ParseTuple(out, "iOO", &dt, &shp, &raw)) {
+    set_error_from_python();
+    Py_DECREF(out);
+    return 1;
+  }
+  *dtype = static_cast<PD_DataType>(dt);
+  *ndim = static_cast<int>(PyTuple_Size(shp));
+  *shape = static_cast<int64_t*>(malloc(sizeof(int64_t) * (*ndim)));
+  for (int i = 0; i < *ndim; ++i) {
+    (*shape)[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
+    set_error_from_python();
+    free(*shape);
+    Py_DECREF(out);
+    return 1;
+  }
+  *data = malloc(static_cast<size_t>(len));
+  memcpy(*data, buf, static_cast<size_t>(len));
+  *nbytes = static_cast<size_t>(len);
+  Py_DECREF(out);
+  return 0;
+}
+
 }  // namespace
 
 struct PD_AnalysisConfig {
@@ -133,6 +193,16 @@ struct PD_Predictor {
   PyObject* obj = nullptr;           // bridge Predictor
   std::vector<std::string> inputs;   // cached names (stable char*)
   std::vector<std::string> outputs;
+};
+
+struct PD_Trainer {
+  PyObject* obj = nullptr;  // bridge _Trainer
+  std::string loss_name;
+};
+
+struct PD_Program {
+  PyObject* obj = nullptr;  // bridge Program
+  std::string last_op_type;
 };
 
 extern "C" {
@@ -244,27 +314,8 @@ const char* PD_GetOutputName(const PD_Predictor* p, int i) {
 
 int PD_SetInput(PD_Predictor* p, const char* name, PD_DataType dtype,
                 const int64_t* shape, int ndim, const void* data) {
-  static const size_t kItem[] = {4, 4, 8, 1};
-  if (dtype < 0 || static_cast<size_t>(dtype) >= sizeof(kItem) / sizeof(*kItem)) {
-    g_last_error = "PD_SetInput: invalid PD_DataType";
-    return 1;
-  }
-  GIL gil;
-  size_t n = 1;
-  PyObject* shp = PyTuple_New(ndim);
-  for (int i = 0; i < ndim; ++i) {
-    n *= static_cast<size_t>(shape[i]);
-    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
-  }
-  PyObject* mv = PyMemoryView_FromMemory(
-      const_cast<char*>(static_cast<const char*>(data)),
-      static_cast<Py_ssize_t>(n * kItem[dtype]), PyBUF_READ);
-  PyObject* out = bridge_call(
-      "set_input",
-      Py_BuildValue("(OsiNN)", p->obj, name, static_cast<int>(dtype), shp, mv));
-  if (!out) return 1;
-  Py_DECREF(out);
-  return 0;
+  return set_named_input(p->obj, "set_input", name, static_cast<int>(dtype),
+                         shape, ndim, data);
 }
 
 int PD_PredictorRun(PD_Predictor* p) {
@@ -281,36 +332,121 @@ int PD_GetOutput(PD_Predictor* p, const char* name, PD_DataType* dtype,
   PyObject* out =
       bridge_call("get_output", Py_BuildValue("(Os)", p->obj, name));
   if (!out) return 1;
-  int dt = 0;
-  PyObject *shp = nullptr, *raw = nullptr;
-  if (!PyArg_ParseTuple(out, "iOO", &dt, &shp, &raw)) {
-    set_error_from_python();
-    Py_DECREF(out);
-    return 1;
-  }
-  *dtype = static_cast<PD_DataType>(dt);
-  *ndim = static_cast<int>(PyTuple_Size(shp));
-  *shape = static_cast<int64_t*>(malloc(sizeof(int64_t) * (*ndim)));
-  for (int i = 0; i < *ndim; ++i) {
-    (*shape)[i] = PyLong_AsLongLong(PyTuple_GetItem(shp, i));
-  }
-  char* buf = nullptr;
-  Py_ssize_t len = 0;
-  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
-    set_error_from_python();
-    free(*shape);
-    Py_DECREF(out);
-    return 1;
-  }
-  *data = malloc(static_cast<size_t>(len));
-  memcpy(*data, buf, static_cast<size_t>(len));
-  *nbytes = static_cast<size_t>(len);
-  Py_DECREF(out);
-  return 0;
+  return unpack_tensor_tuple(out, dtype, shape, ndim, data, nbytes);
 }
 
 void PD_Free(void* ptr) { free(ptr); }
 
 const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+/* -- train API ---------------------------------------------------------- */
+
+PD_Trainer* PD_NewTrainer(const char* model_dir, int use_tpu) {
+  if (!ensure_python()) return nullptr;
+  GIL gil;
+  PyObject* obj =
+      bridge_call("new_trainer", Py_BuildValue("(si)", model_dir, use_tpu));
+  if (!obj) return nullptr;
+  auto* t = new PD_Trainer;
+  t->obj = obj;
+  PyObject* ln =
+      bridge_call("trainer_loss_name", Py_BuildValue("(O)", obj));
+  if (ln) {
+    const char* s = PyUnicode_AsUTF8(ln);
+    t->loss_name = s ? s : "";
+    Py_DECREF(ln);
+  }
+  return t;
+}
+
+void PD_DeleteTrainer(PD_Trainer* t) {
+  if (!t) return;
+  if (t->obj) {
+    GIL gil;
+    Py_DECREF(t->obj);
+  }
+  delete t;
+}
+
+const char* PD_TrainerLossName(const PD_Trainer* t) {
+  return t->loss_name.c_str();
+}
+
+int PD_TrainerSetInput(PD_Trainer* t, const char* name, PD_DataType dtype,
+                       const int64_t* shape, int ndim, const void* data) {
+  return set_named_input(t->obj, "trainer_set_input", name,
+                         static_cast<int>(dtype), shape, ndim, data);
+}
+
+int PD_TrainerRunStep(PD_Trainer* t, const char* fetch_name,
+                      PD_DataType* dtype, int64_t** shape, int* ndim,
+                      void** data, size_t* nbytes) {
+  GIL gil;
+  PyObject* out = bridge_call(
+      "trainer_run",
+      Py_BuildValue("(Os)", t->obj, fetch_name ? fetch_name : ""));
+  if (!out) return 1;
+  return unpack_tensor_tuple(out, dtype, shape, ndim, data, nbytes);
+}
+
+int PD_TrainerSave(PD_Trainer* t, const char* dirname) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("trainer_save", Py_BuildValue("(Os)", t->obj, dirname));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+/* -- ProgramDesc IO ----------------------------------------------------- */
+
+PD_Program* PD_LoadProgram(const char* path) {
+  if (!ensure_python()) return nullptr;
+  GIL gil;
+  PyObject* obj = bridge_call("program_load", Py_BuildValue("(s)", path));
+  if (!obj) return nullptr;
+  auto* p = new PD_Program;
+  p->obj = obj;
+  return p;
+}
+
+void PD_DeleteProgram(PD_Program* p) {
+  if (!p) return;
+  if (p->obj) {
+    GIL gil;
+    Py_DECREF(p->obj);
+  }
+  delete p;
+}
+
+int PD_SaveProgram(const PD_Program* p, const char* path) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("program_save", Py_BuildValue("(Os)", p->obj, path));
+  if (!out) return 1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int PD_ProgramOpCount(const PD_Program* p) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("program_op_count", Py_BuildValue("(O)", p->obj));
+  if (!out) return -1;
+  long n = PyLong_AsLong(out);
+  Py_DECREF(out);
+  return static_cast<int>(n);
+}
+
+const char* PD_ProgramOpType(const PD_Program* p, int index) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("program_op_type", Py_BuildValue("(Oi)", p->obj, index));
+  if (!out) return nullptr;
+  const char* s = PyUnicode_AsUTF8(out);
+  const_cast<PD_Program*>(p)->last_op_type = s ? s : "";
+  Py_DECREF(out);
+  return p->last_op_type.c_str();
+}
 
 }  // extern "C"

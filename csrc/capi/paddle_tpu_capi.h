@@ -65,6 +65,36 @@ int PD_GetOutput(PD_Predictor* predictor, const char* name,
 void PD_Free(void* ptr);
 const char* PD_GetLastError(void);
 
+/* -- train API (reference: paddle/fluid/train/ C++ train demo) ----------
+ * model_dir holds main_program/startup_program (+ optional params/) as
+ * written by paddle_tpu.io.save_train_model. */
+typedef struct PD_Trainer PD_Trainer;
+
+PD_Trainer* PD_NewTrainer(const char* model_dir, int use_tpu);
+void PD_DeleteTrainer(PD_Trainer* trainer);
+/* "" when the export recorded no loss */
+const char* PD_TrainerLossName(const PD_Trainer* trainer);
+int PD_TrainerSetInput(PD_Trainer* trainer, const char* name,
+                       PD_DataType dtype, const int64_t* shape, int ndim,
+                       const void* data);
+/* one training step; fetch_name NULL/"" fetches the recorded loss.
+ * Output buffers are malloc'd - release with PD_Free. */
+int PD_TrainerRunStep(PD_Trainer* trainer, const char* fetch_name,
+                      PD_DataType* dtype, int64_t** shape, int* ndim,
+                      void** data, size_t* nbytes);
+/* save persistables (params + optimizer state) to dirname */
+int PD_TrainerSave(PD_Trainer* trainer, const char* dirname);
+
+/* -- ProgramDesc IO (reference: paddle/fluid/framework/c/c_api.cc) ------ */
+typedef struct PD_Program PD_Program;
+
+PD_Program* PD_LoadProgram(const char* path);
+void PD_DeleteProgram(PD_Program* program);
+int PD_SaveProgram(const PD_Program* program, const char* path);
+int PD_ProgramOpCount(const PD_Program* program);
+/* returned pointer valid until the next PD_ProgramOpType call */
+const char* PD_ProgramOpType(const PD_Program* program, int index);
+
 #ifdef __cplusplus
 }
 #endif

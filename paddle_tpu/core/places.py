@@ -36,7 +36,13 @@ class CPUPlace(Place):
     def jax_device(self):
         import jax
 
-        return jax.devices("cpu")[0] if "cpu" in _platforms() else jax.devices()[0]
+        # local_devices: in a multi-controller job jax.devices() lists every
+        # process's devices; an executor must target one THIS process owns
+        return (
+            jax.local_devices(backend="cpu")[0]
+            if "cpu" in _platforms()
+            else jax.local_devices()[0]
+        )
 
 
 class TPUPlace(Place):
@@ -51,7 +57,9 @@ class TPUPlace(Place):
         if not devs:
             # CPU fallback keeps programs runnable on hosts without a TPU
             # (tests force JAX_PLATFORMS=cpu with a virtual 8-device mesh).
-            devs = jax.devices()
+            # local only: a multi-controller peer's devices are not valid
+            # device_put targets here
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 
@@ -77,7 +85,7 @@ def _accelerator_devices():
         # makes a bare jax.devices() hang, so never probe accelerators.
         jax.config.update("jax_platforms", "cpu")
         return []
-    return [d for d in jax.devices() if d.platform != "cpu"]
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 _PROBE_SRC = (

@@ -9,6 +9,8 @@ global device mesh (XLA lowers it onto ICI/DCN), with all gradients flattened
 and concatenated into coalesced buckets exactly like the reference's
 coalesce_grad_tensor_pass."""
 
+import functools
+
 import numpy as np
 
 import jax
@@ -77,24 +79,36 @@ def _global_psum(grads):
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     flat = jnp.concatenate([g.reshape(-1).astype(jnp.float32) for g in grads])
 
-    devices = jax.devices()
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
+    devices = jax.devices()
     mesh = Mesh(np.array(devices), ("world",))
+    row_sharding = NamedSharding(mesh, P("world"))
+    repl = NamedSharding(mesh, P())
+    # each process's DIFFERENT local gradients become row 0 of its LOCAL
+    # block of a [world, size] global array (a local array cannot be fed to
+    # a sharding spanning non-addressable devices); extra local devices
+    # carry zero rows so the row-sum — GSPMD's cross-process allreduce over
+    # DCN/ICI — counts each process's gradients exactly once. The same
+    # construction covers the single-process case.
+    n_local = len(jax.local_devices())
+    local_rows = np.zeros((n_local, flat.shape[0]), np.float32)
+    local_rows[0] = np.asarray(flat)
+    stacked = jax.make_array_from_process_local_data(row_sharding, local_rows)
 
-    @jax.jit
-    def allreduce(x):
-        return shard_map(
-            lambda v: jax.lax.psum(v, "world"),
-            mesh=mesh,
-            in_specs=P(None),
-            out_specs=P(None),
-        )(x)
-
-    summed = allreduce(flat)
+    summed = _row_sum(stacked, repl)
+    if jax.process_count() > 1:
+        # hand back a LOCAL array: the replicated global result is not a
+        # valid input for single-device work downstream (device_put to a
+        # local device would try to touch peers' devices)
+        summed = jnp.asarray(np.asarray(summed.addressable_data(0)))
     out, off = [], 0
     for shape, size, g in zip(shapes, sizes, grads):
         out.append(summed[off : off + size].reshape(shape).astype(g.dtype))
         off += size
     return out
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _row_sum(x, out_sharding):
+    return jax.lax.with_sharding_constraint(x.sum(axis=0), out_sharding)

@@ -66,3 +66,103 @@ def get_output(pred, name):
     if dt not in _DTYPES:
         raise TypeError(f"output '{name}' has non-C-ABI dtype {dt}")
     return _DTYPES.index(dt), tuple(arr.shape), arr.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# C train API bridge (reference: paddle/fluid/train/ - the C++ train demo;
+# here PD_Trainer in csrc/capi/capi.cc drives these)
+# ---------------------------------------------------------------------------
+
+
+class _Trainer:
+    def __init__(self, model_dir, use_tpu):
+        import paddle_tpu as fluid
+        from paddle_tpu import io as pio
+
+        self.main, self.startup, self.loss = pio.load_train_model(model_dir)
+        place = fluid.TPUPlace(0) if use_tpu else fluid.CPUPlace()
+        self.exe = fluid.Executor(place)
+        self.scope = fluid.Scope()
+        import os
+
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+            params_dir = os.path.join(model_dir, "params")
+            if os.path.isdir(params_dir):
+                pio.load_persistables(
+                    self.exe, params_dir, main_program=self.main
+                )
+        self.feeds = {}
+
+
+def new_trainer(model_dir, use_tpu):
+    return _Trainer(model_dir, bool(use_tpu))
+
+
+def trainer_loss_name(tr):
+    return tr.loss or ""
+
+
+def trainer_set_input(tr, name, dtype_idx, shape, data):
+    """`data` is a memoryview over the caller's buffer; copy immediately -
+    the C host may free/reuse it after this returns (same contract as
+    set_input above)."""
+    tr.feeds[name] = (
+        np.frombuffer(data, dtype=_DTYPES[dtype_idx]).reshape(shape).copy()
+    )
+    return 0
+
+
+def trainer_run(tr, fetch_name):
+    """One training step with the accumulated feeds; returns the fetched
+    var as (dtype_idx, shape, bytes). Empty fetch_name = the saved loss."""
+    import paddle_tpu as fluid
+
+    fetch = fetch_name or tr.loss
+    with fluid.scope_guard(tr.scope):
+        out = tr.exe.run(
+            tr.main, feed=dict(tr.feeds), fetch_list=[fetch] if fetch else []
+        )
+    if not fetch:
+        return (0, (), b"")
+    arr = np.ascontiguousarray(np.asarray(out[0]))
+    if arr.dtype == np.float64 or str(arr.dtype) == "bfloat16":
+        arr = arr.astype(np.float32)
+    dt = str(arr.dtype)
+    if dt not in _DTYPES:
+        raise TypeError(f"fetch '{fetch}' has non-C-ABI dtype {dt}")
+    return _DTYPES.index(dt), tuple(int(d) for d in arr.shape), arr.tobytes()
+
+
+def trainer_save(tr, dirname):
+    import paddle_tpu as fluid
+    from paddle_tpu import io as pio
+
+    with fluid.scope_guard(tr.scope):
+        pio.save_persistables(tr.exe, dirname, main_program=tr.main)
+    return 0
+
+
+# -- ProgramDesc-level C surface (reference: paddle/fluid/framework/c/
+# c_api.cc - minimal ProgramDesc IO) ---------------------------------------
+
+
+def program_load(path):
+    from paddle_tpu.core.ir import Program
+
+    with open(path, "rb") as f:
+        return Program.from_bytes(f.read())
+
+
+def program_save(prog, path):
+    with open(path, "wb") as f:
+        f.write(prog.to_bytes())
+    return 0
+
+
+def program_op_count(prog):
+    return len(prog.global_block().ops)
+
+
+def program_op_type(prog, i):
+    return prog.global_block().ops[i].type

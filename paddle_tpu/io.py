@@ -301,3 +301,48 @@ def load_inference_model(
     block = program.global_block()
     fetch_vars = [block.var(n) for n in fetch_names]
     return program, feed_names, fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# train-model export for the C train API (reference: paddle/fluid/train/ -
+# demo_trainer.cc loads serialized main/startup ProgramDescs and trains
+# without Python; here the same contract feeds csrc/capi's PD_Trainer)
+# ---------------------------------------------------------------------------
+
+
+def save_train_model(dirname, main_program, startup_program, loss=None,
+                     executor=None):
+    """Serialize (main, startup) programs + meta so a C host can train
+    (csrc/capi PD_NewTrainer). With `executor`, current persistables are
+    saved too (warm start); otherwise the C side runs the startup program."""
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "main_program"), "wb") as f:
+        f.write(main_program.to_bytes())
+    with open(os.path.join(dirname, "startup_program"), "wb") as f:
+        f.write(startup_program.to_bytes())
+    meta = {"format_version": MODEL_FORMAT_VERSION}
+    if loss is not None:
+        meta["loss"] = loss if isinstance(loss, str) else loss.name
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if executor is not None:
+        save_persistables(
+            executor, os.path.join(dirname, "params"),
+            main_program=main_program,
+        )
+
+
+def load_train_model(dirname):
+    """Returns (main_program, startup_program, loss_name_or_None)."""
+    from paddle_tpu.core.ir import Program
+
+    with open(os.path.join(dirname, "main_program"), "rb") as f:
+        main = Program.from_bytes(f.read())
+    with open(os.path.join(dirname, "startup_program"), "rb") as f:
+        startup = Program.from_bytes(f.read())
+    loss = None
+    meta_p = os.path.join(dirname, "meta.json")
+    if os.path.exists(meta_p):
+        with open(meta_p) as f:
+            loss = json.load(f).get("loss")
+    return main, startup, loss

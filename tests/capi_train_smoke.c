@@ -1,0 +1,84 @@
+/* Out-of-process C train host: load a saved train model, run steps,
+ * assert the loss drops, save persistables.
+ * reference: paddle/fluid/train/demo/demo_trainer.cc (same flow, C ABI). */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <model_dir> <steps> <save_dir>\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int steps = atoi(argv[2]);
+  const char* save_dir = argv[3];
+
+  PD_Trainer* tr = PD_NewTrainer(model_dir, /*use_tpu=*/0);
+  if (!tr) {
+    fprintf(stderr, "PD_NewTrainer failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("loss_name=%s\n", PD_TrainerLossName(tr));
+
+  /* fixed batch: y = 2*x0 - x1 + noiseless */
+  const int64_t xshape[2] = {8, 2};
+  const int64_t yshape[2] = {8, 1};
+  float x[16], y[8];
+  int i;
+  for (i = 0; i < 8; ++i) {
+    x[2 * i] = (float)(i % 4) / 4.0f;
+    x[2 * i + 1] = (float)(i % 3) / 3.0f;
+    y[i] = 2.0f * x[2 * i] - x[2 * i + 1];
+  }
+
+  double first = -1, last = -1;
+  for (i = 0; i < steps; ++i) {
+    if (PD_TrainerSetInput(tr, "x", PD_FLOAT32, xshape, 2, x) ||
+        PD_TrainerSetInput(tr, "y", PD_FLOAT32, yshape, 2, y)) {
+      fprintf(stderr, "SetInput failed: %s\n", PD_GetLastError());
+      return 1;
+    }
+    PD_DataType dt;
+    int64_t* shp;
+    int nd;
+    void* data;
+    size_t nbytes;
+    if (PD_TrainerRunStep(tr, NULL, &dt, &shp, &nd, &data, &nbytes)) {
+      fprintf(stderr, "RunStep failed: %s\n", PD_GetLastError());
+      return 1;
+    }
+    double loss = (double)((float*)data)[0];
+    if (i == 0) first = loss;
+    last = loss;
+    PD_Free(shp);
+    PD_Free(data);
+  }
+  printf("first=%f last=%f\n", first, last);
+  if (!(last < first)) {
+    fprintf(stderr, "loss did not decrease (%f -> %f)\n", first, last);
+    return 1;
+  }
+  if (PD_TrainerSave(tr, save_dir)) {
+    fprintf(stderr, "Save failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  /* ProgramDesc IO surface */
+  char main_path[1024];
+  snprintf(main_path, sizeof main_path, "%s/main_program", model_dir);
+  PD_Program* prog = PD_LoadProgram(main_path);
+  if (!prog) {
+    fprintf(stderr, "PD_LoadProgram failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int nops = PD_ProgramOpCount(prog);
+  printf("ops=%d first_op=%s\n", nops, PD_ProgramOpType(prog, 0));
+  if (nops <= 0) return 1;
+  PD_DeleteProgram(prog);
+  PD_DeleteTrainer(tr);
+  printf("CAPI_TRAIN_OK\n");
+  return 0;
+}

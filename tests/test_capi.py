@@ -101,3 +101,42 @@ def test_go_binding_compiles(tmp_path, rng, capi_lib):
     )
     assert proc.returncode == 0, f"stdout={proc.stdout}\nstderr={proc.stderr}"
     assert "ok" in proc.stdout
+
+
+def _save_train_model(tmpdir):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [-1, 2])
+        y = fluid.data("y", [-1, 1])
+        pred = fluid.layers.fc(x, 1, num_flatten_dims=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+    model_dir = os.path.join(str(tmpdir), "train_model")
+    fluid.io.save_train_model(model_dir, main, startup, loss=loss)
+    return model_dir
+
+
+def test_capi_train_from_c_host(tmp_path, capi_lib):
+    """C host trains the exported program end to end (reference:
+    paddle/fluid/train/demo/demo_trainer.cc flow over the C ABI)."""
+    model_dir = _save_train_model(tmp_path)
+    capi_dir = os.path.dirname(capi_lib)
+    exe_path = os.path.join(str(tmp_path), "capi_train_smoke")
+    build = subprocess.run(
+        ["g++", os.path.join(REPO, "tests", "capi_train_smoke.c"),
+         f"-I{capi_dir}", f"-L{capi_dir}", "-lcapi",
+         f"-Wl,-rpath,{capi_dir}", "-o", exe_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert build.returncode == 0, build.stderr
+    save_dir = os.path.join(str(tmp_path), "saved")
+    env = dict(os.environ)
+    env["PADDLE_TPU_FORCE_CPU"] = "1"
+    r = subprocess.run(
+        [exe_path, model_dir, "20", save_dir],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "CAPI_TRAIN_OK" in r.stdout
+    # persistables were saved (param + optimizer state files exist)
+    assert os.path.isdir(save_dir) and len(os.listdir(save_dir)) >= 2

@@ -248,3 +248,49 @@ def test_ps_fleet_geo_mode_subprocess():
     finally:
         server.send_signal(signal.SIGKILL)
         server.wait(timeout=10)
+
+
+def test_dygraph_data_parallel_2proc():
+    """Dygraph DataParallel across 2 real processes: sharded batches +
+    apply_collective_grads == single-process full-batch run (the reference's
+    test_parallel_dygraph_* pattern). The per-rank reported losses are local
+    shard means; their average must equal the single-run loss, and both
+    ranks must march in lockstep (identical params -> identical curves when
+    shards are swapped)."""
+    W = os.path.join(REPO, "tests", "dist_worker_dygraph.py")
+    steps = 4
+    single = subprocess.run(
+        [sys.executable, W],
+        env=_clean_env({"DIST_SINGLE": "1", "DIST_STEPS": str(steps)}),
+        capture_output=True, text=True, timeout=240,
+    )
+    assert single.returncode == 0, single.stderr[-2000:]
+    ref = _parse_result(single.stdout)
+
+    port = _free_port()
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(2):
+        env = _clean_env(
+            {
+                "DIST_STEPS": str(steps),
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": "2",
+                "PADDLE_DIST_COORDINATOR": coord,
+                "PADDLE_TRAINER_ENDPOINTS":
+                    f"127.0.0.1:{port},127.0.0.1:{port + 1}",
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, W], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    curves = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-2000:]
+        curves.append(_parse_result(out))
+    avg = [(a + b) / 2 for a, b in zip(*curves)]
+    np.testing.assert_allclose(avg, ref, rtol=1e-4, atol=1e-6)
