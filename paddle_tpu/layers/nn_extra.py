@@ -1,0 +1,396 @@
+"""Second tranche of layer functions (reference: python/paddle/fluid/
+layers/nn.py + loss.py — one builder per op in ops/nn_extra.py)."""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "selu", "brelu", "soft_relu", "stanh", "sign", "maxout",
+    "argsort", "eye", "diag", "expand_as", "strided_slice", "reverse",
+    "scatter_nd_add", "pad2d", "shard_index", "rank", "size", "multiplex",
+    "crop_tensor",
+    "log_loss", "rank_loss", "margin_rank_loss", "dice_loss", "bpr_loss",
+    "label_smooth", "cos_sim", "npair_loss", "mean_iou",
+    "resize_nearest", "resize_bilinear", "image_resize", "pixel_shuffle",
+    "space_to_depth", "shuffle_channel", "temporal_shift", "unfold",
+    "add_position_encoding", "bilinear_tensor_product", "pool3d", "conv3d",
+    "adaptive_pool2d",
+]
+
+
+def _simple(op, ins, attrs, dtype="float32", outs=("Out",), name=None):
+    helper = LayerHelper(op, name=name)
+    out_vars = [helper.create_variable_for_type_inference(dtype) for _ in outs]
+    helper.append_op(
+        op, ins, {slot: [v.name] for slot, v in zip(outs, out_vars)}, attrs
+    )
+    return out_vars[0] if len(out_vars) == 1 else tuple(out_vars)
+
+
+def _x_op(op, x, attrs=None, name=None, out_slot="Out"):
+    return _simple(op, {"X": [x.name]}, attrs or {}, x.dtype,
+                   (out_slot,), name)
+
+
+# -- activations ---------------------------------------------------------
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _x_op("selu", x, {"scale": scale, "alpha": alpha}, name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _x_op("brelu", x, {"t_min": t_min, "t_max": t_max}, name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _x_op("soft_relu", x, {"threshold": threshold}, name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _x_op("stanh", x, {"scale_a": scale_a, "scale_b": scale_b}, name)
+
+
+def sign(x, name=None):
+    return _x_op("sign", x, {}, name)
+
+
+def maxout(x, groups, name=None):
+    return _x_op("maxout", x, {"groups": groups}, name)
+
+
+# -- tensor utilities ----------------------------------------------------
+def argsort(x, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ids = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "argsort", {"X": [x.name]},
+        {"Out": [out.name], "Indices": [ids.name]},
+        {"axis": axis, "descending": descending},
+    )
+    return out, ids
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    attrs = {"num_rows": num_rows, "dtype": dtype}
+    if num_columns is not None:
+        attrs["num_columns"] = num_columns
+    return _simple("eye", {}, attrs, dtype, name=name)
+
+
+def diag(diagonal, name=None):
+    return _simple("diag", {"Diagonal": [diagonal.name]}, {},
+                   diagonal.dtype, name=name)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _simple(
+        "expand_as",
+        {"X": [x.name], "target_tensor": [target_tensor.name]}, {},
+        x.dtype, name=name,
+    )
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    return _simple(
+        "strided_slice", {"Input": [input.name]},
+        {"axes": list(axes), "starts": list(starts), "ends": list(ends),
+         "strides": list(strides)},
+        input.dtype, name=name,
+    )
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _x_op("reverse", x, {"axis": list(axis)}, name)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _simple(
+        "scatter_nd_add",
+        {"X": [ref.name], "Index": [index.name], "Updates": [updates.name]},
+        {}, ref.dtype, name=name,
+    )
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _x_op(
+        "pad2d", input,
+        {"paddings": list(paddings), "mode": mode, "pad_value": pad_value},
+        name,
+    )
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    return _x_op(
+        "shard_index", input,
+        {"index_num": index_num, "nshards": nshards, "shard_id": shard_id,
+         "ignore_value": ignore_value},
+        name,
+    )
+
+
+def rank(input, name=None):
+    return _simple("rank", {"Input": [input.name]}, {}, "int32", name=name)
+
+
+def size(input, name=None):
+    return _simple("size", {"Input": [input.name]}, {}, "int64", name=name)
+
+
+def multiplex(inputs, index, name=None):
+    return _simple(
+        "multiplex",
+        {"X": [v.name for v in inputs], "Ids": [index.name]}, {},
+        inputs[0].dtype, name=name,
+    )
+
+
+def crop_tensor(x, shape, offsets, name=None):
+    return _x_op(
+        "crop_tensor", x,
+        {"shape": list(shape), "offsets": list(offsets)}, name,
+    )
+
+
+# -- losses --------------------------------------------------------------
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple(
+        "log_loss",
+        {"Predicted": [input.name], "Labels": [label.name]},
+        {"epsilon": epsilon}, input.dtype, ("Loss",), name,
+    )
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple(
+        "rank_loss",
+        {"Label": [label.name], "Left": [left.name], "Right": [right.name]},
+        {}, left.dtype, name=name,
+    )
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(
+        "margin_rank_loss",
+        {"Label": [label.name], "X1": [left.name], "X2": [right.name]},
+        {"Out": [out.name], "Activated": [act.name]},
+        {"margin": margin},
+    )
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _simple(
+        "dice_loss_op",
+        {"X": [input.name], "Label": [label.name]},
+        {"epsilon": epsilon}, input.dtype, name=name,
+    )
+
+
+def bpr_loss(input, label, name=None):
+    return _simple(
+        "bpr_loss", {"X": [input.name], "Label": [label.name]}, {},
+        input.dtype, name=name,
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    ins = {"X": [label.name]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist.name]
+    return _simple("label_smooth", ins, {"epsilon": epsilon},
+                   label.dtype, name=name)
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(
+        "cos_sim", {"X": [X.name], "Y": [Y.name]},
+        {"Out": [out.name], "XNorm": [xn.name], "YNorm": [yn.name]}, {},
+    )
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return _simple(
+        "npair_loss",
+        {"anchor": [anchor.name], "positive": [positive.name],
+         "labels": [labels.name]},
+        {"l2_reg": l2_reg}, anchor.dtype, name=name,
+    )
+
+
+def mean_iou(input, label, num_classes, name=None):
+    helper = LayerHelper("mean_iou", name=name)
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("float32")
+    correct = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "mean_iou",
+        {"Predictions": [input.name], "Labels": [label.name]},
+        {"OutMeanIou": [miou.name], "OutWrong": [wrong.name],
+         "OutCorrect": [correct.name]},
+        {"num_classes": num_classes},
+    )
+    return miou, wrong, correct
+
+
+# -- vision --------------------------------------------------------------
+def resize_nearest(input, out_shape, align_corners=True, name=None):
+    return _x_op(
+        "nearest_interp", input,
+        {"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
+         "align_corners": align_corners}, name,
+    )
+
+
+def resize_bilinear(input, out_shape, align_corners=True, name=None):
+    return _x_op(
+        "bilinear_interp", input,
+        {"out_h": int(out_shape[0]), "out_w": int(out_shape[1]),
+         "align_corners": align_corners}, name,
+    )
+
+
+def image_resize(input, out_shape, resample="BILINEAR", align_corners=True,
+                 name=None):
+    resample = resample.upper()
+    if resample == "BILINEAR":
+        return resize_bilinear(input, out_shape, align_corners, name=name)
+    if resample == "NEAREST":
+        return resize_nearest(input, out_shape, align_corners, name=name)
+    raise ValueError(
+        f"image_resize: unsupported resample method {resample!r} "
+        "(BILINEAR or NEAREST)"
+    )
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    return _x_op("pixel_shuffle", x, {"upscale_factor": upscale_factor}, name)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _x_op("space_to_depth", x, {"blocksize": blocksize}, name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _x_op("shuffle_channel", x, {"group": group}, name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _x_op(
+        "temporal_shift", x,
+        {"seg_num": seg_num, "shift_ratio": shift_ratio}, name,
+    )
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _x_op(
+        "unfold", x,
+        {"kernel_sizes": _pair(kernel_sizes), "strides": _pair(strides),
+         "paddings": pads, "dilations": _pair(dilations)},
+        name, out_slot="Y",
+    )
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _x_op("add_position_encoding", input,
+                 {"alpha": alpha, "beta": beta}, name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper(
+        "bilinear_tensor_product", param_attr=param_attr,
+        bias_attr=bias_attr, act=act, name=name,
+    )
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[size, int(x.shape[-1]), int(y.shape[-1])], dtype=x.dtype,
+    )
+    ins = {"X": [x.name], "Y": [y.name], "Weight": [w.name]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[size], dtype=x.dtype, is_bias=True
+        )
+        ins["Bias"] = [b.name]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("bilinear_tensor_product", ins, {"Out": [out.name]}, {})
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size, pool_type="max", pool_stride=None,
+           pool_padding=0, global_pooling=False, name=None):
+    ks = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    strides = (
+        ks if pool_stride is None
+        else [pool_stride] * 3 if isinstance(pool_stride, int)
+        else list(pool_stride)
+    )
+    pads = (
+        [pool_padding] * 3 if isinstance(pool_padding, int)
+        else list(pool_padding)
+    )
+    return _x_op(
+        "pool3d", input,
+        {"ksize": ks, "strides": strides, "paddings": pads,
+         "pooling_type": pool_type, "global_pooling": global_pooling},
+        name,
+    )
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper(
+        "conv3d", param_attr=param_attr, bias_attr=bias_attr, act=act,
+        name=name,
+    )
+    ks = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+    c_in = int(input.shape[1])
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[num_filters, c_in // groups] + ks, dtype=input.dtype,
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d",
+        {"Input": [input.name], "Filter": [w.name]},
+        {"Output": [out.name]},
+        {
+            "strides": [stride] * 3 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int) else list(dilation),
+            "groups": groups,
+        },
+    )
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_filters], dtype=input.dtype,
+            is_bias=True,
+        )
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+    ps = [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size)
+    return _x_op(
+        "adaptive_pool2d", input,
+        {"pooled_height": ps[0], "pooled_width": ps[1],
+         "pooling_type": pool_type},
+        name,
+    )

@@ -1,0 +1,458 @@
+"""Second tranche of dense op lowerings: activations, tensor utilities,
+losses, vision ops (reference: paddle/fluid/operators/ — one *_op.cc per
+row; here one jnp/lax lowering each, gradients synthesized via vjp).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+from paddle_tpu.utils.enforce import EnforceError
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _lower(ins, attrs, _fn=fn):
+        return {"Out": [_fn(first(ins, "X"), attrs)]}
+
+
+# -- activations (reference: paddle/fluid/operators/activation_op.cc) ----
+_unary("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+    x > 0, x,
+    # exp only sees non-positive values: the unselected branch must stay
+    # finite or where's vjp produces 0*inf = NaN cotangents
+    a.get("alpha", 1.6732632423543772) * (jnp.exp(jnp.minimum(x, 0.0)) - 1)))
+_unary("brelu", lambda x, a: jnp.clip(
+    x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_unary("soft_relu", lambda x, a: jnp.log(
+    1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_unary("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+    a.get("scale_a", 0.67) * x))
+
+
+@register_op("maxout")
+def _maxout(ins, attrs):
+    """reference: paddle/fluid/operators/maxout_op.cc. NCHW: channel groups
+    reduced by max."""
+    x = first(ins, "X")
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // groups, groups, h, w).max(axis=2)]}
+
+
+# -- tensor utilities ----------------------------------------------------
+@register_op("argsort", nondiff_inputs=("X",))
+def _argsort(ins, attrs):
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+
+
+@register_op("diag")
+def _diag(ins, attrs):
+    return {"Out": [jnp.diag(first(ins, "Diagonal"))]}
+
+
+
+
+
+
+@register_op("reverse")
+def _reverse(ins, attrs):
+    x = first(ins, "X")
+    out = x
+    for ax in attrs.get("axis", [0]):
+        out = jnp.flip(out, axis=ax)
+    return {"Out": [out]}
+
+
+
+
+
+
+@register_op("shard_index", nondiff_inputs=("X",))
+def _shard_index(ins, attrs):
+    """reference: paddle/fluid/operators/shard_index_op.cc."""
+    x = first(ins, "X")
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (x // size) == shard_id
+    return {"Out": [jnp.where(in_shard, x % size, ignore)]}
+
+
+@register_op("rank", nondiff_inputs=("Input",))
+def _rank(ins, attrs):
+    return {"Out": [jnp.asarray(first(ins, "Input").ndim, jnp.int32)]}
+
+
+@register_op("size", nondiff_inputs=("Input",))
+def _size(ins, attrs):
+    return {"Out": [jnp.asarray(first(ins, "Input").size, jnp.int64)]}
+
+
+@register_op("multiplex", nondiff_inputs=("Ids",))
+def _multiplex(ins, attrs):
+    """reference: paddle/fluid/operators/multiplex_op.cc — per-row pick one
+    of the candidate tensors."""
+    ids = first(ins, "Ids").astype(jnp.int32).reshape(-1)
+    xs = jnp.stack(ins["X"])  # [K, B, ...]
+    return {"Out": [xs[ids, jnp.arange(ids.shape[0])]]}
+
+
+@register_op("crop_tensor")
+def _crop_tensor(ins, attrs):
+    x = first(ins, "X")
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    sl = tuple(
+        slice(o, o + s) for o, s in zip(offsets, shape)
+    )
+    return {"Out": [x[sl]]}
+
+
+# -- losses --------------------------------------------------------------
+@register_op("log_loss", nondiff_inputs=("Labels",))
+def _log_loss(ins, attrs):
+    p = first(ins, "Predicted")
+    y = first(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": [-y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)]}
+
+
+@register_op("rank_loss", nondiff_inputs=("Label",))
+def _rank_loss(ins, attrs):
+    """reference: paddle/fluid/operators/rank_loss_op.cc."""
+    label = first(ins, "Label")
+    left = first(ins, "Left")
+    right = first(ins, "Right")
+    d = left - right
+    # softplus, not log(1+exp): exp overflows fp32 beyond d ~ 88
+    return {"Out": [jax.nn.softplus(d) - label * d]}
+
+
+@register_op("margin_rank_loss", nondiff_inputs=("Label",))
+def _margin_rank_loss(ins, attrs):
+    label = first(ins, "Label")
+    x1 = first(ins, "X1")
+    x2 = first(ins, "X2")
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("dice_loss_op", nondiff_inputs=("Label",))
+def _dice_loss(ins, attrs):
+    """reference: python/paddle/fluid/layers/loss.py dice_loss — integer
+    class labels [N, ..., 1] are one-hot encoded to x's class dim before
+    the intersection/union."""
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    eps = attrs.get("epsilon", 1e-5)
+    if jnp.issubdtype(label.dtype, jnp.integer):
+        idx = label.reshape(label.shape[:-1]).astype(jnp.int32)
+        label = jax.nn.one_hot(idx, x.shape[-1], dtype=x.dtype)
+    else:
+        label = label.astype(x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2 * jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label, axis=reduce_dims)
+    return {"Out": [jnp.mean(1.0 - (inter + eps) / (union + eps))]}
+
+
+@register_op("bpr_loss", nondiff_inputs=("Label",))
+def _bpr_loss(ins, attrs):
+    """reference: paddle/fluid/operators/bpr_loss_op.cc."""
+    x = first(ins, "X")  # [B, C] raw scores
+    label = first(ins, "Label").astype(jnp.int32).reshape(-1)
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = x - pos
+    losses = jax.nn.softplus(diff)  # overflow-stable
+    C = x.shape[1]
+    mask = jnp.arange(C)[None, :] != label[:, None]
+    return {"Out": [
+        (losses * mask).sum(axis=1, keepdims=True) / max(C - 1, 1)
+    ]}
+
+
+@register_op("label_smooth", nondiff_inputs=())
+def _label_smooth(ins, attrs):
+    x = first(ins, "X")
+    eps = attrs.get("epsilon", 0.1)
+    prior = maybe(ins, "PriorDist")
+    k = x.shape[-1]
+    uniform = prior if prior is not None else 1.0 / k
+    return {"Out": [(1 - eps) * x + eps * uniform]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("npair_loss", nondiff_inputs=("labels",))
+def _npair_loss(ins, attrs):
+    """reference: python/paddle/fluid/layers/loss.py npair_loss."""
+    anchor = first(ins, "anchor")
+    positive = first(ins, "positive")
+    labels = first(ins, "labels").reshape(-1)
+    l2_reg = attrs.get("l2_reg", 0.002)
+    B = anchor.shape[0]
+    sim = anchor @ positive.T  # [B, B]
+    tgt = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = tgt / tgt.sum(axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -(tgt * logp).sum(axis=1).mean()
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) / 2
+    return {"Out": [ce + reg]}
+
+
+@register_op("mean_iou", nondiff_inputs=("Predictions", "Labels"))
+def _mean_iou(ins, attrs):
+    pred = first(ins, "Predictions").astype(jnp.int32).reshape(-1)
+    label = first(ins, "Labels").astype(jnp.int32).reshape(-1)
+    n = attrs["num_classes"]
+    inter = jnp.zeros(n).at[pred].add(
+        (pred == label).astype(jnp.float32)
+    )
+    pred_n = jnp.zeros(n).at[pred].add(1.0)
+    label_n = jnp.zeros(n).at[label].add(1.0)
+    union = pred_n + label_n - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.where(present, union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum(), 1)
+    return {"OutMeanIou": [miou], "OutWrong": [pred_n - inter],
+            "OutCorrect": [inter]}
+
+
+# -- vision --------------------------------------------------------------
+def _interp(x, oh, ow, method, align_corners):
+    """align_corners=True matches the fluid-1.7 default sampling grid
+    (corner-aligned); False is jax.image.resize's half-pixel convention."""
+    n, c, h, w = x.shape
+    if not align_corners:
+        return jax.image.resize(x, (n, c, oh, ow), method=method).astype(
+            x.dtype
+        )
+    ys = (
+        jnp.linspace(0, h - 1, oh)
+        if oh > 1 else jnp.zeros((1,))
+    )
+    xs = (
+        jnp.linspace(0, w - 1, ow)
+        if ow > 1 else jnp.zeros((1,))
+    )
+    if method == "nearest":
+        yi = jnp.round(ys).astype(jnp.int32)
+        xi = jnp.round(xs).astype(jnp.int32)
+        return x[:, :, yi][:, :, :, xi]
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yi, xi: x[:, :, yi][:, :, :, xi].astype(jnp.float32)
+    out = (
+        g(y0, x0) * (1 - wy) * (1 - wx)
+        + g(y0, x1) * (1 - wy) * wx
+        + g(y1, x0) * wy * (1 - wx)
+        + g(y1, x1) * wy * wx
+    )
+    return out.astype(x.dtype)
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ins, attrs):
+    x = first(ins, "X")  # NCHW
+    return {"Out": [_interp(
+        x, attrs["out_h"], attrs["out_w"], "nearest",
+        attrs.get("align_corners", True),
+    )]}
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ins, attrs):
+    x = first(ins, "X")
+    return {"Out": [_interp(
+        x, attrs["out_h"], attrs["out_w"], "bilinear",
+        attrs.get("align_corners", True),
+    )]}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ins, attrs):
+    """reference: paddle/fluid/operators/pixel_shuffle_op.cc."""
+    x = first(ins, "X")
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return {"Out": [out.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ins, attrs):
+    x = first(ins, "X")
+    b = attrs["blocksize"]
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // b, b, w // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [out.reshape(n, c * b * b, h // b, w // b)]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ins, attrs):
+    x = first(ins, "X")
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return {"Out": [
+        x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4).reshape(x.shape)
+    ]}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ins, attrs):
+    """reference: paddle/fluid/operators/temporal_shift_op.cc. Input
+    [N*T, C, H, W]; shifts 1/4 channels one step back/forward in time."""
+    x = first(ins, "X")
+    t = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    xr = x.reshape(n, t, c, h, w)
+    back = jnp.concatenate(
+        [xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1
+    )
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1
+    )
+    rest = xr[:, :, c2:]
+    return {"Out": [
+        jnp.concatenate([back, fwd, rest], axis=2).reshape(x.shape)
+    ]}
+
+
+@register_op("unfold")
+def _unfold(ins, attrs):
+    """reference: paddle/fluid/operators/unfold_op.cc (im2col)."""
+    x = first(ins, "X")  # NCHW
+    ks = attrs["kernel_sizes"]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=strides,
+        padding=[(pads[0], pads[2] if len(pads) > 2 else pads[0]),
+                 (pads[1], pads[3] if len(pads) > 2 else pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, oh, ow]
+    n, ckk = patches.shape[0], patches.shape[1]
+    return {"Y": [patches.reshape(n, ckk, -1)]}
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ins, attrs):
+    """reference: paddle/fluid/operators/add_position_encoding_op.cc."""
+    x = first(ins, "X")  # [B, S, D]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, s, d = x.shape
+    pos = jnp.arange(s, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    return {"Out": [alpha * x + beta * enc[None].astype(x.dtype)]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ins, attrs):
+    """reference: paddle/fluid/operators/bilinear_tensor_product_op.cc."""
+    x = first(ins, "X")  # [B, M]
+    y = first(ins, "Y")  # [B, N]
+    w = first(ins, "Weight")  # [O, M, N]
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    bias = maybe(ins, "Bias")
+    if bias is not None:
+        out = out + bias
+    return {"Out": [out]}
+
+
+@register_op("pool3d")
+def _pool3d(ins, attrs):
+    x = first(ins, "X")  # NCDHW
+    ks = attrs["ksize"]
+    strides = attrs.get("strides", ks)
+    ptype = attrs.get("pooling_type", "max")
+    pads = attrs.get("paddings", [0, 0, 0])
+    window = (1, 1) + tuple(ks)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if attrs.get("global_pooling", False):
+        window = (1, 1) + x.shape[2:]
+        stride = window
+        padding = ((0, 0),) * 5
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, stride, padding)
+    else:
+        out = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
+        div = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                stride, padding)
+        out = out / div
+    return {"Out": [out]}
+
+
+@register_op("conv3d")
+def _conv3d(ins, attrs):
+    x = first(ins, "Input")  # NCDHW
+    w = first(ins, "Filter")  # OIDHW
+    strides = attrs.get("strides", [1, 1, 1])
+    pads = attrs.get("paddings", [0, 0, 0])
+    dil = attrs.get("dilations", [1, 1, 1])
+    groups = attrs.get("groups", 1)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("adaptive_pool2d")
+def _adaptive_pool2d(ins, attrs):
+    """Output-size-driven pooling (reference: pool_op.cc adaptive=True).
+    Requires input H/W divisible by the output size (the TPU-friendly
+    static-shape case)."""
+    x = first(ins, "X")
+    oh, ow = attrs["pooled_height"], attrs["pooled_width"]
+    ptype = attrs.get("pooling_type", "avg")
+    n, c, h, w = x.shape
+    if h % oh or w % ow:
+        raise EnforceError(
+            f"adaptive_pool2d needs H({h})%out_h({oh})==0 and "
+            f"W({w})%out_w({ow})==0 on TPU (static shapes)"
+        )
+    xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if ptype == "max":
+        return {"Out": [xr.max(axis=(3, 5))]}
+    return {"Out": [xr.mean(axis=(3, 5))]}

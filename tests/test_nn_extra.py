@@ -1,0 +1,280 @@
+"""Tests for the second-tranche layers (ops/nn_extra.py + layers/nn_extra.py),
+numpy references per op (reference: the matching test_*_op.py files under
+python/paddle/fluid/tests/unittests/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+
+
+def _run(build, feeds, fetch_n=1):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feeds, fetch_list=list(outs[:fetch_n]))
+
+
+def test_activations(rng):
+    x = rng.randn(4, 5).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [4, 5])
+        return [
+            fluid.layers.selu(xv),
+            fluid.layers.brelu(xv, 0.0, 1.0),
+            fluid.layers.soft_relu(xv),
+            fluid.layers.stanh(xv),
+            fluid.layers.sign(xv),
+        ]
+
+    selu_o, brelu_o, softr_o, stanh_o, sign_o = _run(
+        build, {"x": x}, fetch_n=5
+    )
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    np.testing.assert_allclose(
+        selu_o, scale * np.where(x > 0, x, alpha * (np.exp(x) - 1)),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(brelu_o, np.clip(x, 0, 1), rtol=1e-6)
+    np.testing.assert_allclose(softr_o, np.log1p(np.exp(x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        stanh_o, 1.7159 * np.tanh(0.67 * x), rtol=1e-5
+    )
+    np.testing.assert_allclose(sign_o, np.sign(x))
+
+
+def test_maxout_argsort_multiplex(rng):
+    x = rng.randn(2, 6, 3, 3).astype("float32")
+    s = rng.randn(3, 7).astype("float32")
+    a = rng.randn(4, 5).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    ids = np.array([[0], [1], [0], [1]], dtype="int32")
+
+    def build():
+        xv = fluid.data("x", [2, 6, 3, 3])
+        sv = fluid.data("s", [3, 7])
+        av = fluid.data("a", [4, 5])
+        bv = fluid.data("b", [4, 5])
+        iv = fluid.data("ids", [4, 1], dtype="int32")
+        mo = fluid.layers.maxout(xv, groups=2)
+        so, si = fluid.layers.argsort(sv, axis=1, descending=True)
+        mx = fluid.layers.multiplex([av, bv], iv)
+        return [mo, so, mx]
+
+    mo, so, mx = _run(
+        build, {"x": x, "s": s, "a": a, "b": b, "ids": ids}, fetch_n=3
+    )
+    np.testing.assert_allclose(
+        mo, x.reshape(2, 3, 2, 3, 3).max(axis=2), rtol=1e-6
+    )
+    np.testing.assert_allclose(so, -np.sort(-s, axis=1), rtol=1e-6)
+    expect = np.stack([a[0], b[1], a[2], b[3]])
+    np.testing.assert_allclose(mx, expect, rtol=1e-6)
+
+
+def test_losses(rng):
+    p = rng.rand(6, 1).astype("float32") * 0.8 + 0.1
+    y = rng.randint(0, 2, (6, 1)).astype("float32")
+    scores = rng.randn(5, 4).astype("float32")
+    labels = rng.randint(0, 4, (5, 1)).astype("int64")
+
+    def build():
+        pv = fluid.data("p", [6, 1])
+        yv = fluid.data("y", [6, 1])
+        sv = fluid.data("s", [5, 4])
+        lv = fluid.data("l", [5, 1], dtype="int64")
+        ll = fluid.layers.log_loss(pv, yv)
+        bpr = fluid.layers.bpr_loss(sv, lv)
+        sm = fluid.layers.label_smooth(sv, epsilon=0.2)
+        return [ll, bpr, sm]
+
+    ll, bpr, sm = _run(
+        build, {"p": p, "y": y, "s": scores, "l": labels}, fetch_n=3
+    )
+    eps = 1e-4
+    np.testing.assert_allclose(
+        ll, -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps), rtol=1e-4
+    )
+    assert bpr.shape == (5, 1) and np.isfinite(bpr).all()
+    np.testing.assert_allclose(sm, 0.8 * scores + 0.2 / 4, rtol=1e-5)
+
+
+def test_cos_sim_and_npair(rng):
+    a = rng.randn(4, 8).astype("float32")
+    b = rng.randn(4, 8).astype("float32")
+    lab = np.array([0, 0, 1, 1], dtype="int64").reshape(4, 1)
+
+    def build():
+        av = fluid.data("a", [4, 8])
+        bv = fluid.data("b", [4, 8])
+        lv = fluid.data("l", [4, 1], dtype="int64")
+        return [
+            fluid.layers.cos_sim(av, bv),
+            fluid.layers.npair_loss(av, bv, lv),
+        ]
+
+    cs, npl = _run(build, {"a": a, "b": b, "l": lab}, fetch_n=2)
+    expect = (a * b).sum(1) / (
+        np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1)
+    )
+    np.testing.assert_allclose(cs.reshape(-1), expect, rtol=1e-4)
+    assert np.isfinite(npl).all()
+
+
+def test_vision_ops(rng):
+    x = rng.randn(2, 4, 4, 4).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [2, 4, 4, 4])
+        ps = fluid.layers.pixel_shuffle(xv, 2)
+        sd = fluid.layers.space_to_depth(xv, 2)
+        rn = fluid.layers.resize_nearest(xv, [8, 8])
+        rb = fluid.layers.resize_bilinear(xv, [8, 8])
+        ap = fluid.layers.adaptive_pool2d(xv, 2, pool_type="avg")
+        return [ps, sd, rn, rb, ap]
+
+    ps, sd, rn, rb, ap = _run(build, {"x": x}, fetch_n=5)
+    assert ps.shape == (2, 1, 8, 8)
+    assert sd.shape == (2, 16, 2, 2)
+    assert rn.shape == (2, 4, 8, 8) and rb.shape == (2, 4, 8, 8)
+    # nearest: every 2x2 block repeats the source pixel
+    np.testing.assert_allclose(rn[:, :, ::2, ::2], x, rtol=1e-6)
+    np.testing.assert_allclose(
+        ap, x.reshape(2, 4, 2, 2, 2, 2).mean(axis=(3, 5)), rtol=1e-5
+    )
+
+
+def test_temporal_shift_and_unfold(rng):
+    x = rng.randn(4, 8, 3, 3).astype("float32")  # N*T=4 (T=2), C=8
+
+    def build():
+        xv = fluid.data("x", [4, 8, 3, 3])
+        ts = fluid.layers.temporal_shift(xv, seg_num=2, shift_ratio=0.25)
+        uf = fluid.layers.unfold(xv, kernel_sizes=2)
+        return [ts, uf]
+
+    ts, uf = _run(build, {"x": x}, fetch_n=2)
+    assert ts.shape == x.shape
+    xr = x.reshape(2, 2, 8, 3, 3)
+    tsr = ts.reshape(2, 2, 8, 3, 3)
+    # first quarter of channels shifted backward in time
+    np.testing.assert_allclose(tsr[:, 0, :2], xr[:, 1, :2], rtol=1e-6)
+    assert np.allclose(tsr[:, 1, :2], 0)
+    assert uf.shape == (4, 8 * 2 * 2, 4)  # 2x2 patches over 3x3 -> 4 windows
+
+
+def test_conv3d_pool3d_trains(rng):
+    x = rng.randn(2, 3, 4, 6, 6).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [2, 3, 4, 6, 6])
+        c = fluid.layers.conv3d(xv, num_filters=4, filter_size=3, padding=1)
+        p = fluid.layers.pool3d(c, pool_size=2, pool_type="avg",
+                                pool_stride=2)
+        loss = fluid.layers.mean(p)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return [p, loss]
+
+    p, loss = _run(build, {"x": x}, fetch_n=2)
+    assert p.shape == (2, 4, 2, 3, 3)
+    assert np.isfinite(loss).all()
+
+
+def test_misc_tensor_ops(rng):
+    lens = np.array([[3], [7], [12]], dtype="int64")
+
+    def build():
+        lv = fluid.data("l", [3, 1], dtype="int64")
+        sh = fluid.layers.shard_index(lv, index_num=20, nshards=2, shard_id=1)
+        ey = fluid.layers.eye(3, dtype="float32")
+        mi, _, _ = fluid.layers.mean_iou(
+            fluid.layers.cast(lv, "int32") * 0,
+            fluid.layers.cast(lv, "int32") * 0, num_classes=2,
+        )
+        return [sh, ey, mi]
+
+    sh, ey, mi = _run(build, {"l": lens}, fetch_n=3)
+    # shard 1 of 2, shard size 10: 12 -> 2, others ignored
+    np.testing.assert_array_equal(sh.reshape(-1), [-1, -1, 2])
+    np.testing.assert_allclose(ey, np.eye(3))
+    assert 0.9 < mi <= 1.0  # all-equal predictions: IoU 1 for class 0
+
+
+def test_bilinear_tensor_product_and_position_encoding(rng):
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 5).astype("float32")
+    seq = rng.randn(2, 6, 8).astype("float32")
+
+    def build():
+        xv = fluid.data("x", [3, 4])
+        yv = fluid.data("y", [3, 5])
+        sv = fluid.data("s", [2, 6, 8])
+        btp = fluid.layers.bilinear_tensor_product(xv, yv, size=7)
+        pe = fluid.layers.add_position_encoding(sv)
+        return [btp, pe]
+
+    btp, pe = _run(build, {"x": x, "y": y, "s": seq}, fetch_n=2)
+    assert btp.shape == (3, 7)
+    assert pe.shape == seq.shape
+    # position encoding is deterministic: row 0 gets sin(0)=0, cos(0)=1
+    np.testing.assert_allclose(
+        pe[:, 0, 4:] - seq[:, 0, 4:], np.ones((2, 4)), rtol=1e-5
+    )
+
+
+def test_dice_loss_onehot_and_stable_rank_loss(rng):
+    prob = rng.rand(3, 6, 4).astype("float32")
+    prob /= prob.sum(-1, keepdims=True)
+    lab = rng.randint(0, 4, (3, 6, 1)).astype("int64")
+    big = np.array([[200.0]], dtype="float32")
+
+    def build():
+        pv = fluid.data("p", [3, 6, 4])
+        lv = fluid.data("l", [3, 6, 1], dtype="int64")
+        bl = fluid.data("b", [1, 1])
+        zl = fluid.data("z", [1, 1])
+        d = fluid.layers.dice_loss(pv, lv)
+        r = fluid.layers.rank_loss(zl, bl, zl)  # d = 200: must stay finite
+        return [d, r]
+
+    d, r = _run(
+        build,
+        {"p": prob, "l": lab, "b": big, "z": np.zeros((1, 1), "float32")},
+        fetch_n=2,
+    )
+    onehot = np.eye(4)[lab.reshape(3, 6)]
+    inter = 2 * (prob * onehot).sum(axis=(1, 2))
+    union = prob.sum(axis=(1, 2)) + onehot.sum(axis=(1, 2))
+    np.testing.assert_allclose(
+        d, np.mean(1 - (inter + 1e-5) / (union + 1e-5)), rtol=1e-5
+    )
+    assert np.isfinite(r).all() and abs(float(r[0, 0]) - 200.0) < 1e-2
+
+
+def test_resize_align_corners(rng):
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+
+    def build():
+        xv = fluid.data("x", [1, 1, 4, 4])
+        ac = fluid.layers.resize_bilinear(xv, [7, 7], align_corners=True)
+        hp = fluid.layers.resize_bilinear(xv, [7, 7], align_corners=False)
+        return [ac, hp]
+
+    ac, hp = _run(build, {"x": x}, fetch_n=2)
+    # corner-aligned: the four corners reproduce the source corners exactly
+    np.testing.assert_allclose(
+        [ac[0, 0, 0, 0], ac[0, 0, 0, -1], ac[0, 0, -1, 0], ac[0, 0, -1, -1]],
+        [0.0, 3.0, 12.0, 15.0], rtol=1e-5,
+    )
+    assert not np.allclose(ac, hp)  # the two conventions genuinely differ
+    with pytest.raises(ValueError, match="resample"):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            fluid.layers.image_resize(
+                fluid.data("q", [1, 1, 4, 4]), [8, 8], resample="TRILINEAR"
+            )
