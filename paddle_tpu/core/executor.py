@@ -77,7 +77,7 @@ def live_ops(block, fetch_names):
             (v := block._find_var_recursive(n)) is not None and v.persistable
             for n in writes
         )
-        stateful_side_effect = op.type in ("print",)
+        stateful_side_effect = op.type in ("print", "py_func")
         if writes_persistable or stateful_side_effect or (writes & needed):
             keep[i] = True
             needed.update(reads)

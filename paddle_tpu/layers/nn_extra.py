@@ -4,6 +4,7 @@ layers/nn.py + loss.py — one builder per op in ops/nn_extra.py)."""
 from paddle_tpu.layer_helper import LayerHelper
 
 __all__ = [
+    "py_func",
     "selu", "brelu", "soft_relu", "stanh", "sign", "maxout",
     "argsort", "eye", "diag", "expand_as", "strided_slice", "reverse",
     "scatter_nd_add", "pad2d", "shard_index", "rank", "size", "multiplex",
@@ -394,3 +395,46 @@ def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
          "pooling_type": pool_type},
         name,
     )
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """User Python inside the step via host callback (reference:
+    python/paddle/fluid/layers/nn.py py_func). `out` var(s) must be
+    pre-created with concrete shape+dtype (as in the reference);
+    `skip_vars_in_backward_input` lists input vars OMITTED from
+    backward_func's argument list."""
+    from paddle_tpu.ops.py_func import PyFuncToken
+    from paddle_tpu.utils.enforce import enforce
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        enforce(
+            o.shape is not None and all(d >= 0 for d in o.shape),
+            f"py_func output {o.name} needs a concrete shape (got {o.shape})",
+        )
+    skip_idx = []
+    if skip_vars_in_backward_input:
+        skip_names = {
+            v if isinstance(v, str) else v.name
+            for v in (
+                skip_vars_in_backward_input
+                if isinstance(skip_vars_in_backward_input, (list, tuple))
+                else [skip_vars_in_backward_input]
+            )
+        }
+        skip_idx = [i for i, v in enumerate(xs) if v.name in skip_names]
+    token = PyFuncToken(func, backward_func, skip_idx)
+    helper = LayerHelper("py_func", name=name)
+    helper.append_op(
+        "py_func",
+        {"X": [v.name for v in xs]},
+        {"Out": [o.name for o in outs]},
+        {
+            "_pyfunc_token": token,
+            "out_shapes": [list(o.shape) for o in outs],
+            "out_dtypes": [o.dtype for o in outs],
+        },
+    )
+    return out

@@ -18,3 +18,4 @@ from paddle_tpu.ops import sequence  # noqa: F401
 from paddle_tpu.ops import detection  # noqa: F401
 from paddle_tpu.ops import pipeline  # noqa: F401
 from paddle_tpu.ops import nn_extra  # noqa: F401
+from paddle_tpu.ops import py_func  # noqa: F401

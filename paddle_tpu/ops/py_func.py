@@ -1,0 +1,124 @@
+"""py_func: user Python inside the compiled step via host callback.
+
+reference: paddle/fluid/operators/py_func_op.cc + python/paddle/fluid/
+layers/nn.py py_func — arbitrary user Python runs per step with tensor
+inputs/outputs. TPU-native: the callable is invoked through a JAX host
+callback, so the XLA computation stays whole and the host round-trip
+happens only at this op's boundary.
+
+Design notes:
+* The callables live on a token object stored directly in the op's attrs
+  (`_pyfunc_token`), so their lifetime is the program's — no global registry
+  to leak. Programs containing py_func are not serializable (same as the
+  reference: a pickled ProgramDesc cannot carry Python closures).
+* Without a backward_func the op uses `io_callback` — an EFFECTFUL
+  callback XLA must not elide, so side-effect-only uses (logging, metric
+  sinks) run even when nothing downstream consumes the output.
+* With a backward_func the op is differentiable (custom_vjp); integer
+  inputs get float0 cotangents (JAX's contract for non-differentiable
+  primals) and are omitted from backward_func's gradient outputs.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.utils.enforce import EnforceError
+
+
+class PyFuncToken:
+    """Carries the user callables inside op attrs (clone-safe by identity)."""
+
+    def __init__(self, forward, backward=None, skip_input_idx=()):
+        self.forward = forward
+        self.backward = backward
+        self.skip_input_idx = frozenset(skip_input_idx)
+
+    def __deepcopy__(self, memo):
+        return self  # clones share the token; callables are not copyable
+
+
+@register_op("py_func", stateful=True)
+def _py_func(ins, attrs):
+    token = attrs.get("_pyfunc_token")
+    if not isinstance(token, PyFuncToken):
+        raise EnforceError(
+            "py_func op has no callable token — programs containing "
+            "py_func cannot be rebuilt from serialized bytes (Python "
+            "closures do not serialize; same restriction as the reference)"
+        )
+    fwd, bwd = token.forward, token.backward
+    xs = tuple(ins.get("X", []))
+    out_shapes = [tuple(s) for s in attrs["out_shapes"]]
+    out_dtypes = attrs["out_dtypes"]
+    from paddle_tpu.core.dtypes import to_numpy_dtype
+
+    result_spec = tuple(
+        jax.ShapeDtypeStruct(s, to_numpy_dtype(d))
+        for s, d in zip(out_shapes, out_dtypes)
+    )
+
+    def call_fwd(*arrays):
+        out = fwd(*arrays)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    if bwd is None:
+        # io_callback: ordered side effects XLA cannot elide — the op runs
+        # even when its outputs feed nothing (logging/metric sinks)
+        from jax.experimental import io_callback
+
+        outs = io_callback(call_fwd, result_spec, *xs, ordered=True)
+        outs = jax.tree.map(jax.lax.stop_gradient, outs)
+        return {"Out": list(outs)}
+
+    diff_idx = [
+        i for i, x in enumerate(xs)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(call_fwd, result_spec, *xs)
+
+    def run_fwd(*xs):
+        outs = jax.pure_callback(call_fwd, result_spec, *xs)
+        return outs, (xs, outs)
+
+    def run_bwd(res, gs):
+        saved_xs, saved_outs = res
+        bwd_args = [
+            x for i, x in enumerate(saved_xs)
+            if i not in token.skip_input_idx
+        ]
+
+        def call_bwd(*arrays):
+            # backward_func(non-skipped inputs..., outputs..., out_grads...)
+            # -> one gradient per DIFFERENTIABLE input (reference calling
+            # convention, py_func_op.cc + skip_vars_in_backward_input)
+            out = bwd(*arrays)
+            out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            return out
+
+        diff_spec = tuple(
+            jax.ShapeDtypeStruct(saved_xs[i].shape, saved_xs[i].dtype)
+            for i in diff_idx
+        )
+        diff_grads = jax.pure_callback(
+            call_bwd, diff_spec, *bwd_args, *saved_outs, *gs
+        )
+        grads = []
+        it = iter(diff_grads)
+        for i, x in enumerate(saved_xs):
+            if i in diff_idx:
+                grads.append(next(it))
+            else:
+                # integer/bool primals take float0 cotangents
+                grads.append(
+                    np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+                )
+        return tuple(grads)
+
+    run.defvjp(run_fwd, run_bwd)
+    return {"Out": list(run(*xs))}

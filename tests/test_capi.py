@@ -140,3 +140,38 @@ def test_capi_train_from_c_host(tmp_path, capi_lib):
     assert "CAPI_TRAIN_OK" in r.stdout
     # persistables were saved (param + optimizer state files exist)
     assert os.path.isdir(save_dir) and len(os.listdir(save_dir)) >= 2
+
+
+def test_trainer_bridge_warm_start(tmp_path, rng):
+    """Python-level bridge check: save_train_model with executor saves
+    persistables; a new trainer warm-starts from them instead of re-running
+    random init (the reference train API's LoadPersistables flow)."""
+    from paddle_tpu.inference import capi_bridge as bridge
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [-1, 2])
+        y = fluid.data("y", [-1, 1])
+        pred = fluid.layers.fc(x, 1, num_flatten_dims=1,
+                               param_attr=fluid.ParamAttr(name="tw"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    model_dir = os.path.join(str(tmp_path), "warm")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scope.set("tw", np.full((2, 1), 0.25, dtype="float32"))
+        fluid.io.save_train_model(model_dir, main, startup, loss=loss,
+                                  executor=exe)
+
+    tr = bridge.new_trainer(model_dir, use_tpu=False)
+    got = np.asarray(tr.scope.find_var("tw"))
+    np.testing.assert_allclose(got, 0.25)
+    # and it can step
+    feed_x = rng.randn(4, 2).astype("float32")
+    feed_y = rng.randn(4, 1).astype("float32")
+    bridge.trainer_set_input(tr, "x", 0, (4, 2), memoryview(feed_x.tobytes()))
+    bridge.trainer_set_input(tr, "y", 0, (4, 1), memoryview(feed_y.tobytes()))
+    dt, shape, raw = bridge.trainer_run(tr, "")
+    assert np.isfinite(np.frombuffer(raw, "float32")).all()

@@ -278,3 +278,60 @@ def test_resize_align_corners(rng):
             fluid.layers.image_resize(
                 fluid.data("q", [1, 1, 4, 4]), [8, 8], resample="TRILINEAR"
             )
+
+
+def test_py_func_forward_and_backward(rng):
+    """py_func host callback: forward numpy code + custom backward
+    (reference: python/paddle/fluid/tests/unittests/test_py_func_op.py)."""
+    x = rng.randn(3, 4).astype("float32")
+
+    def fwd(a):
+        return np.tanh(a)
+
+    def bwd(a, out, g_out):
+        return (g_out * (1 - out * out)).astype("float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [3, 4])
+        xv.stop_gradient = False
+        ov = main.global_block().create_var(
+            name="pyf_out", shape=[3, 4], dtype="float32"
+        )
+        fluid.layers.py_func(
+            func=fwd, x=xv, out=ov,
+            backward_func=lambda a, o, g: bwd(a, o, g),
+        )
+        loss = fluid.layers.mean(ov)
+        grads = fluid.gradients(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, g = exe.run(main, feed={"x": x}, fetch_list=[ov, grads[0]])
+    np.testing.assert_allclose(got, np.tanh(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        g, (1 - np.tanh(x) ** 2) / 12, rtol=1e-4
+    )
+
+
+def test_py_func_side_effect_only_runs(rng):
+    """A py_func with no consumed output still executes (io_callback is
+    effectful; the executor keeps py_func ops like it keeps print)."""
+    calls = []
+
+    def hook(a):
+        calls.append(float(np.asarray(a).sum()))
+        return np.zeros((1,), dtype="float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [2, 2])
+        dummy = main.global_block().create_var(
+            name="hook_out", shape=[1], dtype="float32"
+        )
+        fluid.layers.py_func(func=hook, x=xv, out=dummy)
+        loss = fluid.layers.mean(xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((2, 2), "float32")
+    exe.run(main, feed={"x": x}, fetch_list=[loss])  # hook out NOT fetched
+    assert calls and abs(calls[0] - 4.0) < 1e-6
