@@ -47,19 +47,31 @@ def make_generic_grad_lowering(base):
         fwd_in_slots = [s for s in attrs["__fwd_inputs__"] if s in ins]
         fwd_out_slots = attrs["__fwd_outputs__"]
         fwd_ins = {s: ins[s] for s in fwd_in_slots}
+        # a slot participates if ANY member is floating; non-float members
+        # (e.g. int32 indices mixed into py_func's X) are frozen per-element
+        # and get zero grads, so the emitted @GRAD list stays aligned with
+        # the forward member list
         diff_slots = [
             s
             for s in fwd_in_slots
-            if s not in base.nondiff_inputs and all(_is_diff(x) for x in fwd_ins[s])
+            if s not in base.nondiff_inputs and any(_is_diff(x) for x in fwd_ins[s])
         ]
         if not diff_slots:
             return {}
+        diff_idx = {
+            s: [i for i, x in enumerate(fwd_ins[s]) if _is_diff(x)]
+            for s in diff_slots
+        }
         frozen = {s: fwd_ins[s] for s in fwd_in_slots if s not in diff_slots}
         clean_attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
 
         def f(diff_part):
             full = dict(frozen)
-            full.update(diff_part)
+            for s, vals in diff_part.items():
+                members = list(fwd_ins[s])
+                for j, i in enumerate(diff_idx[s]):
+                    members[i] = vals[j]
+                full[s] = members
             if "__rng_key__" in ins:
                 full["__rng_key__"] = ins["__rng_key__"]
             outs = base.lower(full, clean_attrs)
@@ -70,7 +82,9 @@ def make_generic_grad_lowering(base):
                     result[s] = list(vals) if isinstance(vals, (list, tuple)) else [vals]
             return result
 
-        primal_in = {s: list(fwd_ins[s]) for s in diff_slots}
+        primal_in = {
+            s: [fwd_ins[s][i] for i in diff_idx[s]] for s in diff_slots
+        }
         primal_out, vjp = jax.vjp(f, primal_in)
         cotangents = {}
         for s, primals in primal_out.items():
@@ -84,7 +98,15 @@ def make_generic_grad_lowering(base):
                     cots.append(jnp.zeros_like(p))
             cotangents[s] = cots
         (gins,) = vjp(cotangents)
-        return {f"{s}@GRAD": gins[s] for s in diff_slots}
+        result = {}
+        for s in diff_slots:
+            idx = set(diff_idx[s])
+            it = iter(gins[s])
+            result[f"{s}@GRAD"] = [
+                next(it) if i in idx else jnp.zeros_like(jnp.asarray(x))
+                for i, x in enumerate(fwd_ins[s])
+            ]
+        return result
 
     return lower
 

@@ -426,6 +426,13 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
         }
         skip_idx = [i for i, v in enumerate(xs) if v.name in skip_names]
     token = PyFuncToken(func, backward_func, skip_idx)
+    if backward_func is None:
+        # no backward_func -> the op is non-differentiable: mark outputs
+        # stop_gradient so append_backward never emits py_func_grad (the
+        # io_callback path cannot be vjp'd; same contract as the reference,
+        # which only appends a grad op when backward_func is given)
+        for o in outs:
+            o.stop_gradient = True
     helper = LayerHelper("py_func", name=name)
     helper.append_op(
         "py_func",

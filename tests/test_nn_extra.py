@@ -313,6 +313,69 @@ def test_py_func_forward_and_backward(rng):
     )
 
 
+def test_py_func_mixed_int_float_inputs_backward(rng):
+    """Integer inputs mixed into X must not kill the float inputs' grads:
+    the generic grad maker freezes non-float members per-element and emits
+    zero grads for them (reference: py_func_op.cc accepts any dtype mix)."""
+    x = rng.randn(3, 4).astype("float32")
+    idx = np.array([1, 0, 1], dtype="int32")
+
+    def fwd(a, i):
+        return (a * i[:, None].astype("float32")).astype("float32")
+
+    def bwd(a, i, out, g_out):
+        # one gradient per DIFFERENTIABLE input (int inputs get float0
+        # cotangents internally and are omitted here)
+        return (g_out * i[:, None].astype("float32")).astype("float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [3, 4])
+        xv.stop_gradient = False
+        iv = fluid.data("i", [3], dtype="int32")
+        ov = main.global_block().create_var(
+            name="pyf_mixed_out", shape=[3, 4], dtype="float32"
+        )
+        fluid.layers.py_func(func=fwd, x=[xv, iv], out=ov,
+                             backward_func=bwd)
+        loss = fluid.layers.mean(ov)
+        grads = fluid.gradients(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, g = exe.run(main, feed={"x": x, "i": idx}, fetch_list=[ov, grads[0]])
+    np.testing.assert_allclose(got, x * idx[:, None], rtol=1e-5)
+    np.testing.assert_allclose(g, np.broadcast_to(idx[:, None], x.shape) / 12,
+                               rtol=1e-4)
+
+
+def test_py_func_no_backward_is_non_differentiable(rng):
+    """Without backward_func the outputs are stop_gradient: a loss built on
+    them must not try to vjp through the io_callback (which would raise
+    'IO callbacks do not support JVP')."""
+    x = rng.randn(2, 3).astype("float32")
+
+    def fwd(a):
+        return (a * 2).astype("float32")
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [2, 3])
+        xv.stop_gradient = False
+        ov = main.global_block().create_var(
+            name="pyf_nb_out", shape=[2, 3], dtype="float32"
+        )
+        fluid.layers.py_func(func=fwd, x=xv, out=ov)
+        assert ov.stop_gradient
+        # mix the non-differentiable branch with a differentiable one
+        loss = fluid.layers.mean(ov) + fluid.layers.mean(xv)
+        grads = fluid.gradients(loss, [xv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got, g = exe.run(main, feed={"x": x}, fetch_list=[loss, grads[0]])
+    np.testing.assert_allclose(got, (x * 2).mean() + x.mean(), rtol=1e-5)
+    np.testing.assert_allclose(g, np.full_like(x, 1 / 6), rtol=1e-5)
+
+
 def test_py_func_side_effect_only_runs(rng):
     """A py_func with no consumed output still executes (io_callback is
     effectful; the executor keeps py_func ops like it keeps print)."""

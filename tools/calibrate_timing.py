@@ -1,0 +1,136 @@
+"""Timing-methodology calibration for the axon TPU backend.
+
+Times a chained jitted matmul with known FLOPs under several sync styles and
+prints implied TFLOP/s for each. If any style implies > peak (394 TF/s on
+v5e), that style under-waits and must not be used by bench.py.
+
+Measured on the axon tunnel (2026-07, TPU v5 lite):
+  A chained+block_until_ready   0.19 ms/step  2857 TF/s  -> UNDER-WAITS (7x peak)
+  B chained+np.asarray(16MB)    3020 ms/step  0.2 TF/s   -> tunnel transfer-bound
+  C independent+block(last)     4.29 ms/step  128 TF/s   -> under-waits too
+  D per-step block              74 ms/step    7.4 TF/s   -> RTT-bound
+  G scalar fetch RTT            ~70 ms
+  E fori_loop x50 + scalar      162.6 TF/s    -> TRUE device throughput
+  F chained dispatch + scalar   161.8 TF/s    -> matches E: the methodology
+Conclusion: dispatch the step loop async, sync ONCE by np.asarray of a
+scalar output (bench.py does exactly this).
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 4096
+STEPS = 20
+FLOPS_PER_STEP = 2 * N * N * N * 4  # 4 matmuls
+
+
+@jax.jit
+def step(x, w):
+    for _ in range(4):
+        x = jnp.tanh(x @ w)
+    return x
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    x = jax.device_put(jax.random.normal(key, (N, N), jnp.bfloat16), dev)
+    w = jax.device_put(jax.random.normal(key, (N, N), jnp.bfloat16), dev)
+
+    # warmup/compile
+    out = step(x, w)
+    jax.block_until_ready(out)
+
+    # style A: chained, block_until_ready on final output
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(STEPS):
+        y = step(y, w)
+    jax.block_until_ready(y)
+    dt = time.perf_counter() - t0
+    print(f"A chained+block_until_ready: {1e3*dt/STEPS:.2f} ms/step "
+          f"{STEPS*FLOPS_PER_STEP/dt/1e12:.1f} TF/s")
+
+    # style B: chained, np.asarray on final output
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(STEPS):
+        y = step(y, w)
+    _ = np.asarray(y)
+    dt = time.perf_counter() - t0
+    print(f"B chained+np.asarray:        {1e3*dt/STEPS:.2f} ms/step "
+          f"{STEPS*FLOPS_PER_STEP/dt/1e12:.1f} TF/s")
+
+    # style C: independent steps (no chaining), block on last
+    t0 = time.perf_counter()
+    outs = None
+    for _ in range(STEPS):
+        outs = step(x, w)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    print(f"C independent+block(last):   {1e3*dt/STEPS:.2f} ms/step "
+          f"{STEPS*FLOPS_PER_STEP/dt/1e12:.1f} TF/s")
+
+    # style D: per-step block (fully sync)
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(STEPS):
+        y = step(y, w)
+        jax.block_until_ready(y)
+    dt = time.perf_counter() - t0
+    print(f"D per-step block:            {1e3*dt/STEPS:.2f} ms/step "
+          f"{STEPS*FLOPS_PER_STEP/dt/1e12:.1f} TF/s")
+
+    # --- second stage: find the TRUE device throughput ------------------
+
+    @jax.jit
+    def scalar_of(z):
+        return jnp.sum(z.astype(jnp.float32))
+
+    # style G: RTT of fetching a trivial scalar (tunnel round-trip)
+    _ = np.asarray(scalar_of(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        _ = np.asarray(scalar_of(x))
+    rtt = (time.perf_counter() - t0) / 5
+    print(f"G scalar fetch RTT:          {1e3*rtt:.1f} ms")
+
+    # style E: K iterations inside ONE jit, scalar fetch -> ground truth
+    INNER = 50
+
+    @jax.jit
+    def many(z, wz):
+        def body(_, y):
+            for _ in range(4):
+                y = jnp.tanh(y @ wz)
+            return y
+        return jnp.sum(jax.lax.fori_loop(0, INNER, body, z)
+                       .astype(jnp.float32))
+
+    _ = np.asarray(many(x, w))  # compile + settle
+    t0 = time.perf_counter()
+    _ = np.asarray(many(x, w))
+    dt = time.perf_counter() - t0
+    fl = FLOPS_PER_STEP * INNER
+    print(f"E fori_loop x{INNER} + scalar fetch: {1e3*dt:.0f} ms total "
+          f"{fl/dt/1e12:.1f} TF/s")
+
+    # style F: executor-style chained dispatch, single final scalar fetch
+    _ = np.asarray(scalar_of(step(x, w)))
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(INNER):
+        y = step(y, w)
+    _ = np.asarray(scalar_of(y))
+    dt = time.perf_counter() - t0
+    print(f"F chained dispatch x{INNER} + final scalar fetch: "
+          f"{1e3*dt/INNER:.2f} ms/step {fl/dt/1e12:.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

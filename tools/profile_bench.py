@@ -57,7 +57,10 @@ def run_variant(name, batch, seq_len, steps=10, use_amp=True,
     for _ in range(2):  # compile + settle
         out = exe.run(main, feed=data, fetch_list=[fetches[0]],
                       return_numpy=False)
-    jax.block_until_ready(out[0])
+    # value-fetch sync: under the axon tunnel block_until_ready returns
+    # before chained device work finishes (see tools/calibrate_timing.py);
+    # fetching the scalar loss is the only trustworthy queue drain
+    np.asarray(out[0])
 
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
@@ -65,7 +68,7 @@ def run_variant(name, batch, seq_len, steps=10, use_amp=True,
     for _ in range(steps):
         out = exe.run(main, feed=data, fetch_list=[fetches[0]],
                       return_numpy=False)
-    jax.block_until_ready(out[0])
+    np.asarray(out[0])  # sync point: forces the whole dispatched chain
     dt = time.perf_counter() - t0
     if trace_dir:
         jax.profiler.stop_trace()
@@ -80,7 +83,8 @@ def run_variant(name, batch, seq_len, steps=10, use_amp=True,
         "mfu_est": round(mfu, 4),
     }
     print(json.dumps(rec), flush=True)
-    fluid.core.scope.global_scope().clear()
+    scope = fluid.core.scope.global_scope()
+    scope.erase(list(scope.var_names()))
     exe.close()
     return rec
 
