@@ -1,0 +1,585 @@
+"""Third tranche of layer builders: RoI/vision, norms, CTR helpers,
+structured-prediction losses.
+
+reference: python/paddle/fluid/layers/nn.py (roi_align, roi_pool,
+grid_sampler, affine_grid, affine_channel, lrn, l2_normalize, data_norm,
+spectral_norm, pad_constant_like, im2sequence, row_conv, resize_trilinear,
+conv3d_transpose, gather_tree), layers/loss.py (nce, warpctc,
+center_loss), layers/nn.py linear_chain_crf/crf_decoding, layers/
+detection.py sigmoid_focal_loss, contrib/layers/nn.py (partial_concat,
+partial_sum, shuffle_batch), fluid.layers continuous_value_model.
+"""
+
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "roi_align", "roi_pool", "grid_sampler", "affine_grid",
+    "affine_channel", "lrn", "l2_normalize", "data_norm", "spectral_norm",
+    "pad_constant_like", "im2sequence", "row_conv", "resize_trilinear",
+    "conv3d_transpose", "gather_tree", "nce", "warpctc", "center_loss",
+    "linear_chain_crf", "crf_decoding", "sigmoid_focal_loss",
+    "partial_concat", "partial_sum", "shuffle_batch",
+    "continuous_value_model", "conv_shift", "unpool", "hinge_loss",
+    "max_pool2d_with_index",
+]
+
+
+def _out(helper, dtype="float32", stop_gradient=False):
+    return helper.create_variable_for_type_inference(
+        dtype, stop_gradient=stop_gradient
+    )
+
+
+def _roi_inputs(input, rois, rois_num, rois_batch_id):
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_batch_id is not None:
+        ins["BatchId"] = [rois_batch_id.name]
+    elif rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    return ins
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              rois_batch_id=None, name=None):
+    """reference: python/paddle/fluid/layers/nn.py roi_align. The LoD on
+    `rois` becomes an explicit per-image count (`rois_num`) or per-RoI batch
+    id (`rois_batch_id`)."""
+    helper = LayerHelper("roi_align", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "roi_align", _roi_inputs(input, rois, rois_num, rois_batch_id),
+        {"Out": [out.name]},
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+    )
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, rois_batch_id=None,
+             name=None):
+    """reference: python/paddle/fluid/layers/nn.py roi_pool."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = _out(helper, input.dtype)
+    argmax = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "roi_pool", _roi_inputs(input, rois, rois_num, rois_batch_id),
+        {"Out": [out.name], "Argmax": [argmax.name]},
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale},
+    )
+    return out
+
+
+def grid_sampler(x, grid, name=None):
+    """reference: python/paddle/fluid/layers/nn.py grid_sampler."""
+    helper = LayerHelper("grid_sampler", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "grid_sampler", {"X": [x.name], "Grid": [grid.name]},
+        {"Output": [out.name]}, {},
+    )
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """reference: python/paddle/fluid/layers/nn.py affine_grid."""
+    helper = LayerHelper("affine_grid", name=name)
+    out = _out(helper, theta.dtype)
+    ins = {"Theta": [theta.name]}
+    attrs = {}
+    if hasattr(out_shape, "name"):
+        ins["OutputShape"] = [out_shape.name]
+    else:
+        attrs["output_shape"] = list(out_shape)
+    helper.append_op("affine_grid", ins, {"Output": [out.name]}, attrs)
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    """reference: python/paddle/fluid/layers/nn.py affine_channel."""
+    helper = LayerHelper("affine_channel", name=name, act=act)
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "affine_channel",
+        {"X": [x.name], "Scale": [scale.name], "Bias": [bias.name]},
+        {"Out": [out.name]}, {"data_layout": data_layout},
+    )
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """reference: python/paddle/fluid/layers/nn.py lrn."""
+    helper = LayerHelper("lrn", name=name)
+    out = _out(helper, input.dtype)
+    mid = _out(helper, "float32", stop_gradient=True)
+    helper.append_op(
+        "lrn", {"X": [input.name]},
+        {"Out": [out.name], "MidOut": [mid.name]},
+        {"n": n, "k": k, "alpha": alpha, "beta": beta},
+    )
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    """reference: python/paddle/fluid/layers/nn.py l2_normalize (norm op)."""
+    helper = LayerHelper("l2_normalize", name=name)
+    out = _out(helper, x.dtype)
+    norm = _out(helper, "float32", stop_gradient=True)
+    helper.append_op(
+        "norm", {"X": [x.name]},
+        {"Out": [out.name], "Norm": [norm.name]},
+        {"axis": 1 if axis is None else axis, "epsilon": epsilon},
+    )
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True):
+    """reference: python/paddle/fluid/layers/nn.py data_norm — batch-stat
+    tables (size/sum/square-sum) normalize without learned scale/shift."""
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("data_norm", name=name, act=act)
+    C = input.shape[1]
+    dtype = "float32"
+
+    def stat(suffix, value):
+        p = helper.create_parameter(
+            ParamAttr(name=None, initializer=ConstantInitializer(value),
+                      trainable=True),
+            shape=[C], dtype=dtype,
+        )
+        return p
+
+    batch_size = stat("batch_size", 1e4)
+    batch_sum = stat("batch_sum", 0.0)
+    batch_square_sum = stat("batch_square_sum", 1e4)
+    out = _out(helper, input.dtype)
+    means = _out(helper, dtype, stop_gradient=True)
+    scales = _out(helper, dtype, stop_gradient=True)
+    helper.append_op(
+        "data_norm",
+        {"X": [input.name], "BatchSize": [batch_size.name],
+         "BatchSum": [batch_sum.name],
+         "BatchSquareSum": [batch_square_sum.name]},
+        {"Y": [out.name], "Means": [means.name], "Scales": [scales.name]},
+        {"epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: python/paddle/fluid/layers/nn.py spectral_norm."""
+    from paddle_tpu.initializer import NormalInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = 1
+    for i, d in enumerate(weight.shape):
+        if i != dim:
+            w *= d
+    u = helper.create_parameter(
+        ParamAttr(initializer=NormalInitializer(0.0, 1.0), trainable=False),
+        shape=[h], dtype="float32",
+    )
+    v = helper.create_parameter(
+        ParamAttr(initializer=NormalInitializer(0.0, 1.0), trainable=False),
+        shape=[w], dtype="float32",
+    )
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = _out(helper, weight.dtype)
+    helper.append_op(
+        "spectral_norm",
+        {"Weight": [weight.name], "U": [u.name], "V": [v.name]},
+        {"Out": [out.name]},
+        {"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference: python/paddle/fluid/layers/nn.py pad_constant_like."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = _out(helper, y.dtype)
+    helper.append_op(
+        "pad_constant_like", {"X": [x.name], "Y": [y.name]},
+        {"Out": [out.name]}, {"pad_value": pad_value},
+    )
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """reference: python/paddle/fluid/layers/nn.py im2sequence."""
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("im2sequence", name=name)
+    out = _out(helper, input.dtype)
+    pads = _pair(padding)
+    if len(pads) == 2:
+        pads = pads + pads
+    helper.append_op(
+        "im2sequence", {"X": [input.name]}, {"Out": [out.name]},
+        {"kernels": _pair(filter_size), "strides": _pair(stride),
+         "paddings": pads},
+    )
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: python/paddle/fluid/layers/nn.py row_conv — lookahead
+    filter [future_context_size + 1, D] over batched [B, T, D] input."""
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    d = input.shape[-1]
+    flt = helper.create_parameter(
+        helper.param_attr, shape=[future_context_size + 1, d],
+        dtype=input.dtype,
+    )
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "row_conv", {"X": [input.name], "Filter": [flt.name]},
+        {"Out": [out.name]}, {},
+    )
+    return helper.append_activation(out)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """reference: python/paddle/fluid/layers/nn.py resize_trilinear."""
+    helper = LayerHelper("trilinear_interp", name=name)
+    out = _out(helper, input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if out_shape is not None:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = (
+            int(out_shape[0]), int(out_shape[1]), int(out_shape[2])
+        )
+    elif scale is not None:
+        attrs["out_d"] = int(input.shape[2] * scale)
+        attrs["out_h"] = int(input.shape[3] * scale)
+        attrs["out_w"] = int(input.shape[4] * scale)
+    helper.append_op(
+        "trilinear_interp", {"X": [input.name]}, {"Out": [out.name]}, attrs
+    )
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference: python/paddle/fluid/layers/nn.py conv3d_transpose."""
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    in_c = input.shape[1]
+    ks = _triple(filter_size)
+    strides = _triple(stride)
+    pads = _triple(padding)
+    flt = helper.create_parameter(
+        helper.param_attr,
+        shape=[in_c, num_filters // groups] + ks,
+        dtype=input.dtype,
+    )
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "conv3d_transpose",
+        {"Input": [input.name], "Filter": [flt.name]},
+        {"Output": [out.name]},
+        {"strides": strides, "paddings": pads, "groups": groups},
+    )
+    if helper.bias_attr is not False:
+        out = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(out)
+
+
+def gather_tree(ids, parents):
+    """reference: python/paddle/fluid/layers/nn.py gather_tree."""
+    helper = LayerHelper("gather_tree")
+    out = _out(helper, ids.dtype)
+    helper.append_op(
+        "gather_tree", {"Ids": [ids.name], "Parents": [parents.name]},
+        {"Out": [out.name]}, {},
+    )
+    return out
+
+
+_SAMPLER_ENUM = {"uniform": 0, "log_uniform": 1}
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference: python/paddle/fluid/layers/loss.py:633 nce. `custom_dist`
+    sampling and `is_sparse` SelectedRows grads have no TPU analog (dense
+    grads are the design); uniform and log_uniform samplers are native."""
+    from paddle_tpu.utils.enforce import enforce
+
+    enforce(sampler in _SAMPLER_ENUM,
+            f"nce sampler must be uniform/log_uniform, got {sampler}")
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[1]
+    num_neg = num_neg_samples or 10
+    w = helper.create_parameter(
+        helper.param_attr, shape=[num_total_classes, dim],
+        dtype=input.dtype,
+    )
+    ins = {"Input": [input.name], "Label": [label.name],
+           "Weight": [w.name]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_total_classes], dtype=input.dtype,
+            is_bias=True,
+        )
+        ins["Bias"] = [b.name]
+    if sample_weight is not None:
+        ins["SampleWeight"] = [sample_weight.name]
+    cost = _out(helper, input.dtype)
+    slogits = _out(helper, input.dtype, stop_gradient=True)
+    slabels = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "nce", ins,
+        {"Cost": [cost.name], "SampleLogits": [slogits.name],
+         "SampleLabels": [slabels.name]},
+        {"num_total_classes": num_total_classes,
+         "num_neg_samples": num_neg, "seed": seed,
+         "sampler": _SAMPLER_ENUM[sampler]},
+    )
+    return cost
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """reference: python/paddle/fluid/layers/loss.py:489 warpctc. Padded
+    form only (the LoD form has no TPU analog): `input` is
+    [max_logit_length, B, V] time-major exactly as the reference's padded
+    mode; `label` is [B, max_label_length]."""
+    from paddle_tpu.layers.tensor import transpose
+
+    helper = LayerHelper("warpctc")
+    logits_btv = transpose(input, [1, 0, 2])
+    ins = {"Logits": [logits_btv.name], "Label": [label.name]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length.name]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length.name]
+    loss = _out(helper, "float32")
+    grad = _out(helper, "float32", stop_gradient=True)
+    helper.append_op(
+        "warpctc", ins,
+        {"Loss": [loss.name], "WarpCTCGrad": [grad.name]},
+        {"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """reference: python/paddle/fluid/layers/loss.py center_loss — the
+    centers table updates through CentersOut scope write-back (like
+    batch_norm's running stats)."""
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("center_loss", param_attr=param_attr)
+    dim = input.shape[1]
+    centers = helper.create_parameter(
+        helper.param_attr if param_attr is not None else ParamAttr(
+            initializer=ConstantInitializer(0.0), trainable=False
+        ),
+        shape=[num_classes, dim], dtype=input.dtype,
+    )
+    centers.stop_gradient = True
+    from paddle_tpu.layers.tensor import fill_constant
+
+    lr = fill_constant([1], "float32", float(alpha))
+    loss = _out(helper, input.dtype)
+    diff = _out(helper, input.dtype, stop_gradient=True)
+    helper.append_op(
+        "center_loss",
+        {"X": [input.name], "Label": [label.name],
+         "Centers": [centers.name], "CenterUpdateRate": [lr.name]},
+        {"Loss": [loss.name], "SampleCenterDiff": [diff.name],
+         "CentersOut": [centers.name]},
+        {"need_update": update_center},
+    )
+    return loss
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: python/paddle/fluid/layers/nn.py:552 linear_chain_crf —
+    emits the per-sequence negative log-likelihood; transition param is
+    [size + 2, size] (start row, stop row, pairwise)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[size + 2, size], dtype=input.dtype,
+    )
+    ins = {"Emission": [input.name], "Transition": [transition.name],
+           "Label": [label.name]}
+    if length is not None:
+        ins["Length"] = [length.name]
+    ll = _out(helper, "float32")
+    alpha = _out(helper, "float32", stop_gradient=True)
+    eexp = _out(helper, "float32", stop_gradient=True)
+    texp = _out(helper, "float32", stop_gradient=True)
+    helper.append_op(
+        "linear_chain_crf", ins,
+        {"LogLikelihood": [ll.name], "Alpha": [alpha.name],
+         "EmissionExps": [eexp.name], "TransitionExps": [texp.name]},
+        {},
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """reference: python/paddle/fluid/layers/nn.py crf_decoding."""
+    from paddle_tpu.core.ir import default_main_program
+
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    # reuse the transition parameter created by linear_chain_crf via name
+    name = param_attr.name if param_attr is not None else None
+    block = default_main_program().global_block()
+    from paddle_tpu.utils.enforce import enforce
+
+    enforce(name is not None and block._find_var_recursive(name) is not None,
+            "crf_decoding needs param_attr naming the trained transition "
+            "parameter (create it via linear_chain_crf first)")
+    ins = {"Emission": [input.name], "Transition": [name]}
+    if label is not None:
+        ins["Label"] = [label.name]
+    if length is not None:
+        ins["Length"] = [length.name]
+    path = _out(helper, "int64", stop_gradient=True)
+    helper.append_op("crf_decoding", ins, {"ViterbiPath": [path.name]}, {})
+    return path
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """reference: python/paddle/fluid/layers/detection.py
+    sigmoid_focal_loss."""
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "sigmoid_focal_loss",
+        {"X": [x.name], "Label": [label.name], "FgNum": [fg_num.name]},
+        {"Out": [out.name]}, {"gamma": gamma, "alpha": alpha},
+    )
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """reference: python/paddle/fluid/contrib/layers/nn.py partial_concat."""
+    helper = LayerHelper("partial_concat")
+    out = _out(helper, input[0].dtype)
+    helper.append_op(
+        "partial_concat", {"X": [v.name for v in input]},
+        {"Out": [out.name]},
+        {"start_index": start_index, "length": length},
+    )
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """reference: python/paddle/fluid/contrib/layers/nn.py partial_sum."""
+    helper = LayerHelper("partial_sum")
+    out = _out(helper, input[0].dtype)
+    helper.append_op(
+        "partial_sum", {"X": [v.name for v in input]},
+        {"Out": [out.name]},
+        {"start_index": start_index, "length": length},
+    )
+    return out
+
+
+def shuffle_batch(x, seed=None):
+    """reference: python/paddle/fluid/contrib/layers/nn.py shuffle_batch."""
+    helper = LayerHelper("shuffle_batch")
+    out = _out(helper, x.dtype)
+    idx = _out(helper, "int64", stop_gradient=True)
+    seed_out = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "shuffle_batch", {"X": [x.name]},
+        {"Out": [out.name], "ShuffleIdx": [idx.name],
+         "SeedOut": [seed_out.name]},
+        {"seed": seed or 0},
+    )
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: python/paddle/fluid/layers/nn.py continuous_value_model."""
+    helper = LayerHelper("cvm")
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "cvm", {"X": [input.name], "CVM": [cvm.name]},
+        {"Y": [out.name]}, {"use_cvm": use_cvm},
+    )
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """reference: python/paddle/fluid/layers/nn.py conv_shift (circular
+    correlation)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "conv_shift", {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]}, {}
+    )
+    return out
+
+
+def unpool(x, indices, unpooled_height, unpooled_width, name=None):
+    """Max-unpool from recorded pool indices (reference:
+    paddle/fluid/operators/unpool_op.cc)."""
+    helper = LayerHelper("unpool", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "unpool", {"X": [x.name], "Indices": [indices.name]},
+        {"Out": [out.name]},
+        {"unpooled_height": unpooled_height,
+         "unpooled_width": unpooled_width},
+    )
+    return out
+
+
+def hinge_loss(logits, labels, name=None):
+    """reference: paddle/fluid/operators/hinge_loss_op.cc."""
+    helper = LayerHelper("hinge_loss", name=name)
+    out = _out(helper, logits.dtype)
+    helper.append_op(
+        "hinge_loss", {"Logits": [logits.name], "Labels": [labels.name]},
+        {"Loss": [out.name]}, {},
+    )
+    return out
+
+
+def max_pool2d_with_index(x, pool_size, pool_stride=None, pool_padding=0,
+                          name=None):
+    """Pooling that also emits argmax indices (reference:
+    paddle/fluid/operators/pool_with_index_op.cc; pairs with `unpool`)."""
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("max_pool2d_with_index", name=name)
+    out = _out(helper, x.dtype)
+    mask = _out(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        "max_pool2d_with_index", {"X": [x.name]},
+        {"Out": [out.name], "Mask": [mask.name]},
+        {"ksize": _pair(pool_size),
+         "strides": _pair(pool_stride or pool_size),
+         "paddings": _pair(pool_padding)},
+    )
+    return out, mask

@@ -1,0 +1,293 @@
+"""Misc dense ops: data/spectral/l2 norms, CTR helpers, partial ops, row
+convolutions, sampled-softmax losses.
+
+reference: paddle/fluid/operators/{data_norm_op.cc, spectral_norm_op.cc,
+norm_op.cc, selu_op.cc, l1_norm_op.cc, pad_constant_like_op.cc,
+partial_concat_op.cc, partial_sum_op.cc, cvm_op.h, row_conv_op.cc,
+conv_shift_op.cc, hinge_loss_op.cc, center_loss_op.cc, nce_op.h,
+detection/sigmoid_focal_loss_op.cu}. Each is re-expressed as a vectorized
+jnp/lax computation; stateful sampling uses the executor-threaded rng key.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+
+@register_op("data_norm", nondiff_inputs=("BatchSize", "BatchSum", "BatchSquareSum"))
+def _data_norm(ins, attrs):
+    """reference: paddle/fluid/operators/data_norm_op.cc:208 —
+    means = batch_sum / batch_size, scales = sqrt(batch_size /
+    batch_square_sum), y = (x - mean) * scale. Stat-table updates live in
+    the optimizer in the reference (grad outputs d_batch_*); here the
+    updated tables ride as data outputs for the caller to persist."""
+    x = first(ins, "X")
+    bsize = first(ins, "BatchSize").astype(jnp.float32)
+    bsum = first(ins, "BatchSum").astype(jnp.float32)
+    bsq = first(ins, "BatchSquareSum").astype(jnp.float32)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x.astype(jnp.float32) - means[None, :]) * scales[None, :]
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Means": [means],
+        "Scales": [scales],
+    }
+
+
+@register_op("spectral_norm", nondiff_inputs=("U", "V"))
+def _spectral_norm(ins, attrs):
+    """reference: paddle/fluid/operators/spectral_norm_op.cc — weight /
+    sigma_max via `power_iters` rounds of power iteration from U, V."""
+    w = first(ins, "Weight")
+    u = first(ins, "U").reshape(-1)
+    v = first(ins, "V").reshape(-1)
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # [h, wd]
+
+    def normalize(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    def body(_, carry):
+        u_, v_ = carry
+        v_ = normalize(wm.T @ u_)
+        u_ = normalize(wm @ v_)
+        return u_, v_
+
+    u, v = jax.lax.fori_loop(0, max(power_iters, 1), body, (u, v))
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (wm @ v)
+    return {"Out": [w / sigma]}
+
+
+@register_op("norm")
+def _norm(ins, attrs):
+    """reference: paddle/fluid/operators/norm_op.cc — l2-normalize along
+    `axis`, emitting the norm as a saved output."""
+    x = first(ins, "X")
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    sq = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    norm = jnp.sqrt(sq + eps)
+    return {"Out": [(x / norm).astype(x.dtype)], "Norm": [norm]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ins, attrs):
+    """reference: paddle/fluid/operators/l1_norm_op.cc."""
+    x = first(ins, "X")
+    return {"Out": [jnp.sum(jnp.abs(x))]}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ins, attrs):
+    """reference: paddle/fluid/operators/pad_constant_like_op.cc — pad Y up
+    to X's (larger) shape with pad_value; X only supplies the target shape."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    val = attrs.get("pad_value", 0.0)
+    pads = [(0, x.shape[i] - y.shape[i]) for i in range(y.ndim)]
+    return {"Out": [jnp.pad(y, pads, constant_values=val)]}
+
+
+def _partial_slices(ins, attrs):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    xs = ins["X"]
+    cols = xs[0].shape[1]
+    s = start % cols if start < 0 else start
+    ln = cols - s if length < 0 else length
+    return [x[:, s:s + ln] for x in xs]
+
+
+@register_op("partial_concat")
+def _partial_concat(ins, attrs):
+    """reference: paddle/fluid/operators/partial_concat_op.cc."""
+    return {"Out": [jnp.concatenate(_partial_slices(ins, attrs), axis=1)]}
+
+
+@register_op("partial_sum")
+def _partial_sum(ins, attrs):
+    """reference: paddle/fluid/operators/partial_sum_op.cc."""
+    parts = _partial_slices(ins, attrs)
+    out = parts[0]
+    for p in parts[1:]:
+        out = out + p
+    return {"Out": [out]}
+
+
+@register_op("cvm", nondiff_inputs=("CVM",))
+def _cvm(ins, attrs):
+    """reference: paddle/fluid/operators/cvm_op.h CvmComputeKernel —
+    use_cvm keeps the width and log-transforms the (show, click) columns;
+    otherwise the two CVM columns are dropped. Show/click get no gradient
+    (the reference grad kernel re-injects the raw CVM input)."""
+    x = first(ins, "X")
+    use_cvm = attrs.get("use_cvm", True)
+    if not use_cvm:
+        return {"Y": [x[:, 2:]]}
+    head = jax.lax.stop_gradient(x[:, :2])
+    c0 = jnp.log1p(head[:, 0:1])
+    c1 = jnp.log1p(head[:, 1:2]) - c0
+    return {"Y": [jnp.concatenate([c0, c1, x[:, 2:]], axis=1)]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ins, attrs):
+    """reference: paddle/fluid/operators/hinge_loss_op.cc —
+    max(0, 1 - (2*label - 1) * logits), labels in {0, 1}."""
+    logits = first(ins, "Logits")
+    labels = first(ins, "Labels").astype(logits.dtype)
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)]}
+
+
+@register_op("sigmoid_focal_loss", nondiff_inputs=("Label", "FgNum"))
+def _sigmoid_focal_loss(ins, attrs):
+    """reference: paddle/fluid/operators/detection/sigmoid_focal_loss_op.cu —
+    per-(sample, class) focal loss; Label is 1-based (0 = background) and
+    FgNum normalizes."""
+    x = first(ins, "X")  # [N, C] logits
+    label = first(ins, "Label").reshape(-1)  # [N], 0 = background
+    fg = jnp.maximum(first(ins, "FgNum").astype(jnp.float32).reshape(()), 1.0)
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    C = x.shape[1]
+    xf = x.astype(jnp.float32)
+    # target[n, c] = 1 iff label[n] == c + 1
+    tgt = (label[:, None] == (jnp.arange(C)[None, :] + 1)).astype(jnp.float32)
+    p = jax.nn.sigmoid(xf)
+    ce_pos = -jax.nn.log_sigmoid(xf)          # -log(p)
+    ce_neg = -jax.nn.log_sigmoid(-xf)         # -log(1-p)
+    loss = tgt * alpha * jnp.power(1.0 - p, gamma) * ce_pos + \
+        (1.0 - tgt) * (1.0 - alpha) * jnp.power(p, gamma) * ce_neg
+    return {"Out": [(loss / fg).astype(x.dtype)]}
+
+
+@register_op("center_loss", nondiff_inputs=("Label", "Centers", "CenterUpdateRate"))
+def _center_loss(ins, attrs):
+    """reference: paddle/fluid/operators/center_loss_op.h — per-sample
+    0.5*||x - c_label||^2 plus the class-count-normalized center update,
+    emitted as the CentersOut data output (functional state threading)."""
+    x = first(ins, "X").astype(jnp.float32)
+    label = first(ins, "Label").reshape(-1).astype(jnp.int32)
+    centers = first(ins, "Centers").astype(jnp.float32)
+    lr = first(ins, "CenterUpdateRate").astype(jnp.float32).reshape(())
+    diff = x - centers[label]  # [N, D]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],), jnp.float32).at[label].add(1.0)
+        sums = jnp.zeros_like(centers).at[label].add(
+            jax.lax.stop_gradient(diff)
+        )
+        centers_out = centers + lr * sums / (1.0 + counts)[:, None]
+    else:
+        centers_out = centers
+    return {
+        "Loss": [loss],
+        "SampleCenterDiff": [diff],
+        "CentersOut": [centers_out],
+    }
+
+
+@register_op("row_conv")
+def _row_conv(ins, attrs):
+    """reference: paddle/fluid/operators/row_conv_op.cc — lookahead
+    convolution over time: y[t] = sum_j w[j] * x[t + j]. Batched form
+    X [B, T, D], Filter [k, D] (the reference's LoD form maps each sequence
+    to a batch row)."""
+    x = first(ins, "X")
+    w = first(ins, "Filter")
+    k = w.shape[0]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for j in range(k):  # k is small & static (lookahead window)
+        out = out + xp[:, j:j + x.shape[1], :] * w[j][None, None, :]
+    return {"Out": [out]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ins, attrs):
+    """reference: paddle/fluid/operators/conv_shift_op.cc — circular
+    correlation: out[b, i] = sum_j x[b, (i + j - m//2) mod n] * y[b, j]."""
+    x = first(ins, "X")  # [B, N]
+    y = first(ins, "Y")  # [B, M]
+    n, m = x.shape[1], y.shape[1]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(m)[None, :]
+    idx = (i + j - m // 2) % n  # [N, M]
+    gathered = x[:, idx]  # [B, N, M]
+    return {"Out": [jnp.einsum("bnm,bm->bn", gathered, y)]}
+
+
+@register_op("nce", stateful=True,
+             nondiff_inputs=("Label", "SampleWeight", "CustomDistProbs",
+                             "CustomDistAlias", "CustomDistAliasProbs"))
+def _nce(ins, attrs):
+    """reference: paddle/fluid/operators/nce_op.h — noise-contrastive
+    estimation with a uniform negative sampler. Per-step negatives come from
+    the executor-threaded rng key; the sampled ids are re-drawn each step
+    exactly like the reference's per-iteration sampler."""
+    from paddle_tpu.ops.common import seeded_rng_key
+
+    x = first(ins, "Input")           # [B, D]
+    label = first(ins, "Label")       # [B, num_true]
+    w = first(ins, "Weight")          # [num_classes, D]
+    b = maybe(ins, "Bias")            # [num_classes]
+    num_total = attrs["num_total_classes"]
+    num_neg = attrs.get("num_neg_samples", 10)
+    sampler = attrs.get("sampler", 0)  # 0 uniform, 1 log_uniform (ref enum)
+    B = x.shape[0]
+    if label.ndim == 1:
+        label = label[:, None]
+    num_true = label.shape[1]
+    key = seeded_rng_key(ins, attrs)
+    if sampler == 1:
+        # log-uniform (Zipfian): P(k) = (log(k+2)-log(k+1)) / log(K+1),
+        # sampled by inverse CDF on a uniform draw
+        u = jax.random.uniform(key, (B, num_neg))
+        neg = jnp.clip(
+            jnp.floor(jnp.exp(u * jnp.log(float(num_total + 1))) - 1.0)
+            .astype(jnp.int32), 0, num_total - 1,
+        )
+
+        def log_q_of(ids):
+            idf = ids.astype(jnp.float32)
+            q = (jnp.log(idf + 2.0) - jnp.log(idf + 1.0)) / jnp.log(
+                float(num_total + 1)
+            )
+            return jnp.log(num_neg * q)
+    else:
+        neg = jax.random.randint(key, (B, num_neg), 0, num_total)
+
+        def log_q_of(ids):
+            return jnp.full(ids.shape,
+                            jnp.log(num_neg / float(num_total)), jnp.float32)
+
+    def logits(ids):
+        wv = w[ids]  # [B, K, D]
+        out = jnp.einsum("bd,bkd->bk", x, wv)
+        if b is not None:
+            out = out + b[ids]
+        return out
+
+    pos_ids = label.astype(jnp.int32)
+    pos_logit = logits(pos_ids) - log_q_of(pos_ids)
+    neg_logit = logits(neg) - log_q_of(neg)
+    pos_cost = -jax.nn.log_sigmoid(pos_logit).sum(axis=1)
+    neg_cost = -jax.nn.log_sigmoid(-neg_logit).sum(axis=1)
+    cost = (pos_cost / num_true + neg_cost)[:, None]
+    sw = maybe(ins, "SampleWeight")
+    if sw is not None:
+        cost = cost * sw.reshape(-1, 1)
+    return {
+        "Cost": [cost],
+        "SampleLogits": [jnp.concatenate([pos_logit, neg_logit], axis=1)],
+        "SampleLabels": [jnp.concatenate(
+            [label.astype(jnp.int64), neg.astype(jnp.int64)], axis=1)],
+    }

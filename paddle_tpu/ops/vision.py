@@ -1,0 +1,462 @@
+"""Vision ops: RoI pooling/alignment, grid sampling, resampling, LRN, pooling
+with indices, patch extraction.
+
+The reference implements these as CUDA kernels with per-thread scalar loops
+(reference: paddle/fluid/operators/roi_align_op.cu, roi_pool_op.cu,
+grid_sampler_op.cu, affine_grid_op.cc, lrn_op.cc, pool_with_index_op.cu,
+unpool_op.cc, interpolate_op.cc, im2sequence_op.cc). TPU-native redesign:
+everything is expressed as fixed-shape vectorized gathers/reductions so XLA
+can tile them — RoIs carry an explicit batch-id tensor instead of LoD, and
+"adaptive" sampling counts become static attrs (data-dependent loop bounds
+don't exist under jit).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# bilinear helpers
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(x, bi, ys, xs):
+    """Sample x [N, C, H, W] at float coords (ys, xs) [R, ...] for batch ids
+    bi [R]; out-of-range samples contribute 0 (reference roi_align
+    semantics: x in [-1, H] clamps, outside that is zero)."""
+    H, W = x.shape[2], x.shape[3]
+    valid = (ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)
+    y = jnp.clip(ys, 0.0, H - 1)
+    xq = jnp.clip(xs, 0.0, W - 1)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(xq).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly = (y - y0).astype(x.dtype)
+    lx = (xq - x0).astype(x.dtype)
+    hy, hx = 1.0 - ly, 1.0 - lx
+    # broadcast batch ids over the sample grid dims
+    bfull = bi.reshape((-1,) + (1,) * (ys.ndim - 1))
+    bfull = jnp.broadcast_to(bfull, ys.shape)
+
+    def corner(yy, xx):
+        # advanced indexing -> gather: [R, ..., C]
+        return x[bfull, :, yy, xx]
+
+    w00 = (hy * hx)[..., None]
+    w01 = (hy * lx)[..., None]
+    w10 = (ly * hx)[..., None]
+    w11 = (ly * lx)[..., None]
+    out = (
+        corner(y0, x0) * w00
+        + corner(y0, x1) * w01
+        + corner(y1, x0) * w10
+        + corner(y1, x1) * w11
+    )
+    return out * valid[..., None].astype(x.dtype)
+
+
+def _roi_batch_ids(ins, num_rois):
+    """Batch id per RoI: explicit BatchId tensor, or derived from per-image
+    counts (RoisNum), else all zeros (single image)."""
+    bid = maybe(ins, "BatchId")
+    if bid is not None:
+        return bid.astype(jnp.int32)
+    rois_num = maybe(ins, "RoisNum")
+    if rois_num is not None:
+        # id[i] = #{j : i >= cumsum(rois_num)[j]} — fixed-shape scan-free
+        bounds = jnp.cumsum(rois_num.astype(jnp.int32))
+        idx = jnp.arange(num_rois, dtype=jnp.int32)
+        return jnp.sum(idx[:, None] >= bounds[None, :], axis=1).astype(jnp.int32)
+    return jnp.zeros((num_rois,), jnp.int32)
+
+
+@register_op("roi_align", nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _roi_align(ins, attrs):
+    """reference: paddle/fluid/operators/roi_align_op.cc. sampling_ratio<=0
+    (adaptive ceil(roi/bin) in the reference) becomes a static 2x2 grid —
+    data-dependent sample counts cannot exist under XLA."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    R = rois.shape[0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    s = attrs.get("sampling_ratio", -1)
+    s = int(s) if s and s > 0 else 2
+    aligned = attrs.get("aligned", False)
+    off = 0.5 if aligned else 0.0
+    bi = _roi_batch_ids(ins, R)
+
+    x1 = rois[:, 0] * scale - off
+    y1 = rois[:, 1] * scale - off
+    x2 = rois[:, 2] * scale - off
+    y2 = rois[:, 3] * scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    # sample coords: ys [R, ph*s], xs [R, pw*s]
+    iy = (jnp.arange(ph * s) + 0.5) / s  # fractional bin positions
+    ix = (jnp.arange(pw * s) + 0.5) / s
+    ys = y1[:, None] + iy[None, :] * bin_h[:, None]  # [R, ph*s]
+    xs = x1[:, None] + ix[None, :] * bin_w[:, None]  # [R, pw*s]
+    yy = jnp.broadcast_to(ys[:, :, None], (R, ph * s, pw * s))
+    xx = jnp.broadcast_to(xs[:, None, :], (R, ph * s, pw * s))
+    sampled = _bilinear_gather(x, bi, yy, xx)  # [R, ph*s, pw*s, C]
+    C = x.shape[1]
+    sampled = sampled.reshape(R, ph, s, pw, s, C).mean(axis=(2, 4))
+    return {"Out": [jnp.transpose(sampled, (0, 3, 1, 2))]}
+
+
+@register_op("roi_pool", nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _roi_pool(ins, attrs):
+    """reference: paddle/fluid/operators/roi_pool_op.cc — exact integer-bin
+    max pooling. Fixed-shape form: each bin gathers at most
+    ceil(H/ph)+1 x ceil(W/pw)+1 integer positions (a static bound on the
+    reference's dynamic bin extents) and masks rows past the bin end."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    R = rois.shape[0]
+    C, H, W = x.shape[1], x.shape[2], x.shape[3]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    bi = _roi_batch_ids(ins, R)
+
+    x1 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+
+    mh = -(-H // ph) + 1  # static per-bin row bound
+    mw = -(-W // pw) + 1
+
+    def bin_edges(start, size, n, i):
+        lo = start + (i * size) // n
+        hi = start + ((i + 1) * size + n - 1) // n  # ceil
+        return lo, hi
+
+    ib = jnp.arange(ph)[None, :]  # [1, ph]
+    h_lo, h_hi = bin_edges(y1[:, None], rh[:, None], ph, ib)  # [R, ph]
+    jb = jnp.arange(pw)[None, :]
+    w_lo, w_hi = bin_edges(x1[:, None], rw[:, None], pw, jb)  # [R, pw]
+    h_lo = jnp.clip(h_lo, 0, H)
+    h_hi = jnp.clip(h_hi, 0, H)
+    w_lo = jnp.clip(w_lo, 0, W)
+    w_hi = jnp.clip(w_hi, 0, W)
+
+    hr = h_lo[:, :, None] + jnp.arange(mh)[None, None, :]  # [R, ph, mh]
+    wr = w_lo[:, :, None] + jnp.arange(mw)[None, None, :]  # [R, pw, mw]
+    hmask = hr < h_hi[:, :, None]
+    wmask = wr < w_hi[:, :, None]
+    hc = jnp.clip(hr, 0, H - 1)
+    wc = jnp.clip(wr, 0, W - 1)
+
+    bfull = bi[:, None, None, None, None]
+    hfull = hc[:, :, :, None, None]  # [R, ph, mh, 1, 1]
+    wfull = wc[:, None, None, :, :]  # [R, 1, 1, pw, mw]
+    b_b = jnp.broadcast_to(bfull, (R, ph, mh, pw, mw))
+    h_b = jnp.broadcast_to(hfull, (R, ph, mh, pw, mw))
+    w_b = jnp.broadcast_to(wfull, (R, ph, mh, pw, mw))
+    vals = x[b_b, :, h_b, w_b]  # [R, ph, mh, pw, mw, C]
+    mask = (hmask[:, :, :, None, None] & wmask[:, None, None, :, :])
+    vals = jnp.where(mask[..., None], vals, _NEG)
+    # vals axes [R, ph, mh, pw, mw, C] -> [R, C, ph, pw, mh*mw]
+    flat = jnp.transpose(vals, (0, 5, 1, 3, 2, 4)).reshape(R, C, ph, pw, mh * mw)
+    mx = flat.max(axis=-1)
+    out = jnp.where(mx <= _NEG / 2, 0.0, mx).astype(x.dtype)
+    # argmax (flat h*W+w index into the input image) for Unpool-style uses
+    amax = flat.argmax(axis=-1)  # [R, C, ph, pw] index into mh*mw
+    hi_idx = amax // mw
+    wi_idx = amax % mw
+    h_sel = jnp.take_along_axis(
+        jnp.broadcast_to(hc[:, None, :, None, :], (R, C, ph, pw, mh)),
+        hi_idx[..., None], axis=-1,
+    )[..., 0]
+    w_sel = jnp.take_along_axis(
+        jnp.broadcast_to(wc[:, None, None, :, :], (R, C, ph, pw, mw)),
+        wi_idx[..., None], axis=-1,
+    )[..., 0]
+    argmax = (h_sel * W + w_sel).astype(jnp.int64)
+    return {"Out": [out], "Argmax": [argmax]}
+
+
+@register_op("grid_sampler", nondiff_inputs=())
+def _grid_sampler(ins, attrs):
+    """reference: paddle/fluid/operators/grid_sampler_op.cc — bilinear
+    sampling of X [N,C,H,W] at Grid [N,Hg,Wg,2] normalized coords."""
+    x = first(ins, "X")
+    grid = first(ins, "Grid")
+    N, C, H, W = x.shape
+    align = attrs.get("align_corners", True)
+    gx = grid[..., 0].astype(jnp.float32)
+    gy = grid[..., 1].astype(jnp.float32)
+    if align:
+        xs = (gx + 1.0) / 2.0 * (W - 1)
+        ys = (gy + 1.0) / 2.0 * (H - 1)
+    else:
+        xs = ((gx + 1.0) * W - 1.0) / 2.0
+        ys = ((gy + 1.0) * H - 1.0) / 2.0
+    Hg, Wg = grid.shape[1], grid.shape[2]
+    out = _bilinear_gather(x, jnp.arange(N, dtype=jnp.int32),
+                           ys.reshape(N, -1), xs.reshape(N, -1))
+    out = out.reshape(N, Hg, Wg, C)
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+@register_op("affine_grid")
+def _affine_grid(ins, attrs):
+    """reference: paddle/fluid/operators/affine_grid_op.cc. Theta [N,2,3] ->
+    Output [N,H,W,2]."""
+    theta = first(ins, "Theta")
+    shape = maybe(ins, "OutputShape")
+    if shape is not None:
+        hs, ws = int(shape[2]), int(shape[3])
+    else:
+        out_shape = attrs["output_shape"]
+        hs, ws = int(out_shape[2]), int(out_shape[3])
+    align = attrs.get("align_corners", True)
+
+    def axis_coords(n):
+        if align:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys = axis_coords(hs)
+    xs = axis_coords(ws)
+    xg, yg = jnp.meshgrid(xs, ys)  # [H, W]
+    base = jnp.stack([xg, yg, jnp.ones_like(xg)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum(
+        "hwk,nck->nhwc", base.astype(theta.dtype), theta
+    )  # [N, H, W, 2]
+    return {"Output": [out]}
+
+
+@register_op("affine_channel")
+def _affine_channel(ins, attrs):
+    """reference: paddle/fluid/operators/affine_channel_op.cc — per-channel
+    x * scale + bias (conv-BN folding target)."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(-1)
+    bias = first(ins, "Bias").reshape(-1)
+    layout = attrs.get("data_layout", "NCHW")
+    shape = (
+        (1, -1) + (1,) * (x.ndim - 2) if layout == "NCHW" else
+        (1,) * (x.ndim - 1) + (-1,)
+    )
+    return {"Out": [x * scale.reshape(shape) + bias.reshape(shape)]}
+
+
+@register_op("lrn")
+def _lrn(ins, attrs):
+    """reference: paddle/fluid/operators/lrn_op.cc — across-channel local
+    response normalization via a channel-axis window sum (reduce_window)."""
+    x = first(ins, "X")
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    sq = jnp.square(x.astype(jnp.float32))
+    lo = (n - 1) // 2
+    hi = n - 1 - lo
+    window_sum = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        (1, n, 1, 1), (1, 1, 1, 1), ((0, 0), (lo, hi), (0, 0), (0, 0)),
+    )
+    mid = jnp.power(k + alpha * window_sum, beta)
+    return {
+        "Out": [(x.astype(jnp.float32) / mid).astype(x.dtype)],
+        "MidOut": [mid],
+    }
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ins, attrs):
+    """reference: paddle/fluid/operators/pool_with_index_op.cc. Patches are
+    extracted with conv_general_dilated_patches (one XLA op), then max +
+    argmax over the window axis; -inf pre-padding keeps padded positions out
+    of the max (plain conv padding would inject zeros)."""
+    x = first(ins, "X")
+    ksize = tuple(attrs.get("ksize", [2, 2]))
+    strides = tuple(attrs.get("strides", ksize))
+    pads = attrs.get("paddings", [0, 0])
+    ph, pw = (pads[0], pads[1]) if len(pads) == 2 else (pads[0], pads[2])
+    N, C, H, W = x.shape
+    xp = jnp.pad(
+        x.astype(jnp.float32),
+        ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+        constant_values=_NEG,
+    )
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, ksize, strides, "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, oh, ow], feature dim ordered (C, kh, kw)
+    oh, ow = patches.shape[2], patches.shape[3]
+    kh, kw = ksize
+    p = patches.reshape(N, C, kh * kw, oh, ow)
+    out = p.max(axis=2).astype(x.dtype)
+    widx = p.argmax(axis=2)  # [N, C, oh, ow] flat window index
+    base_h = jnp.arange(oh)[:, None] * strides[0] - ph
+    base_w = jnp.arange(ow)[None, :] * strides[1] - pw
+    gh = base_h[None, None] + widx // kw
+    gw = base_w[None, None] + widx % kw
+    mask = p.max(axis=2) <= _NEG / 2
+    out = jnp.where(mask, 0.0, out).astype(x.dtype)
+    return {"Out": [out], "Mask": [(gh * W + gw).astype(jnp.int32)]}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def _unpool(ins, attrs):
+    """reference: paddle/fluid/operators/unpool_op.cc — max-unpool: scatter
+    values to the recorded argmax positions of an earlier pool."""
+    x = first(ins, "X")
+    idx = first(ins, "Indices").astype(jnp.int32)
+    N, C, H, W = x.shape
+    oh, ow = attrs["unpooled_height"], attrs["unpooled_width"]
+    flat = jnp.zeros((N, C, oh * ow), x.dtype)
+    vals = x.reshape(N, C, H * W)
+    iflat = idx.reshape(N, C, H * W)
+    out = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        iflat,
+    ].add(vals)
+    return {"Out": [out.reshape(N, C, oh, ow)]}
+
+
+@register_op("trilinear_interp")
+def _trilinear_interp(ins, attrs):
+    """reference: paddle/fluid/operators/interpolate_op.cc (trilinear).
+    X [N,C,D,H,W] resized via separable 1-D linear interpolation per axis."""
+    x = first(ins, "X")
+    out_size = maybe(ins, "OutSize")
+    if out_size is not None:
+        od, oh, ow = (int(v) for v in out_size)
+    else:
+        od = attrs.get("out_d", -1)
+        oh = attrs.get("out_h", -1)
+        ow = attrs.get("out_w", -1)
+    align = attrs.get("align_corners", True)
+    align_mode = attrs.get("align_mode", 1)
+
+    def axis_pos(n_in, n_out):
+        i = jnp.arange(n_out, dtype=jnp.float32)
+        if align:
+            scale = (n_in - 1) / max(n_out - 1, 1)
+            return i * scale
+        scale = n_in / n_out
+        if align_mode == 0:
+            return jnp.clip((i + 0.5) * scale - 0.5, 0.0, n_in - 1)
+        return jnp.clip(i * scale, 0.0, n_in - 1)
+
+    def interp_axis(v, axis, n_out):
+        n_in = v.shape[axis]
+        pos = axis_pos(n_in, n_out)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (pos - lo).astype(v.dtype)
+        vlo = jnp.take(v, lo, axis=axis)
+        vhi = jnp.take(v, hi, axis=axis)
+        shape = [1] * v.ndim
+        shape[axis] = n_out
+        w = w.reshape(shape)
+        return vlo * (1 - w) + vhi * w
+
+    out = interp_axis(x, 2, od)
+    out = interp_axis(out, 3, oh)
+    out = interp_axis(out, 4, ow)
+    return {"Out": [out]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ins, attrs):
+    """reference: paddle/fluid/operators/im2sequence_op.cc. Patches of
+    X [N,C,H,W] flattened to [N*oh*ow, C*kh*kw] (row-major over N, oh, ow) —
+    the LoD the reference attaches becomes the implied (N, oh*ow) grouping."""
+    x = first(ins, "X")
+    kh, kw = attrs["kernels"]
+    strides = tuple(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    if len(pads) == 2:
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+    N, C = x.shape[0], x.shape[1]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), strides,
+        ((pads[0], pads[2]), (pads[1], pads[3])),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, oh, ow]
+    oh, ow = patches.shape[2], patches.shape[3]
+    out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(N * oh * ow, C * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("shuffle_batch", stateful=True)
+def _shuffle_batch(ins, attrs):
+    """reference: paddle/fluid/operators/shuffle_batch_op.cc — random
+    row permutation; the permutation is emitted so it can be undone."""
+    from paddle_tpu.ops.common import seeded_rng_key
+
+    x = first(ins, "X")
+    key = seeded_rng_key(ins, attrs)
+    perm = jax.random.permutation(key, x.shape[0])
+    return {
+        "Out": [x[perm]],
+        "ShuffleIdx": [perm.astype(jnp.int64)],
+        "SeedOut": [jnp.zeros((1,), jnp.int64)],
+    }
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ins, attrs):
+    """Transposed 3-D conv as input-dilated forward conv (reference:
+    paddle/fluid/operators/conv_transpose_op.cc)."""
+    x, w = first(ins, "Input"), first(ins, "Filter")
+    strides = tuple(attrs.get("strides", [1, 1, 1]))
+    groups = attrs.get("groups", 1)
+    pads = attrs.get("paddings", [0, 0, 0])
+    if len(pads) == 3:
+        pads6 = [(p, p) for p in pads]
+    else:
+        pads6 = [(pads[2 * i], pads[2 * i + 1]) for i in range(3)]
+    in_c, oc_per_g, kd, kh, kw = w.shape
+    wf = jnp.flip(w, (2, 3, 4))
+    wf = wf.reshape(groups, in_c // groups, oc_per_g, kd, kh, kw)
+    wf = jnp.swapaxes(wf, 1, 2).reshape(
+        groups * oc_per_g, in_c // groups, kd, kh, kw
+    )
+    ks = (kd, kh, kw)
+    padding = tuple(
+        (ks[i] - 1 - pads6[i][0], ks[i] - 1 - pads6[i][1]) for i in range(3)
+    )
+    out = jax.lax.conv_general_dilated(
+        x, wf,
+        window_strides=(1, 1, 1),
+        padding=padding,
+        lhs_dilation=strides,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ins, attrs):
+    from paddle_tpu.core.registry import OpRegistry
+
+    attrs = dict(attrs)
+    x = first(ins, "Input")
+    attrs["groups"] = x.shape[1]
+    base = OpRegistry.get("conv2d_transpose")
+    return base.lower(ins, attrs)
