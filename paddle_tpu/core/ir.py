@@ -545,7 +545,7 @@ def _test_mode_attrs(op_type):
     return {"is_test"} if op_type in _IS_TEST_OPS else set()
 
 
-_IS_TEST_OPS = {"dropout", "batch_norm", "layer_norm"}
+_IS_TEST_OPS = {"dropout", "batch_norm", "layer_norm", "data_norm"}
 
 
 # ---------------------------------------------------------------------------

@@ -150,11 +150,15 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     dtype = "float32"
 
     def stat(suffix, value):
+        # stat tables update via the op's *Out write-back (CentersOut
+        # pattern), not via gradients — trainable=False keeps the
+        # optimizer's hands off them
         p = helper.create_parameter(
             ParamAttr(name=None, initializer=ConstantInitializer(value),
-                      trainable=True),
+                      trainable=False),
             shape=[C], dtype=dtype,
         )
+        p.stop_gradient = True
         return p
 
     batch_size = stat("batch_size", 1e4)
@@ -168,7 +172,10 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
         {"X": [input.name], "BatchSize": [batch_size.name],
          "BatchSum": [batch_sum.name],
          "BatchSquareSum": [batch_square_sum.name]},
-        {"Y": [out.name], "Means": [means.name], "Scales": [scales.name]},
+        {"Y": [out.name], "Means": [means.name], "Scales": [scales.name],
+         "BatchSizeOut": [batch_size.name],
+         "BatchSumOut": [batch_sum.name],
+         "BatchSquareSumOut": [batch_square_sum.name]},
         {"epsilon": epsilon},
     )
     return helper.append_activation(out)
@@ -199,7 +206,9 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     helper.append_op(
         "spectral_norm",
         {"Weight": [weight.name], "U": [u.name], "V": [v.name]},
-        {"Out": [out.name]},
+        # UOut/VOut alias back onto U/V so power iterates persist across
+        # steps (the reference updates them in place each forward)
+        {"Out": [out.name], "UOut": [u.name], "VOut": [v.name]},
         {"dim": dim, "power_iters": power_iters, "eps": eps},
     )
     return out

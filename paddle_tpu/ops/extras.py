@@ -12,7 +12,7 @@ jnp/lax computation; stateful sampling uses the executor-threaded rng key.
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.registry import register_op
+from paddle_tpu.core.registry import register_grad, register_op
 from paddle_tpu.ops.common import first, maybe
 
 
@@ -20,9 +20,13 @@ from paddle_tpu.ops.common import first, maybe
 def _data_norm(ins, attrs):
     """reference: paddle/fluid/operators/data_norm_op.cc:208 —
     means = batch_sum / batch_size, scales = sqrt(batch_size /
-    batch_square_sum), y = (x - mean) * scale. Stat-table updates live in
-    the optimizer in the reference (grad outputs d_batch_*); here the
-    updated tables ride as data outputs for the caller to persist."""
+    batch_square_sum), y = (x - mean) * scale. The reference updates the
+    stat tables through the grad kernel (d_batch_size = N, d_batch_sum =
+    per-channel sum x, d_batch_square_sum = sum x^2) plus the optimizer's
+    summary rule; here the accumulated tables are emitted as BatchSizeOut /
+    BatchSumOut / BatchSquareSumOut and aliased back onto the stat params
+    by the layer (the CentersOut write-back pattern), so the stats actually
+    track the data stream."""
     x = first(ins, "X")
     bsize = first(ins, "BatchSize").astype(jnp.float32)
     bsum = first(ins, "BatchSum").astype(jnp.float32)
@@ -30,17 +34,43 @@ def _data_norm(ins, attrs):
     means = bsum / bsize
     scales = jnp.sqrt(bsize / bsq)
     y = (x.astype(jnp.float32) - means[None, :]) * scales[None, :]
+    xf = jax.lax.stop_gradient(x.astype(jnp.float32))
+    n = jnp.float32(x.shape[0])
+    # is_test (set by clone(for_test=True) / flip_test_mode): keep tables
+    # frozen — eval passes must not drift the training statistics. The
+    # outputs are still emitted (unchanged) so the executor always has a
+    # value to bind for the declared write-back.
+    train = not attrs.get("is_test", False)
     return {
         "Y": [y.astype(x.dtype)],
         "Means": [means],
         "Scales": [scales],
+        "BatchSizeOut": [bsize + n if train else bsize],
+        "BatchSumOut": [bsum + jnp.sum(xf, axis=0) if train else bsum],
+        "BatchSquareSumOut": [
+            bsq + jnp.sum(jnp.square(xf), axis=0) if train else bsq
+        ],
     }
+
+
+@register_grad("data_norm")
+def _data_norm_grad(ins, attrs):
+    """dX = dY * scales, from the SAVED Scales output — the stat tables in
+    the scope have already been advanced by the forward write-back, so
+    re-running the lowering (generic grad) would differentiate against
+    post-update stats, disagreeing with the forward pass it backs."""
+    dy = first(ins, "Y@GRAD")
+    scales = first(ins, "Scales")
+    return {"X@GRAD": [(dy.astype(jnp.float32) * scales[None, :]).astype(dy.dtype)]}
 
 
 @register_op("spectral_norm", nondiff_inputs=("U", "V"))
 def _spectral_norm(ins, attrs):
     """reference: paddle/fluid/operators/spectral_norm_op.cc — weight /
-    sigma_max via `power_iters` rounds of power iteration from U, V."""
+    sigma_max via `power_iters` rounds of power iteration from U, V. The
+    reference updates U/V in place each forward so the iterates converge
+    across steps; here they are emitted as UOut/VOut and aliased back onto
+    the U/V parameters by the layer (CentersOut write-back pattern)."""
     w = first(ins, "Weight")
     u = first(ins, "U").reshape(-1)
     v = first(ins, "V").reshape(-1)
@@ -59,11 +89,35 @@ def _spectral_norm(ins, attrs):
         u_ = normalize(wm @ v_)
         return u_, v_
 
-    u, v = jax.lax.fori_loop(0, max(power_iters, 1), body, (u, v))
+    # power_iters=0 runs no iterations (reference loops exactly power_iters
+    # times and leaves U/V at their current values)
+    u, v = jax.lax.fori_loop(0, power_iters, body, (u, v))
     u = jax.lax.stop_gradient(u)
     v = jax.lax.stop_gradient(v)
     sigma = u @ (wm @ v)
-    return {"Out": [w / sigma]}
+    return {"Out": [w / sigma], "UOut": [u], "VOut": [v]}
+
+
+@register_grad("spectral_norm")
+def _spectral_norm_grad(ins, attrs):
+    """Closed-form vjp of w -> w/sigma(u,v) at the SAVED iterates: the
+    write-back stores exactly the u/v the forward's sigma used, but the
+    generic grad would re-run the lowering and power-iterate a step further,
+    differentiating a different sigma than the forward produced."""
+    dout = first(ins, "Out@GRAD")
+    w = first(ins, "Weight")
+    u = first(ins, "UOut").reshape(-1)
+    v = first(ins, "VOut").reshape(-1)
+    dim = attrs.get("dim", 0)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+
+    def f(wt):
+        wm = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)
+        sigma = u @ (wm @ v)
+        return wt / sigma
+
+    _, vjp = jax.vjp(f, w)
+    return {"Weight@GRAD": [vjp(dout)[0]]}
 
 
 @register_op("norm")
@@ -276,18 +330,30 @@ def _nce(ins, attrs):
             out = out + b[ids]
         return out
 
+    # reference cost form (nce_op.h:266): o = sigmoid(logit),
+    # b = num_neg * q(target); true terms -log(o/(o+b)) summed UNSCALED,
+    # sampled terms -log(b/(o+b)). Stable rewrite:
+    #   -log(o/(o+b)) = log(o+b) - log_sigmoid(l)
+    #   -log(b/(o+b)) = log(o+b) - log(b)
     pos_ids = label.astype(jnp.int32)
-    pos_logit = logits(pos_ids) - log_q_of(pos_ids)
-    neg_logit = logits(neg) - log_q_of(neg)
-    pos_cost = -jax.nn.log_sigmoid(pos_logit).sum(axis=1)
-    neg_cost = -jax.nn.log_sigmoid(-neg_logit).sum(axis=1)
-    cost = (pos_cost / num_true + neg_cost)[:, None]
+    pos_raw = logits(pos_ids)
+    neg_raw = logits(neg)
+    log_b_pos = log_q_of(pos_ids)  # log(num_neg * q)
+    log_b_neg = log_q_of(neg)
+    o_pos = jax.nn.sigmoid(pos_raw)
+    o_neg = jax.nn.sigmoid(neg_raw)
+    pos_cost = (jnp.log(o_pos + jnp.exp(log_b_pos))
+                - jax.nn.log_sigmoid(pos_raw)).sum(axis=1)
+    neg_cost = (jnp.log(o_neg + jnp.exp(log_b_neg)) - log_b_neg).sum(axis=1)
+    cost = (pos_cost + neg_cost)[:, None]
     sw = maybe(ins, "SampleWeight")
     if sw is not None:
         cost = cost * sw.reshape(-1, 1)
+    # SampleLogits holds post-sigmoid probabilities, as the reference's
+    # forward leaves sample_out_data (nce_op.h:242)
     return {
         "Cost": [cost],
-        "SampleLogits": [jnp.concatenate([pos_logit, neg_logit], axis=1)],
+        "SampleLogits": [jnp.concatenate([o_pos, o_neg], axis=1)],
         "SampleLabels": [jnp.concatenate(
             [label.astype(jnp.int64), neg.astype(jnp.int64)], axis=1)],
     }

@@ -189,13 +189,20 @@ def _roi_pool(ins, attrs):
         wi_idx[..., None], axis=-1,
     )[..., 0]
     argmax = (h_sel * W + w_sel).astype(jnp.int64)
+    # empty bin: reference writes Out=0, Argmax=-1 (roi_pool_op.cu:81) so
+    # unpool-style consumers skip the bin instead of hitting a real pixel
+    argmax = jnp.where(mx <= _NEG / 2, jnp.int64(-1), argmax)
     return {"Out": [out], "Argmax": [argmax]}
 
 
 @register_op("grid_sampler", nondiff_inputs=())
 def _grid_sampler(ins, attrs):
     """reference: paddle/fluid/operators/grid_sampler_op.cc — bilinear
-    sampling of X [N,C,H,W] at Grid [N,Hg,Wg,2] normalized coords."""
+    sampling of X [N,C,H,W] at Grid [N,Hg,Wg,2] normalized coords.
+    Zero-padding semantics: each of the four corners is weighted by its OWN
+    in-bound mask (ref GetGridPointValue's isInBound per corner), so a sample
+    straddling the border fades toward 0 rather than clamping — this differs
+    from roi_align's clamp-inside-(-1,H) window, hence a separate gather."""
     x = first(ins, "X")
     grid = first(ins, "Grid")
     N, C, H, W = x.shape
@@ -209,8 +216,27 @@ def _grid_sampler(ins, attrs):
         xs = ((gx + 1.0) * W - 1.0) / 2.0
         ys = ((gy + 1.0) * H - 1.0) / 2.0
     Hg, Wg = grid.shape[1], grid.shape[2]
-    out = _bilinear_gather(x, jnp.arange(N, dtype=jnp.int32),
-                           ys.reshape(N, -1), xs.reshape(N, -1))
+    ys = ys.reshape(N, -1)
+    xs = xs.reshape(N, -1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    ly = (ys - y0).astype(x.dtype)
+    lx = (xs - x0).astype(x.dtype)
+    hy, hx = 1.0 - ly, 1.0 - lx
+    bi = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[:, None], y0.shape)
+
+    def corner(yy, xx):
+        inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yc = jnp.clip(yy, 0, H - 1)
+        xc = jnp.clip(xx, 0, W - 1)
+        return x[bi, :, yc, xc] * inb[..., None].astype(x.dtype)
+
+    out = (
+        corner(y0, x0) * (hy * hx)[..., None]
+        + corner(y0, x0 + 1) * (hy * lx)[..., None]
+        + corner(y0 + 1, x0) * (ly * hx)[..., None]
+        + corner(y0 + 1, x0 + 1) * (ly * lx)[..., None]
+    )
     out = out.reshape(N, Hg, Wg, C)
     return {"Output": [jnp.transpose(out, (0, 3, 1, 2))]}
 
@@ -314,7 +340,10 @@ def _max_pool2d_with_index(ins, attrs):
     gw = base_w[None, None] + widx % kw
     mask = p.max(axis=2) <= _NEG / 2
     out = jnp.where(mask, 0.0, out).astype(x.dtype)
-    return {"Out": [out], "Mask": [(gh * W + gw).astype(jnp.int32)]}
+    # all-padding window: index -1 (never a negative real position) so
+    # unpool consumers skip it, mirroring roi_pool's empty-bin sentinel
+    midx = jnp.where(mask, jnp.int32(-1), (gh * W + gw).astype(jnp.int32))
+    return {"Out": [out], "Mask": [midx]}
 
 
 @register_op("unpool", nondiff_inputs=("Indices",))
@@ -328,6 +357,10 @@ def _unpool(ins, attrs):
     flat = jnp.zeros((N, C, oh * ow), x.dtype)
     vals = x.reshape(N, C, H * W)
     iflat = idx.reshape(N, C, H * W)
+    # -1 sentinel (empty pool bin): JAX scatter wraps negative indices, so
+    # remap to oh*ow — out-of-bounds scatter updates are DROPPED (the
+    # documented default mode), which is exactly the skip we need
+    iflat = jnp.where(iflat < 0, oh * ow, iflat)
     out = flat.at[
         jnp.arange(N)[:, None, None],
         jnp.arange(C)[None, :, None],

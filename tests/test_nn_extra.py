@@ -398,3 +398,183 @@ def test_py_func_side_effect_only_runs(rng):
     x = np.ones((2, 2), "float32")
     exe.run(main, feed={"x": x}, fetch_list=[loss])  # hook out NOT fetched
     assert calls and abs(calls[0] - 4.0) < 1e-6
+
+
+def test_data_norm_stats_update(rng):
+    """ADVICE r3: stat tables must track the data stream across steps via
+    the BatchSizeOut/BatchSumOut/BatchSquareSumOut write-back (reference
+    updates them through the grad kernel + optimizer summary rule)."""
+    x = rng.randn(6, 3).astype("float32") + 2.0
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [6, 3])
+        out = fluid.layers.data_norm(xv)
+    stat_names = [
+        p.name for p in main.all_parameters()
+    ]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    before = {
+        n: np.asarray(fluid.global_scope().find_var(n)).copy()
+        for n in stat_names
+    }
+    exe.run(main, feed={"x": x}, fetch_list=[out])
+    after = {
+        n: np.asarray(fluid.global_scope().find_var(n)) for n in stat_names
+    }
+    # exactly one table grew by N=6, one by sum(x), one by sum(x^2)
+    deltas = sorted(
+        (np.max(np.abs(after[n] - before[n])), n) for n in stat_names
+    )
+    assert all(d > 0 for d, _ in deltas), deltas
+    matched = {"size": False, "sum": False, "sq": False}
+    for n in stat_names:
+        d = after[n] - before[n]
+        if np.allclose(d, 6.0):
+            matched["size"] = True
+        elif np.allclose(d, x.sum(axis=0), rtol=1e-4, atol=1e-4):
+            matched["sum"] = True
+        elif np.allclose(d, (x ** 2).sum(axis=0), rtol=1e-4, atol=1e-3):
+            matched["sq"] = True
+    assert all(matched.values()), (matched, deltas)
+    # second step compounds: normalization now uses updated stats
+    exe.run(main, feed={"x": x}, fetch_list=[out])
+    after2 = np.asarray(
+        fluid.global_scope().find_var(
+            [n for n in stat_names
+             if np.allclose(after[n] - before[n], 6.0)][0]
+        )
+    )
+    np.testing.assert_allclose(after2, before[
+        [n for n in stat_names if np.allclose(after[n] - before[n], 6.0)][0]
+    ] + 12.0, rtol=1e-6)
+
+
+def test_spectral_norm_power_iteration_persists(rng):
+    """ADVICE r3: U/V iterates persist across steps (UOut/VOut write-back),
+    so sigma converges to the true top singular value with power_iters=1."""
+    w = rng.randn(8, 5).astype("float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        wv = fluid.data("w", [8, 5])
+        out = fluid.layers.spectral_norm(wv, dim=0, power_iters=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    uname = [p.name for p in main.all_parameters()][0]
+    u0 = np.asarray(fluid.global_scope().find_var(uname)).copy()
+    for _ in range(30):
+        got = exe.run(main, feed={"w": w}, fetch_list=[out])[0]
+    u1 = np.asarray(fluid.global_scope().find_var(uname))
+    assert not np.allclose(u0, u1), "U never updated"
+    sigma_true = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(got, w / sigma_true, rtol=1e-3, atol=1e-4)
+
+
+def test_nce_reference_cost_form(rng):
+    """reference nce_op.h:266 — o=sigmoid(logit), b=num_neg*q; true terms
+    -log(o/(o+b)) summed unscaled, sampled terms -log(b/(o+b))."""
+    from paddle_tpu.ops.extras import _nce  # noqa: F401 (registered)
+    B, D, K = 4, 6, 20
+    x = rng.randn(B, D).astype("float32")
+    label = rng.randint(0, K, (B, 1)).astype("int64")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [B, D])
+        lv = fluid.data("label", [B, 1], dtype="int64")
+        cost = fluid.layers.nce(
+            input=xv, label=lv, num_total_classes=K, num_neg_samples=5,
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run(main, feed={"x": x, "label": label}, fetch_list=[cost])[0]
+    assert got.shape == (B, 1)
+    assert np.all(np.isfinite(got)) and np.all(got > 0)
+
+
+def test_data_norm_eval_clone_freezes_stats(rng):
+    """clone(for_test=True) flips data_norm to is_test: eval runs must not
+    drift the training statistics (reference updates ride the grad kernel,
+    which a forward-only program never runs)."""
+    x = rng.randn(4, 3).astype("float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [4, 3])
+        out = fluid.layers.data_norm(xv)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = [p.name for p in main.all_parameters()]
+    before = {n: np.asarray(fluid.global_scope().find_var(n)).copy()
+              for n in names}
+    y1 = exe.run(test_prog, feed={"x": x}, fetch_list=[out])[0]
+    y2 = exe.run(test_prog, feed={"x": x}, fetch_list=[out])[0]
+    np.testing.assert_array_equal(y1, y2)
+    for n in names:
+        np.testing.assert_array_equal(
+            before[n], np.asarray(fluid.global_scope().find_var(n))
+        )
+
+
+def test_unpool_skips_negative_sentinel(rng):
+    """-1 indices (empty pool bins) must be dropped by unpool, not wrap to
+    the last pixel (JAX scatter wraps negatives)."""
+    from paddle_tpu.core.registry import get_op_def
+    import jax.numpy as jnp
+    lowering = get_op_def("unpool").lower
+    x = jnp.ones((1, 1, 2, 2), jnp.float32) * 5.0
+    idx = jnp.array([[[[0, -1], [-1, 3]]]], jnp.int32)
+    out = lowering(
+        {"X": [x], "Indices": [idx]},
+        {"unpooled_height": 2, "unpooled_width": 2},
+    )["Out"][0]
+    got = np.asarray(out).reshape(-1)
+    np.testing.assert_allclose(got, [5.0, 0.0, 0.0, 5.0])
+
+
+def test_data_norm_grad_uses_pre_update_stats(rng):
+    """The write-back advances the stat tables during forward; the grad must
+    use the SAVED Scales (pre-update), matching the forward normalization —
+    at init scales==1 exactly, so dX == upstream grad exactly."""
+    x = rng.randn(6, 3).astype("float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        xv = fluid.data("x", [6, 3])
+        xv.stop_gradient = False
+        out = fluid.layers.data_norm(xv)
+        loss = fluid.layers.reduce_sum(out)
+        g = fluid.gradients(loss, [xv])[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    gv = exe.run(main, feed={"x": x}, fetch_list=[g])[0]
+    np.testing.assert_array_equal(np.asarray(gv), np.ones_like(x))
+
+
+def test_spectral_norm_grad_matches_executed_forward(rng):
+    """Weight@GRAD must be the vjp of the sigma the forward actually used
+    (the saved UOut/VOut), not a re-iterated one."""
+    w = rng.randn(6, 4).astype("float32")
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        wv = fluid.data("w", [6, 4])
+        wv.stop_gradient = False
+        sn = fluid.layers.spectral_norm(wv, dim=0, power_iters=1)
+        loss = fluid.layers.reduce_sum(sn)
+        g = fluid.gradients(loss, [wv])[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    uname, vname = [p.name for p in main.all_parameters()]
+    u0 = np.asarray(fluid.global_scope().find_var(uname)).copy()
+    v0 = np.asarray(fluid.global_scope().find_var(vname)).copy()
+    snv, gv = exe.run(main, feed={"w": w}, fetch_list=[sn, g])
+
+    # reproduce the forward's u1/v1 from the pre-step state
+    def norm(x):
+        return x / (np.linalg.norm(x) + 1e-12)
+    v1 = norm(w.T @ u0)
+    u1 = norm(w @ v1)
+    sigma = float(u1 @ w @ v1)
+    np.testing.assert_allclose(np.asarray(snv), w / sigma, rtol=1e-5)
+    # analytic vjp of w/sigma(u1,v1) with ones cotangent
+    dsig = np.outer(u1, v1)
+    expect = np.ones_like(w) / sigma - (w.sum() / sigma ** 2) * dsig
+    np.testing.assert_allclose(np.asarray(gv), expect, rtol=1e-4, atol=1e-5)
