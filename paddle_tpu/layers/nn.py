@@ -23,6 +23,7 @@ __all__ = [
     "embedding",
     "sparse_embedding",
     "scaled_dot_product_attention",
+    "moe_ffn",
     "dropout",
     "softmax",
     "log_softmax",
@@ -493,13 +494,20 @@ def embedding(
 
 
 def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
-                                 sm_scale=None, name=None):
+                                 sm_scale=None, seq_parallel=None,
+                                 seq_axis="seq", name=None):
     """Fused attention over [B, H, S, D] tensors; `bias` is an optional
     [B, S] additive key bias (padding mask). Lowers to the Pallas flash
     attention kernel on TPU (ops/pallas/flash_attention.py), or an
     XLA-fused reference implementation otherwise. The reference's analog is
     inference-only (paddle/fluid/operators/fused/multihead_matmul_op.cc);
-    this one is differentiable."""
+    this one is differentiable.
+
+    seq_parallel='ring' | 'ulysses' runs attention sequence-sharded over
+    mesh axis `seq_axis` when the program is compiled with
+    CompiledProgram.with_parallel on a mesh carrying that axis (SURVEY
+    §5.7): ring rotates K/V blocks via ppermute, Ulysses head-scatters via
+    all_to_all. Off-mesh the plain path runs — identical math."""
     helper = LayerHelper("scaled_dot_product_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
@@ -508,10 +516,70 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
     attrs = {"causal": causal}
     if sm_scale is not None:
         attrs["sm_scale"] = float(sm_scale)
+    if seq_parallel:
+        attrs["seq_parallel"] = seq_parallel
+        attrs["seq_axis"] = seq_axis
     helper.append_op(
         "scaled_dot_product_attention", inputs, {"Out": [out.name]}, attrs
     )
     return out
+
+
+def moe_ffn(input, num_experts, d_ff=None, expert_axis="expert",
+            capacity_factor=2.0, capacity=0, activation="gelu",
+            param_attr=None, name=None):
+    """Top-2 gated mixture-of-experts FFN (expert parallelism on the IR
+    path — SURVEY §2.7 new first-class work). `input` [..., H] is routed
+    through `num_experts` stacked FFNs; compiled on a mesh whose
+    `expert_axis` has size > 1, experts and tokens shard over that axis
+    with all_to_all dispatch (ops/moe.py); otherwise the routing runs
+    dense. Returns (out, aux_loss) — add aux_loss to the objective for
+    load balancing."""
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
+    H = input.shape[-1]
+    F = d_ff or 4 * H
+    base = helper.param_attr
+
+    def _wattr(suffix):
+        # one ParamAttr per weight: sharing a NAMED attr would resolve all
+        # three weights to the same variable (create_parameter returns the
+        # existing var on a name hit)
+        return ParamAttr(
+            name=f"{base.name}_{suffix}" if base.name else None,
+            initializer=base.initializer,
+            regularizer=base.regularizer,
+            trainable=base.trainable,
+        )
+
+    gate_w = helper.create_parameter(
+        _wattr("gate"), shape=[H, num_experts], dtype="float32",
+    )
+    w1 = helper.create_parameter(_wattr("w1"), shape=[num_experts, H, F],
+                                 dtype="float32")
+    b1 = helper.create_parameter(
+        ParamAttr(initializer=ConstantInitializer(0.0)),
+        shape=[num_experts, F], dtype="float32",
+    )
+    w2 = helper.create_parameter(_wattr("w2"), shape=[num_experts, F, H],
+                                 dtype="float32")
+    b2 = helper.create_parameter(
+        ParamAttr(initializer=ConstantInitializer(0.0)),
+        shape=[num_experts, H], dtype="float32",
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "moe_ffn",
+        {"X": [input.name], "GateW": [gate_w.name], "W1": [w1.name],
+         "B1": [b1.name], "W2": [w2.name], "B2": [b2.name]},
+        {"Out": [out.name], "AuxLoss": [aux.name]},
+        {"expert_axis": expert_axis, "capacity_factor": capacity_factor,
+         "capacity": capacity, "activation": activation},
+    )
+    return out, aux
 
 
 def sparse_embedding(

@@ -21,7 +21,8 @@ from paddle_tpu.param_attr import ParamAttr
 
 class GPTIRConfig:
     def __init__(self, vocab_size=256, hidden_size=64, num_layers=4,
-                 num_heads=4, ffn_mult=4, max_seq_len=64, tp=1):
+                 num_heads=4, ffn_mult=4, max_seq_len=64, tp=1,
+                 use_flash_attention=True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -31,6 +32,11 @@ class GPTIRConfig:
         # tensor-parallel degree is a BUILD-time quantity (Megatron-style):
         # reshape attrs inside the layer body use per-shard head counts
         self.tp = tp
+        # fused scaled_dot_product_attention op (Pallas flash kernel on
+        # TPU): no [1,1,S,S] bias materialization, no S^2 probs buffer.
+        # False falls back to the unfused matmul/softmax path (kept for
+        # parity testing).
+        self.use_flash_attention = use_flash_attention
 
 
 def _causal_bias(seq_len):
@@ -84,7 +90,10 @@ def build_gpt_ir(cfg, seq_len, num_microbatches=1, lr=1e-3):
             param_attr=ParamAttr(name="wpe", initializer=init),
         )
         x = fluid.layers.elementwise_add(emb, pos)
-        bias = _causal_bias(seq_len)
+        flash = getattr(cfg, "use_flash_attention", True)
+        # unfused fallback needs the additive causal mask materialized;
+        # the sdpa op handles causality inside the kernel (no S^2 buffer)
+        bias = None if flash else _causal_bias(seq_len)
 
         stack = fluid.layers.PipelinedStack(
             num_layers=cfg.num_layers,
@@ -142,12 +151,18 @@ def build_gpt_ir(cfg, seq_len, num_microbatches=1, lr=1e-3):
                 return fluid.layers.transpose(t, [0, 2, 1, 3])
 
             qh, kh, vh = heads(q), heads(k), heads(v)
-            scores = fluid.layers.matmul(
-                qh, kh, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
-            )
-            scores = fluid.layers.elementwise_add(scores, bias)
-            probs = fluid.layers.softmax(scores)
-            ctx = fluid.layers.matmul(probs, vh)
+            if flash:
+                ctx = fluid.layers.scaled_dot_product_attention(
+                    qh, kh, vh, causal=True,
+                    sm_scale=1.0 / math.sqrt(d_head),
+                )
+            else:
+                scores = fluid.layers.matmul(
+                    qh, kh, transpose_y=True, alpha=1.0 / math.sqrt(d_head)
+                )
+                scores = fluid.layers.elementwise_add(scores, bias)
+                probs = fluid.layers.softmax(scores)
+                ctx = fluid.layers.matmul(probs, vh)
             ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
             ctx = fluid.layers.reshape(ctx, [0, seq_len, h_local])
             attn = fluid.layers.matmul(ctx, w_ao)  # partial over 'model'

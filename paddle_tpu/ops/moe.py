@@ -1,0 +1,120 @@
+"""Mixture-of-Experts FFN as a first-class IR op.
+
+Expert parallelism on the Program/Executor surface (SURVEY §2.7 names it
+new first-class work the 2020 reference lacks; the closest reference analog
+is distributed sparse lookup, not expert routing). The op computes top-2
+gated expert FFNs over stacked [E, ...] expert weights:
+
+- with an active mesh (CompiledProgram.with_parallel) whose `expert_axis`
+  has size > 1: tokens and experts are sharded over that axis inside a
+  shard_map; tokens travel to their expert's device via one lax.all_to_all
+  each way over ICI (parallel/moe.py moe_ffn_local);
+- otherwise: the same routing math runs dense on one device, so a plain
+  Executor run is the numerical reference for the sharded one.
+
+The load-balance aux loss rides as a second output for the caller to add
+to the objective.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first
+from paddle_tpu.utils.enforce import EnforceError
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def _expert_ffn(act_fn):
+    def fn(params, buf):
+        """params: (w1 [H,F], b1 [F], w2 [F,H], b2 [H]); buf [C, H]."""
+        w1, b1, w2, b2 = params
+        h = act_fn(buf @ w1 + b1)
+        return h @ w2 + b2
+
+    return fn
+
+
+@register_op("moe_ffn")
+def _moe_ffn(ins, attrs):
+    x = first(ins, "X")           # [..., H] (any leading dims = tokens)
+    gate_w = first(ins, "GateW")  # [H, E]
+    w1 = first(ins, "W1")         # [E, H, F]
+    b1 = first(ins, "B1")         # [E, F]
+    w2 = first(ins, "W2")         # [E, F, H]
+    b2 = first(ins, "B2")         # [E, H]
+    axis = attrs.get("expert_axis", "expert")
+    cf = attrs.get("capacity_factor", 2.0)
+    capacity = attrs.get("capacity", 0)
+    act_fn = _ACTS[attrs.get("activation", "gelu")]
+    E = gate_w.shape[1]
+
+    orig_shape = x.shape
+    xt = x.reshape(-1, x.shape[-1])
+    T = xt.shape[0]
+    expert_fn = _expert_ffn(act_fn)
+
+    from paddle_tpu.parallel import env as penv
+
+    mesh = penv.current_mesh()
+    n = 1
+    if mesh is not None and axis in mesh.axis_names:
+        n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    if n > 1 and getattr(jax.typeof(xt), "vma", None):
+        raise EnforceError(
+            "moe_ffn cannot run inside an already-manual region (e.g. a "
+            "pipeline_stack body); place the MoE layer on the outer program"
+        )
+
+    if n > 1:
+        if E % n:
+            raise EnforceError(
+                f"num_experts {E} must divide expert axis '{axis}' size {n}"
+            )
+        if T % n:
+            raise EnforceError(
+                f"expert axis '{axis}' size {n} must divide the token "
+                f"count {T}"
+            )
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.parallel.moe import moe_ffn_local
+
+        # per-source-device capacity, ceil so the TOTAL per-expert buffer
+        # (n * cap_local) is never below the dense path's explicit
+        # capacity — dense vs sharded drop behavior matches when the
+        # capacity is generous
+        cap_local = -(-capacity // n) if capacity else None
+
+        def local(xs, gw, p1, p2, p3, p4):
+            y, aux = moe_ffn_local(
+                xs, gw, (p1, p2, p3, p4), expert_fn, axis,
+                capacity_factor=cf, capacity=cap_local, global_aux=True,
+            )
+            return y, aux
+
+        y, aux = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(axis, None), P()),
+        )(xt, gate_w, w1, b1, w2, b2)
+    else:
+        from paddle_tpu.parallel.moe import top2_gating
+
+        cap = capacity or max(int(cf * T * 2 / E), 4)
+        logits = xt @ gate_w
+        dispatch, combine, aux = top2_gating(logits, cap)
+        buf = jnp.einsum("tec,th->ech", dispatch.astype(xt.dtype), xt)
+        out = jax.vmap(expert_fn)((w1, b1, w2, b2), buf)
+        y = jnp.einsum("tec,ech->th", combine.astype(xt.dtype), out)
+
+    return {
+        "Out": [y.reshape(orig_shape).astype(x.dtype)],
+        "AuxLoss": [aux.astype(jnp.float32)],
+    }

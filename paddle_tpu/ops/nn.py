@@ -17,6 +17,7 @@ from paddle_tpu.ops.common import (
     normalize_padding,
     rng_key,
 )
+from paddle_tpu.utils.enforce import EnforceError
 
 # ---------------------------------------------------------------------------
 # activations
@@ -393,11 +394,64 @@ def _lookup_table_ps(ins, attrs):
     return {"Out": [jnp.take(rows, idx, axis=0)]}
 
 
+def _sdpa_seq_parallel(ins, attrs):
+    """Sequence-parallel route: when the op carries seq_parallel='ring' |
+    'ulysses' and the active mesh (CompiledProgram.with_parallel) has the
+    named seq axis >1, attention runs sequence-sharded — ring rotation via
+    ppermute or Ulysses head-scatter all_to_alls (parallel/ring.py,
+    parallel/ulysses.py). Returns None when the plain single-shard path
+    should run (no mesh, axis absent/size 1). SURVEY §5.7 IR-path form."""
+    mode = attrs.get("seq_parallel")
+    if not mode:
+        return None
+    from paddle_tpu.parallel import env as penv
+
+    mesh = penv.current_mesh()
+    axis = attrs.get("seq_axis", "seq")
+    if mesh is None or axis not in mesh.axis_names:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get(axis, 1) <= 1:
+        return None
+    q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
+    if getattr(jax.typeof(q), "vma", None):
+        raise EnforceError(
+            "seq_parallel scaled_dot_product_attention cannot run inside an "
+            "already-manual region (e.g. a pipeline_stack body); shard the "
+            "sequence axis on the outer program instead"
+        )
+    if ins.get("Bias"):
+        raise EnforceError(
+            "seq_parallel scaled_dot_product_attention does not take Bias; "
+            "fold padding into the sequence instead"
+        )
+    causal = attrs.get("causal", False)
+    scale = attrs.get("sm_scale")
+    if mode == "ring":
+        from paddle_tpu.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, mesh, seq_axis=axis, causal=causal,
+                             scale=scale, batch_axis="data")
+    elif mode == "ulysses":
+        from paddle_tpu.parallel.ulysses import ulysses_attention
+
+        out = ulysses_attention(q, k, v, mesh, seq_axis=axis, causal=causal,
+                                scale=scale, batch_axis="data")
+    else:
+        raise EnforceError(
+            f"unknown seq_parallel mode {mode!r} (want 'ring' or 'ulysses')"
+        )
+    return {"Out": [out]}
+
+
 def _sdpa_reference(ins, attrs):
     """Unfused attention (XLA-fused path): q,k,v [B,H,S,D], optional additive
     key bias [B,S]."""
     import math as _math
 
+    sp = _sdpa_seq_parallel(ins, attrs)
+    if sp is not None:
+        return sp
     q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
     bias = first(ins, "Bias") if ins.get("Bias") else None
     scale = attrs.get("sm_scale") or 1.0 / _math.sqrt(q.shape[-1])
@@ -415,6 +469,9 @@ def _sdpa_reference(ins, attrs):
 def _sdpa_pallas(ins, attrs):
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
+    sp = _sdpa_seq_parallel(ins, attrs)
+    if sp is not None:
+        return sp
     q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
     bias = first(ins, "Bias") if ins.get("Bias") else None
     return {

@@ -86,6 +86,39 @@ def _attention_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
+def _jnp_attention(q, k, v, bias, sm_scale, causal):
+    """Unfused attention with the kernel's exact masking semantics — the
+    off-TPU fallback when Pallas interpret mode cannot run (shard_map)."""
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)[:, None, None, :]
+    if causal:
+        S = q.shape[2]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where((cols <= rows)[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _sds(shape, dtype, *refs):
+    """ShapeDtypeStruct for a pallas_call out_shape, annotated with the
+    union of the refs' varying-mesh-axes: required when the kernel runs
+    inside shard_map (e.g. the pipeline_stack stage body), whose vma
+    checker rejects un-annotated out_shapes."""
+    vma = frozenset()
+    for r in refs:
+        vma |= getattr(jax.typeof(r), "vma", None) or frozenset()
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # pragma: no cover - older jax without vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fwd_impl(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
     B, H, S, D = q.shape
     block_q = min(block_q, S)
@@ -135,8 +168,8 @@ def _fwd_impl(q, k, v, bias, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, S, D), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, S), jnp.float32),
+            _sds((bh, S, D), q.dtype, q3, k3, v3),
+            _sds((bh, 1, S), jnp.float32, q3, k3, v3),
         ],
         interpret=interpret,
     )(*args)
@@ -315,14 +348,14 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), **kw),
     ]
     kv_out_shapes = [
-        jax.ShapeDtypeStruct((bh, S, D), k.dtype),
-        jax.ShapeDtypeStruct((bh, S, D), v.dtype),
+        _sds((bh, S, D), k.dtype, q3, k3, v3, g3),
+        _sds((bh, S, D), v.dtype, q3, k3, v3, g3),
     ]
     if has_bias:
         kv_out_specs.append(
             pl.BlockSpec((1, 1, bk), lambda b, j: (b, 0, j), **kw)
         )
-        kv_out_shapes.append(jax.ShapeDtypeStruct((bh, 1, S), jnp.float32))
+        kv_out_shapes.append(_sds((bh, 1, S), jnp.float32, q3, k3, v3, g3))
 
     def dkdv_kernel(*refs):
         if has_bias:
@@ -382,7 +415,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         grid=(bh, S // bq),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), **kw),
-        out_shape=jax.ShapeDtypeStruct((bh, S, D), q.dtype),
+        out_shape=_sds((bh, S, D), q.dtype, q3, k3, v3, g3),
         interpret=interpret,
     )(*dq_args)
 
@@ -405,6 +438,12 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # pallas interpret mode inside a shard_map region trips an MLIR
+    # closed_call caching bug (KeyError in cached_primitive_lowerings), so
+    # off-TPU under shard_map use the numerically-identical jnp path; the
+    # real chip always runs the Pallas kernel
+    if interpret and (getattr(jax.typeof(q), "vma", None) or frozenset()):
+        return _jnp_attention(q, k, v, bias, float(sm_scale), bool(causal))
     S = q.shape[2]
     bq = min(block_q, S)
     bk = min(block_k, S)

@@ -12,10 +12,13 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def top2_gating(logits, capacity):
+def top2_gating(logits, capacity, mean_fn=None):
     """logits: [T, E]. Returns (dispatch [T, E, C] bool-ish float,
     combine [T, E, C] float, aux_loss scalar) — top-2 routing with
-    per-expert capacity C and load-balancing auxiliary loss."""
+    per-expert capacity C and load-balancing auxiliary loss. `mean_fn`
+    overrides the token-mean used for the aux loss (sharded callers pass a
+    cross-device pmean so the nonlinear density product sees GLOBAL means
+    and ep=1/ep=n report the same loss)."""
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
@@ -26,8 +29,10 @@ def top2_gating(logits, capacity):
     mask2 = jax.nn.one_hot(g2_idx, e, dtype=probs.dtype)
 
     # load-balance loss (Shazeer et al.): mean gate prob * mean assignment
-    density = mask1.mean(axis=0)
-    density_proxy = probs.mean(axis=0)
+    if mean_fn is None:
+        mean_fn = lambda m: m.mean(axis=0)
+    density = mean_fn(mask1)
+    density_proxy = mean_fn(probs)
     aux_loss = (density * density_proxy).sum() * (e * e) / e
 
     # positions within each expert's buffer (running count over tokens)
@@ -54,19 +59,27 @@ def top2_gating(logits, capacity):
 
 
 def moe_ffn_local(x, gate_w, expert_params, expert_fn, expert_axis,
-                  capacity_factor=2.0):
+                  capacity_factor=2.0, capacity=None, global_aux=False):
     """Runs INSIDE shard_map. x: [T_local, H] tokens; gate_w: [H, E_total];
     expert_params: pytree with leading dim E_local (this device's experts).
     Tokens are dispatched to experts with two all_to_alls over `expert_axis`.
-    Returns ([T_local, H], aux_loss)."""
+    Returns ([T_local, H], aux_loss). `capacity` pins the per-source-device
+    expert buffer explicitly (the IR op passes it so dense and sharded paths
+    agree); `global_aux` makes the load-balance loss use cross-device token
+    means (identical value on every shard count when nothing drops)."""
     n_dev = lax.psum(1, expert_axis)
     t_loc, h = x.shape
     e_total = gate_w.shape[1]
     e_local = e_total // n_dev
-    capacity = max(int(capacity_factor * t_loc * 2 / e_total), 4)
+    if capacity is None:
+        capacity = max(int(capacity_factor * t_loc * 2 / e_total), 4)
 
     logits = x @ gate_w                                       # [T,E]
-    dispatch, combine, aux = top2_gating(logits, capacity)
+    mean_fn = (
+        (lambda m: lax.pmean(m.mean(axis=0), expert_axis))
+        if global_aux else None
+    )
+    dispatch, combine, aux = top2_gating(logits, capacity, mean_fn=mean_fn)
 
     # [T,E,C] x [T,H] -> [E,C,H]: expert-major token buffers
     buf = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)

@@ -1,0 +1,173 @@
+"""Sequence + expert parallelism on the Program/Executor product surface
+(VERDICT r3 item 3: ring/Ulysses and MoE were functional-path only; these
+tests drive them through the IR like test_pipeline_ir.py does for pp/tp).
+
+Parity pattern: the SAME program runs (a) uncompiled on one device — the
+dense/plain lowering — and (b) through CompiledProgram.with_parallel on a
+virtual 8-device mesh carrying a 'seq' or 'expert' axis; losses must agree
+step for step.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.parallel.env import make_mesh
+
+
+def _build_attn_model(seq_parallel, B, H_heads, S, D):
+    """Tiny attention regression: loss = mean((attn(qkv(x)) - y)^2)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [B, H_heads, S, D])
+        y = fluid.data("y", [B, H_heads, S, D])
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("attn_w")
+        w = helper.create_parameter(
+            fluid.ParamAttr(
+                initializer=fluid.initializer.NormalInitializer(0, 0.2)
+            ),
+            shape=[D, D], dtype="float32",
+        )
+        q = fluid.layers.matmul(x, w)
+        out = fluid.layers.scaled_dot_product_attention(
+            q, x, x, causal=True, seq_parallel=seq_parallel, seq_axis="seq",
+        )
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(out, y))
+        )
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _init_snapshot(main, startup):
+    """Initial (pre-training) parameter values, keyed by creation order."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        return [np.asarray(sc.find_var(p.name)) for p in main.all_parameters()]
+
+
+def _train_curve(main, startup, loss, feed, prog=None, steps=4, pvals=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        if pvals is not None:
+            # pin initial weights by creation order (arms built separately
+            # get different unique-name suffixes)
+            for p, v in zip(main.all_parameters(), pvals):
+                assert np.asarray(sc.find_var(p.name)).shape == v.shape
+                sc.set(p.name, v)
+        target = prog if prog is not None else main
+        return [
+            float(np.asarray(
+                exe.run(target, feed=feed, fetch_list=[loss])[0]
+            ).reshape(-1)[0])
+            for _ in range(steps)
+        ]
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_ir_seq_parallel_parity(rng, mode):
+    """sdpa with seq_parallel over an 8-way seq-sharded mesh == the plain
+    single-device path, training included."""
+    B, Hh, S, D = 2, 8, 32, 8
+    feed = {
+        "x": rng.randn(B, Hh, S, D).astype("float32"),
+        "y": rng.randn(B, Hh, S, D).astype("float32"),
+    }
+    main, startup, loss = _build_attn_model(None, B, Hh, S, D)
+    pvals = _init_snapshot(main, startup)
+    ref = _train_curve(main, startup, loss, feed, pvals=pvals)
+
+    main2, startup2, loss2 = _build_attn_model(mode, B, Hh, S, D)
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    prog = fluid.CompiledProgram(main2).with_parallel(
+        mesh=mesh, loss_name=loss2.name,
+    )
+    got = _train_curve(main2, startup2, loss2, feed, prog=prog, pvals=pvals)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=1e-6)
+
+
+def test_ir_seq_parallel_rejects_bias(rng):
+    from paddle_tpu.utils.enforce import EnforceError
+
+    B, Hh, S, D = 2, 4, 16, 8
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [B, Hh, S, D])
+        b = fluid.data("b", [B, S])
+        out = fluid.layers.scaled_dot_product_attention(
+            x, x, x, bias=b, seq_parallel="ring"
+        )
+        loss = fluid.layers.mean(out)
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    prog = fluid.CompiledProgram(main).with_parallel(mesh=mesh)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(EnforceError, match="Bias"):
+        exe.run(prog, feed={
+            "x": rng.randn(B, Hh, S, D).astype("float32"),
+            "b": np.zeros((B, S), "float32"),
+        }, fetch_list=[loss])
+
+
+def _build_moe_model(B, S, H, E, cap, lr=0.1):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", [B, S, H])
+        y = fluid.data("y", [B, S, H])
+        out, aux = fluid.layers.moe_ffn(
+            x, num_experts=E, d_ff=2 * H, expert_axis="expert",
+            capacity=cap,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NormalInitializer(0, 0.1)
+            ),
+        )
+        mse = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(out, y))
+        )
+        loss = fluid.layers.elementwise_add(
+            mse, fluid.layers.scale(aux, scale=0.01)
+        )
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def test_ir_moe_parity(rng):
+    """moe_ffn dense (plain Executor) == expert-parallel over a 4-way
+    expert axis (CompiledProgram), generous capacity so nothing drops."""
+    B, S, H, E = 4, 8, 16, 4
+    cap = B * S * 2  # no token ever dropped
+    feed = {
+        "x": rng.randn(B, S, H).astype("float32"),
+        "y": rng.randn(B, S, H).astype("float32"),
+    }
+    main, startup, loss = _build_moe_model(B, S, H, E, cap)
+    pvals = _init_snapshot(main, startup)
+    ref = _train_curve(main, startup, loss, feed, pvals=pvals)
+
+    main2, startup2, loss2 = _build_moe_model(B, S, H, E, cap)
+    mesh = make_mesh((2, 4), ("data", "expert"))
+    prog = fluid.CompiledProgram(main2).with_parallel(
+        mesh=mesh, loss_name=loss2.name,
+    )
+    got = _train_curve(main2, startup2, loss2, feed, prog=prog, pvals=pvals)
+    np.testing.assert_allclose(ref, got, rtol=5e-4, atol=1e-6)
+
+
+def test_ir_moe_trains_dense(rng):
+    """Dense path sanity: the MoE regression actually learns."""
+    B, S, H, E = 4, 4, 8, 2
+    feed = {
+        "x": rng.randn(B, S, H).astype("float32"),
+        "y": rng.randn(B, S, H).astype("float32"),
+    }
+    main, startup, loss = _build_moe_model(B, S, H, E, 0, lr=0.5)
+    curve = _train_curve(main, startup, loss, feed, steps=40)
+    assert np.isfinite(curve).all()
+    assert curve[-1] < curve[0] * 0.8, curve
