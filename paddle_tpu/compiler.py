@@ -144,6 +144,9 @@ class CompiledProgram:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+        from paddle_tpu.passes import apply_deferred_sparse_rewrite
+
+        apply_deferred_sparse_rewrite(self._program)
         block = self._program.global_block()
         mesh = self._mesh
         n_dev = int(np.prod(mesh.devices.shape))
@@ -173,8 +176,76 @@ class CompiledProgram:
             (n, tuple(feed_arrays[n].shape), str(np.asarray(feed_arrays[n]).dtype))
             for n in feed_names
         )
-        key = (self._program._uid, self._program._version, feed_sig, tuple(fetch_names))
+        # DGC sparse-exchange mode (reference: details/
+        # sparse_all_reduce_op_handle.h): a data-parallel program carrying
+        # dgc_momentum ops runs the WHOLE block per-shard under shard_map
+        # so per-shard gradients exist for the top-k (index, value)
+        # all_gather; U/V become per-shard state with a leading shard axis.
+        # Requires a pure-DP mesh and no nested-manual ops; otherwise the
+        # dense fused form runs with a warning.
+        dgc_state = set()
+        for op in block.ops:
+            if op.type == "dgc_momentum":
+                dgc_state.update(op.inputs.get("U", ()))
+                dgc_state.update(op.inputs.get("V", ()))
+        n_batch = axis_sizes.get(batch_axis, 1)
+        dgc_sparse = bool(dgc_state) and n_batch > 1 and \
+            flags.dgc_sparse_exchange
+        if dgc_sparse:
+            # ops whose lowerings open their OWN shard_map cannot nest
+            # inside the per-shard DGC region
+            def _opens_shard_map(op):
+                if op.type in ("pipeline_stack",) or op.type.startswith("c_"):
+                    return True
+                if op.type == "moe_ffn":
+                    ax = op.attrs.get("expert_axis", "expert")
+                    return axis_sizes.get(ax, 1) > 1
+                if op.type == "scaled_dot_product_attention" and \
+                        op.attrs.get("seq_parallel"):
+                    ax = op.attrs.get("seq_axis", "seq")
+                    return axis_sizes.get(ax, 1) > 1
+                return False
+
+            manual_ops = {
+                op.type for op in block.ops if _opens_shard_map(op)
+            }
+            multi_axis = any(
+                s > 1 for a, s in axis_sizes.items() if a != batch_axis
+            )
+            if manual_ops or multi_axis:
+                warnings.warn(
+                    "DGCMomentumOptimizer: sparse exchange needs a pure "
+                    f"data-parallel mesh without manual-region ops (found "
+                    f"{sorted(manual_ops) or 'multi-axis mesh'}); falling "
+                    "back to the dense fused form (no wire savings)"
+                )
+                dgc_sparse = False
+        key = (self._program._uid, self._program._version, feed_sig,
+               tuple(fetch_names), dgc_sparse)
         entry = self._cache.get(key)
+        if dgc_sparse:
+            # expand U/V accumulators to per-shard [n, ...] state; runs on
+            # EVERY call (a fresh scope behind a warm compile cache would
+            # otherwise feed declared-shape state into the per-shard step).
+            # The block var's declared shape distinguishes fresh from
+            # expanded.
+            for n in sorted(dgc_state):
+                if not scope.has_var(n):
+                    continue
+                arr = np.asarray(scope.find_var(n))
+                declared = tuple(
+                    d for d in (block._find_var_recursive(n).shape or ())
+                )
+                if tuple(arr.shape) == declared:
+                    scope.set(
+                        n,
+                        np.broadcast_to(arr, (n_batch,) + declared).copy(),
+                    )
+                elif tuple(arr.shape) != (n_batch,) + declared:
+                    raise EnforceError(
+                        f"dgc accumulator {n} has shape {arr.shape}, "
+                        f"expected {declared} or {(n_batch,) + declared}"
+                    )
         if entry is None:
             donated, readonly, written, live = plan_step(
                 block, feed_names, fetch_names, scope, flags.use_donation
@@ -188,21 +259,96 @@ class CompiledProgram:
                     f"(run the startup program first?)"
                 )
 
-            def step(feed_vals, donated_vals, readonly_vals, rng_key):
-                env = dict(zip(feed_names, feed_vals))
-                env.update(zip(donated, donated_vals))
-                env.update(zip(readonly, readonly_vals))
-                _interpret_block(block, env, rng_key, ops=live)
-                return [env[n] for n in fetch_names], [env.get(n) for n in written]
-
             from paddle_tpu.parallel.sharding import check_spec, derive_shardings
 
             repl = NamedSharding(mesh, P())
             feed_shardings = []
+            feed_specs = []
             for n in feed_names:
                 spec = input_specs.get(n, P(batch_axis))
                 spec = check_spec(tuple(np.shape(feed_arrays[n])), spec, mesh)
+                feed_specs.append(spec)
                 feed_shardings.append(NamedSharding(mesh, spec))
+
+            if dgc_sparse:
+                from jax import lax
+
+                from paddle_tpu.parallel.env import dgc_axis_context
+
+                # batch-shaped fetches would be SILENTLY averaged across
+                # different examples by the per-shard pmean — refuse them
+                # up front on declared shapes
+                for n in fetch_names:
+                    fv = block._find_var_recursive(n)
+                    shape = tuple(fv.shape or ()) if fv is not None else ()
+                    static = [d for d in shape if d and d > 0]
+                    dynamic = any(d in (-1, None) or (d and d < 0)
+                                  for d in shape)
+                    if dynamic or int(np.prod(static or [1])) > 1:
+                        raise EnforceError(
+                            f"fetch '{n}' (declared shape {list(shape)}) is "
+                            "not a scalar: DGC sparse-exchange mode runs the "
+                            "block per-shard and can only fetch scalar "
+                            "losses/metrics (cross-shard means). Fetch "
+                            "scalars, or disable the sparse exchange with "
+                            "FLAGS_dgc_sparse_exchange=0"
+                        )
+
+                def step(feed_vals, donated_vals, readonly_vals, rng_key):
+                    def local_step(feed_vals, donated_vals, readonly_vals,
+                                   rng_key):
+                        # decorrelate per-shard stochastic ops (dropout)
+                        rng_key = jax.random.fold_in(
+                            rng_key, lax.axis_index(batch_axis)
+                        )
+                        env = dict(zip(feed_names, feed_vals))
+                        env.update(zip(donated, donated_vals))
+                        env.update(zip(readonly, readonly_vals))
+                        with dgc_axis_context(batch_axis):
+                            _interpret_block(block, env, rng_key, ops=live)
+                        # scalar float fetches (losses/metrics of the local
+                        # shard) are cross-shard means; non-scalars were
+                        # rejected at entry build (the local view here
+                        # cannot tell a scalar from a batch shard)
+                        fetches = []
+                        for n in fetch_names:
+                            val = env[n]
+                            if "float" in str(val.dtype):
+                                val = lax.pmean(val, batch_axis)
+                            fetches.append(val)
+                        return fetches, [env.get(n) for n in written]
+
+                    def state_spec(names):
+                        return tuple(
+                            P(batch_axis) if n in dgc_state else P()
+                            for n in names
+                        )
+
+                    return jax.shard_map(
+                        local_step,
+                        mesh=mesh,
+                        in_specs=(
+                            tuple(feed_specs),
+                            state_spec(donated),
+                            state_spec(readonly),
+                            P(),
+                        ),
+                        out_specs=(
+                            [P()] * len(fetch_names),
+                            list(state_spec(written)),
+                        ),
+                        # vma checking is off: param updates are invariant
+                        # by construction (the sparse exchange all_gathers
+                        # identical (idx, value) sets on every shard)
+                        check_vma=False,
+                    )(feed_vals, donated_vals, readonly_vals, rng_key)
+            else:
+                def step(feed_vals, donated_vals, readonly_vals, rng_key):
+                    env = dict(zip(feed_names, feed_vals))
+                    env.update(zip(donated, donated_vals))
+                    env.update(zip(readonly, readonly_vals))
+                    _interpret_block(block, env, rng_key, ops=live)
+                    return [env[n] for n in fetch_names], [env.get(n) for n in written]
             scope_names = donated + readonly
             if self._param_rules is not None or self._param_overrides:
                 scope_shardings = derive_shardings(
@@ -214,6 +360,11 @@ class CompiledProgram:
                 )
             else:
                 scope_shardings = {n: repl for n in scope_names}
+            if dgc_sparse:
+                # per-shard U/V state lives sharded on the batch axis
+                for n in dgc_state:
+                    if n in scope_shardings:
+                        scope_shardings[n] = NamedSharding(mesh, P(batch_axis))
             in_shardings = (
                 tuple(feed_shardings),
                 tuple(scope_shardings[n] for n in donated),

@@ -447,6 +447,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _run_compiled(self, program, feed_arrays, fetch_names, scope, return_numpy):
+        from paddle_tpu.passes import apply_deferred_sparse_rewrite
+
+        apply_deferred_sparse_rewrite(program)
         block = program.global_block()
         feed_names = sorted(feed_arrays)
         feed_sig = tuple(
@@ -461,6 +464,14 @@ class Executor:
 
             num_mb = getattr(program, "_num_microbatches", 0)
             if num_mb and num_mb > 1:
+                if any(op.type == "sgd_sparse" for op in block.ops):
+                    raise EnforceError(
+                        "sgd_sparse cannot run microbatched: Ids differ per "
+                        "microbatch while grads accumulate across them. "
+                        "Build the program with "
+                        "FLAGS_sparse_embedding_update=0, or apply "
+                        "PipelineOptimizer before minimize"
+                    )
                 step = _make_microbatched_step(
                     block, ops, feed_names, donated, readonly,
                     written_persistable, fetch_names, num_mb,

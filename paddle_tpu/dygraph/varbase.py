@@ -9,7 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.utils import unique_name
-from paddle_tpu.utils.enforce import enforce
+from paddle_tpu.utils.enforce import EnforceError, enforce
 
 
 class VarBase:
@@ -83,6 +83,40 @@ class VarBase:
     def __len__(self):
         return self.shape[0] if self.shape else 0
 
+    # -- control-flow capture guards -----------------------------------
+    # A Python `if`/`while` on a tensor calls __bool__. Eagerly that is
+    # fine (the value exists); under dygraph-to-static capture the value
+    # is symbolic, and Python would otherwise take the default object
+    # truthiness (always True) and SILENTLY bake one branch into the
+    # traced program (reference fixes this with AST rewriting,
+    # dygraph_to_static/ast_transformer.py; the TPU-native contract is a
+    # loud trace-time error instead — use layers.cond / layers.while_loop
+    # or keep the code eager).
+    def _concrete(self, what):
+        if self.value is None:
+            raise EnforceError(
+                f"cannot convert symbolic tensor '{self.name}' to {what} "
+                "during dygraph-to-static capture: a Python branch/loop on "
+                "a traced value would silently bake one path into the "
+                "program. Rewrite the data-dependent control flow with "
+                "fluid.layers.cond / fluid.layers.while_loop (or a "
+                "vectorized select like fluid.layers.where), or run the "
+                "layer eagerly instead of tracing it"
+            )
+        return np.asarray(self.value)
+
+    def __bool__(self):
+        return bool(self._concrete("bool"))
+
+    def __float__(self):
+        return float(self._concrete("float"))
+
+    def __int__(self):
+        return int(self._concrete("int"))
+
+    def __index__(self):
+        return int(self._concrete("index"))
+
     def __repr__(self):
         tag = "ParamBase" if getattr(self, "trainable", None) is not None else "VarBase"
         return f"{tag}(name={self.name}, shape={self.shape}, dtype={self.dtype})"
@@ -93,7 +127,8 @@ class VarBase:
 
         if not isinstance(other, VarBase):
             other = to_variable(
-                np.full((1,), other, dtype=np.asarray(self.value).dtype)
+                # self.dtype works for capture proxies too (value is None)
+                np.full((1,), other, dtype=np.dtype(self.dtype))
             )
         x, y = (other, self) if reverse else (self, other)
         return trace_op(op_type, {"X": [x], "Y": [y]}, {"axis": -1})["Out"][0]
@@ -124,6 +159,21 @@ class VarBase:
         from paddle_tpu.dygraph.base import trace_op
 
         return trace_op("matmul", {"X": [self], "Y": [o]}, {})["Out"][0]
+
+    # comparisons (reference: math_op_patch monkey-patches these too) —
+    # they return TENSORS; a Python `if` on the result goes through
+    # __bool__, which is guarded against capture proxies above
+    def __gt__(self, o):
+        return self._binary(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, "less_equal")
 
     def __neg__(self):
         from paddle_tpu.dygraph.base import trace_op

@@ -21,6 +21,16 @@ shape every pipelined transformer actually has).
 
 Pass `stack.param_spec_overrides()` into with_parallel(param_specs=...) so
 the stacked parameters are placed stage-major on the mesh.
+
+DESIGN BOUNDARY — homogeneous stages only. Every pipelined layer shares one
+body and one stacked param shape; embedding/LM-head-style odd stages live
+OUTSIDE the stack in the same program (see models/gpt_ir.py). The
+reference's section pipeline cut arbitrary programs into per-device
+sections (reference: python/paddle/fluid/optimizer.py:3414 cut_list,
+device_worker section_worker.cc:142) because each GPU needed its op range
+placed on it; under GSPMD the outside-the-stack ops are sharded over the
+whole mesh by the compiler, so the odd stages need no placement — the
+homogeneous stack covers exactly the part where the GPipe schedule pays.
 """
 
 import numpy as np

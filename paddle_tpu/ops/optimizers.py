@@ -8,9 +8,11 @@ level. All moment arithmetic runs in fp32 even for bf16 params.
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.registry import register_op
 from paddle_tpu.ops.common import first, maybe
+from paddle_tpu.utils.enforce import EnforceError
 
 
 def _f32(x):
@@ -22,6 +24,30 @@ def _sgd(ins, attrs):
     p, g, lr = first(ins, "Param"), first(ins, "Grad"), first(ins, "LearningRate")
     out = _f32(p) - _f32(lr) * _f32(g)
     return {"ParamOut": [out.astype(p.dtype)]}
+
+
+@register_op("sgd_sparse", nondiff_inputs=("Ids",))
+def _sgd_sparse(ins, attrs):
+    """SelectedRows-analog row update (reference: paddle/fluid/operators/
+    optimizers/sgd_op.h sparse branch; selected_rows.h:32): the embedding
+    grad never materializes as a [V, D] dense tensor — the looked-up rows'
+    cotangent scatter-subtracts straight into the touched parameter rows
+    (duplicate ids combine inside the scatter, the segment-sum the
+    reference does in SumKernel's SelectedRows branch). Emitted by the
+    sparse_weight_update pass replacing lookup_table_grad + sgd."""
+    p = first(ins, "Param")
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    rows = first(ins, "RowGrad")
+    lr = _f32(first(ins, "LearningRate")).reshape(())
+    d = p.shape[-1]
+    rows2 = rows.reshape(-1, d).astype(p.dtype)
+    pi = attrs.get("padding_idx", -1)
+    if pi is not None and pi >= 0:
+        # the forward zeroed padding rows, so their grads must not land
+        rows2 = jnp.where((ids == pi)[:, None], 0.0, rows2)
+    return {
+        "ParamOut": [p.at[ids].add(-(lr.astype(p.dtype)) * rows2)],
+    }
 
 
 @register_op("momentum")
@@ -354,7 +380,21 @@ def _dgc_momentum(ins, attrs):
     """DGC update (reference: paddle/fluid/operators/dgc_op.cc semantics):
     u = mu*u + g; v += u; select |v| above the sparsity quantile; apply the
     selected (sparse) update; clear u,v at selected positions (error
-    feedback keeps the rest)."""
+    feedback keeps the rest).
+
+    Two forms:
+    * dense (default): one fused per-param op; under GSPMD the gradient
+      exchange is compiler-inserted dense traffic (compression semantics
+      without wire savings).
+    * sparse exchange (CompiledProgram data-parallel + DGC, per-shard
+      mode): the block runs per-shard under shard_map, U/V are per-shard
+      state with a leading local axis, and the update is a top-k
+      (index, value) all_gather over the data axis — 2*k*n floats on the
+      wire instead of the dense gradient (reference:
+      details/sparse_all_reduce_op_handle.h).
+    """
+    from paddle_tpu.parallel import env as penv
+
     p = first(ins, "Param")
     g = first(ins, "Grad").astype(p.dtype)
     u, v = first(ins, "U"), first(ins, "V")
@@ -365,6 +405,20 @@ def _dgc_momentum(ins, attrs):
     ramp = max(attrs.get("rampup_step", 1.0), 1.0)
     sparsity = jnp.asarray(attrs.get("sparsity", [0.999]), jnp.float32)
     L = sparsity.shape[0]
+    dgc_axis = penv.current_dgc_axis()
+
+    if dgc_axis is None and u.ndim == p.ndim + 1:
+        raise EnforceError(
+            "dgc accumulators carry per-shard state (leading shard axis) "
+            "from a sparse-exchange CompiledProgram run; keep running the "
+            "compiled program, or reset the accumulators, before using the "
+            "plain Executor"
+        )
+    if dgc_axis is not None:
+        # per-shard sparse exchange: U/V arrive [1, ...] (this shard's
+        # slice), Grad is this shard's local-batch gradient
+        u = u[0]
+        v = v[0]
 
     u_new = mu * u + g
     contrib = g + mu * u_new if attrs.get("use_nesterov", False) else u_new
@@ -374,6 +428,45 @@ def _dgc_momentum(ins, attrs):
     idx = jnp.clip(((step - begin) * L / ramp).astype(jnp.int32), 0, L - 1)
     ratio = jnp.where(step < begin, 0.0, jnp.take(sparsity, idx))
     is_dense = ratio <= 0.0
+
+    if dgc_axis is not None:
+        from jax import lax
+
+        size = int(np.prod(p.shape))
+        # static top-k bound from the FINAL (largest-k) sparsity; the
+        # traced ramp ratio masks the tail during warmup
+        k_max = max(1, int(round(size * (1.0 - float(min(
+            attrs.get("sparsity", [0.999])
+        ))))))
+        v_acc = (v + contrib).reshape(-1)
+        mag = jnp.abs(v_acc)
+        _, top_idx = lax.top_k(mag, k_max)                    # [k]
+        k_dyn = jnp.round(size * (1.0 - ratio)).astype(jnp.int32)
+        keep = (jnp.arange(k_max) < jnp.maximum(k_dyn, 1)).astype(v_acc.dtype)
+        vals = v_acc[top_idx] * keep
+        n = lax.psum(1, dgc_axis)
+        # THE wire: 2*k*n floats instead of `size` — the honest DGC saving
+        all_idx = lax.all_gather(top_idx, dgc_axis)           # [n, k]
+        all_vals = lax.all_gather(vals, dgc_axis)             # [n, k]
+        sparse_update = (
+            jnp.zeros((size,), v_acc.dtype)
+            .at[all_idx.reshape(-1)]
+            .add(all_vals.reshape(-1)) / n
+        ).reshape(p.shape)
+        dense_update = lax.pmean(contrib, dgc_axis)
+        update = jnp.where(is_dense, dense_update, sparse_update)
+        sent = jnp.zeros((size,), bool).at[top_idx].set(keep > 0)
+        sent = sent.reshape(p.shape)
+        u_out = jnp.where(is_dense, u_new, jnp.where(sent, 0.0, u_new))
+        v_out = jnp.where(
+            is_dense, v, jnp.where(sent, 0.0, v_acc.reshape(p.shape))
+        )
+        return {
+            "ParamOut": [p - lr.astype(p.dtype) * update],
+            "UOut": [u_out[None]],
+            "VOut": [v_out[None]],
+        }
+
     v_acc = v + contrib
     absv = jnp.abs(v_acc)
     thr = jnp.quantile(absv.reshape(-1).astype(jnp.float32), ratio)

@@ -270,6 +270,24 @@ class SGDOptimizer(Optimizer):
             {"op_role": _OP_ROLE_OPTIMIZE},
         )
 
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = super().minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        from paddle_tpu.dygraph.base import in_dygraph_mode
+        from paddle_tpu.utils.flags import flags as _flags
+
+        if not in_dygraph_mode() and _flags.sparse_embedding_update:
+            # SelectedRows analog (reference: operators/optimizers/sgd_op.h
+            # sparse branch): single-use embedding grads become row-sparse
+            # scatter updates instead of [V, D] dense tensors. The rewrite
+            # is DEFERRED to first execution (executor applies it) because
+            # a PipelineOptimizer wrapping this one sets _num_microbatches
+            # only after we return — and the fused form cannot microbatch.
+            loss.block.program._wants_sparse_embedding = True
+        return result
+
 
 class MomentumOptimizer(Optimizer):
     def __init__(self, learning_rate, momentum, use_nesterov=False, **kwargs):
@@ -809,18 +827,20 @@ class DGCMomentumOptimizer(MomentumOptimizer):
     dgc_op.cc; details/sparse_all_reduce_op_handle.h).
 
     The reference sparsifies gradients to top-k before NCCL allreduce to cut
-    communication. Two TPU forms exist here:
+    communication. TPU forms here:
 
-    * THIS optimizer (IR path): keeps DGC's update *semantics* — momentum
-      correction + error feedback (u/v accumulators) + magnitude selection
-      with warmup sparsity ramp — as one fused op per parameter. Under
-      single-program GSPMD the gradient allreduce is compiler-inserted and
-      dense, so this form regularizes like DGC but does NOT reduce traffic.
-    * parallel/dgc.py `dgc_allreduce`: the actual communication saving —
-      per-shard top-k selection + (index, value) all-gather under
-      shard_map, 2*k*n floats on the wire instead of the dense gradient.
-      Use it in shard_map/multi-process data-parallel training loops where
-      the exchange is under our control.
+    * THIS optimizer + CompiledProgram data parallelism (pure-DP mesh):
+      the compiler runs the block per-shard under shard_map, U/V become
+      per-shard error-feedback state (leading shard axis in the scope),
+      and the exchange is a top-k (index, value) all_gather over the data
+      axis — 2*k*n floats on the wire instead of the dense gradient
+      (compiler.py dgc_sparse mode; ops/optimizers.py sparse branch).
+    * THIS optimizer uncompiled / on a hybrid mesh: the fused dense form —
+      DGC update semantics (momentum correction + error feedback +
+      magnitude selection with warmup ramp) but compiler-inserted dense
+      traffic; the compiler warns when it falls back.
+    * parallel/dgc.py `dgc_allreduce`: the same honest exchange for
+      functional shard_map training loops.
     """
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,

@@ -40,6 +40,28 @@ def current_mesh():
     return _current_mesh
 
 
+_dgc_axis = None
+
+
+@contextlib.contextmanager
+def dgc_axis_context(axis_name):
+    """Installed by CompiledProgram while tracing a DGC program in
+    per-shard sparse-exchange mode: the dgc_momentum lowering reads it to
+    run the top-k (index, value) all_gather over this axis instead of the
+    dense update (ops/optimizers.py)."""
+    global _dgc_axis
+    old = _dgc_axis
+    _dgc_axis = axis_name
+    try:
+        yield
+    finally:
+        _dgc_axis = old
+
+
+def current_dgc_axis():
+    return _dgc_axis
+
+
 @contextlib.contextmanager
 def collective_context(bindings):
     """bindings: {ring_id: mesh_axis_name}."""

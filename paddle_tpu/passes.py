@@ -247,3 +247,102 @@ def _strip_debug_pass(program, ctx):
         program._bump_version()
     ctx.stats["strip_debug_ops"] = {"removed_ops": removed}
     return program
+
+
+@register_pass("sparse_weight_update")
+def _sparse_weight_update_pass(program, ctx):
+    """Fuse lookup_table*_grad + sgd into a row-sparse sgd_sparse update —
+    the SelectedRows analog for the dense path (reference:
+    paddle/fluid/framework/selected_rows.h:32; operators/optimizers/
+    sgd_op.h sparse branch). The [V, D] dense gradient never materializes:
+    the looked-up rows' cotangent scatter-subtracts into the touched
+    parameter rows. Applies only where the dense grad has exactly one
+    producer (the lookup grad) and one consumer (the sgd) — grad clip,
+    regularizers, or multi-use embeddings keep the dense form.
+
+    Skipped under microbatching: Ids differ per microbatch while grads are
+    accumulated across them, so the fused form would silently use one
+    microbatch's ids.
+    """
+    if getattr(program, "_num_microbatches", 1) and \
+            getattr(program, "_num_microbatches", 1) > 1:
+        ctx.stats["sparse_weight_update"] = {"rewritten": 0,
+                                             "skipped": "microbatched"}
+        return program
+    block = program.global_block()
+    producers = {}
+    consumers = {}
+    for op in block.ops:
+        for n in op.output_names():
+            producers.setdefault(n, []).append(op)
+        for n in op.input_names():
+            consumers.setdefault(n, []).append(op)
+
+    lookup_types = {"lookup_table_grad", "lookup_table_v2_grad"}
+    rewrites = []  # (sgd_op, grad_op)
+    for op in block.ops:
+        if op.type != "sgd":
+            continue
+        gname = op.inputs["Grad"][0]
+        prods = producers.get(gname, [])
+        cons = consumers.get(gname, [])
+        v = block.vars.get(gname)
+        if (
+            len(prods) == 1
+            and prods[0].type in lookup_types
+            and len(cons) == 1
+            and cons[0] is op
+            and not (v is not None and v.persistable)
+        ):
+            rewrites.append((op, prods[0]))
+
+    if not rewrites:
+        ctx.stats["sparse_weight_update"] = {"rewritten": 0}
+        return program
+
+    from paddle_tpu.core.ir import Operator
+
+    replaced = {id(o) for pair in rewrites for o in pair}
+    new_ops = []
+    for op in block.ops:
+        if id(op) not in replaced:
+            new_ops.append(op)
+            continue
+        match = next((pair for pair in rewrites if pair[0] is op), None)
+        if match is None:
+            continue  # the grad op: dropped (fused into sgd_sparse)
+        sgd_op, grad_op = match
+        # RowGrad is the lookup OUTPUT's cotangent (Out@GRAD input slot)
+        new_ops.append(Operator(
+            block, "sgd_sparse",
+            {
+                "Param": list(sgd_op.inputs["Param"]),
+                "Ids": list(grad_op.inputs["Ids"]),
+                "RowGrad": list(grad_op.inputs["Out@GRAD"]),
+                "LearningRate": list(sgd_op.inputs["LearningRate"]),
+            },
+            {"ParamOut": list(sgd_op.outputs["ParamOut"])},
+            {
+                "padding_idx": grad_op.attrs.get("padding_idx", -1),
+                "op_role": sgd_op.attrs.get("op_role", 0),
+            },
+        ))
+        block.vars.pop(gname := sgd_op.inputs["Grad"][0], None)
+    block.ops = new_ops
+    program._bump_version()
+    ctx.stats["sparse_weight_update"] = {"rewritten": len(rewrites)}
+    return program
+
+
+def apply_deferred_sparse_rewrite(program):
+    """Execution-time hook: SGDOptimizer.minimize marks the program instead
+    of rewriting it (a wrapping PipelineOptimizer sets _num_microbatches
+    AFTER minimize returns, and the fused sgd_sparse cannot microbatch).
+    Executors call this before building a compile entry."""
+    if not getattr(program, "_wants_sparse_embedding", False):
+        return
+    program._wants_sparse_embedding = False
+    num_mb = getattr(program, "_num_microbatches", 1) or 1
+    if num_mb > 1:
+        return  # microbatched: the dense form is the correct one
+    _PASS_REGISTRY["sparse_weight_update"](program, PassContext())

@@ -82,3 +82,14 @@ define_flag(
     "path - much cheaper bits, same distribution, different stream",
 )
 define_flag("allocator_strategy", "auto_growth", "host allocator strategy label")
+define_flag(
+    "dgc_sparse_exchange", True,
+    "DGCMomentumOptimizer + data-parallel CompiledProgram: run the block "
+    "per-shard and exchange top-k (index, value) pairs instead of dense "
+    "gradients; 0 keeps the fused dense form",
+)
+define_flag(
+    "sparse_embedding_update", True,
+    "fuse lookup_table_grad + sgd into a row-sparse update (SelectedRows "
+    "analog): the [V, D] dense embedding gradient never materializes",
+)

@@ -232,3 +232,53 @@ def test_no_grad_context():
         assert y.stop_gradient
         tracer = dygraph._dygraph_tracer()
         assert len(tracer._tape) == 0
+
+
+def test_eager_data_dependent_branch_works():
+    """Eagerly, Python `if` on a tensor is legitimate — values exist."""
+    with dygraph.guard():
+        x = to_variable(np.array([2.0], dtype=np.float32))
+        if x > 1.0:
+            y = x * 10.0
+        else:
+            y = x
+        np.testing.assert_allclose(y.numpy(), [20.0])
+
+
+def test_trace_data_dependent_branch_raises_loudly():
+    """VERDICT r3 item 8: a Python branch on a traced value must raise at
+    trace time, never silently bake one path (the reference AST-transforms
+    it; our contract is the loud error pointing at layers.cond)."""
+    from paddle_tpu.utils.enforce import EnforceError
+
+    class BranchyLayer:
+        def __call__(self, x):
+            s = dygraph.trace_op("mean", {"X": [x]}, {})["Out"][0]
+            if s > 0:  # data-dependent Python control flow
+                return x * 2.0
+            return x
+
+    with dygraph.guard():
+        x = to_variable(np.ones((2, 2), dtype=np.float32))
+        with pytest.raises(EnforceError, match="layers.cond"):
+            dygraph.TracedLayer.trace(BranchyLayer(), [x])
+
+
+def test_trace_float_int_conversion_raise():
+    from paddle_tpu.utils.enforce import EnforceError
+
+    class FloatLayer:
+        def __call__(self, x):
+            return x * float(x.numpy().sum())  # .numpy() on a proxy
+
+    class IntLayer:
+        def __call__(self, x):
+            n = int(dygraph.trace_op("mean", {"X": [x]}, {})["Out"][0])
+            return x * float(n)
+
+    with dygraph.guard():
+        x = to_variable(np.ones((2,), dtype=np.float32))
+        with pytest.raises(EnforceError):
+            dygraph.TracedLayer.trace(FloatLayer(), [x])
+        with pytest.raises(EnforceError, match="layers.cond"):
+            dygraph.TracedLayer.trace(IntLayer(), [x])

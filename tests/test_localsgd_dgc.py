@@ -122,3 +122,237 @@ def test_dgc_residual_accumulates_until_sent(rng):
         total += np.asarray(upd)[0]
     # after enough rounds every coordinate has been transmitted at least once
     assert (np.abs(total) > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# IR-path DGC: DGCMomentumOptimizer + CompiledProgram sparse exchange
+# (VERDICT r3 item 5 — the user-facing optimizer gets the honest wire)
+# ---------------------------------------------------------------------------
+
+
+def _build_dgc_program(rampup_begin, lr=0.1, dim=16):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, dim])
+        y = fluid.data("y", [8, 1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, y))
+        )
+        fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=lr, momentum=0.9,
+            rampup_begin_step=rampup_begin, rampup_step=1,
+            sparsity=[0.75],
+        ).minimize(loss)
+    return main, startup, loss
+
+
+def test_ir_dgc_sparse_mode_trains_and_keeps_per_shard_state(rng):
+    """Compiled DP run: the block runs per-shard, U/V become [n, ...] state
+    in the scope, training converges."""
+    import paddle_tpu as fluid
+
+    main, startup, loss = _build_dgc_program(rampup_begin=2)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        w_true = rng.randn(16, 1).astype("float32")
+        xs = rng.randn(8, 16).astype("float32")
+        ys = (xs @ w_true).astype("float32")
+        curve = [
+            float(np.asarray(
+                exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])[0]
+            ).reshape(-1)[0])
+            for _ in range(25)
+        ]
+        assert np.isfinite(curve).all()
+        assert curve[-1] < curve[0] * 0.2, curve
+        unames = [n for n in (v.name for v in main.global_block().vars.values())
+                  if "dgc_u" in n or "dgc_v" in n]
+        assert unames, "no dgc accumulators found"
+        for n in unames:
+            arr = np.asarray(sc.find_var(n))
+            assert arr.shape[0] == 8 and arr.ndim >= 2, (n, arr.shape)
+
+
+def test_ir_dgc_sparse_matches_momentum_during_warmup(rng):
+    """Before rampup_begin the DGC compiled step must equal plain dense
+    momentum (pmean of per-shard grads == global grad)."""
+    import paddle_tpu as fluid
+
+    w_true = rng.randn(16, 1).astype("float32")
+    xs = rng.randn(8, 16).astype("float32")
+    ys = (xs @ w_true).astype("float32")
+
+    def momentum_curve():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [8, 16])
+            y = fluid.data("y", [8, 1])
+            pred = fluid.layers.fc(x, size=1, act=None)
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(pred, y)))
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            return [float(np.asarray(exe.run(
+                main, feed={"x": xs, "y": ys}, fetch_list=[loss]
+            )[0]).reshape(-1)[0]) for _ in range(5)]
+
+    ref = momentum_curve()
+    main, startup, loss = _build_dgc_program(rampup_begin=1000)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        got = [float(np.asarray(exe.run(
+            prog, feed={"x": xs, "y": ys}, fetch_list=[loss]
+        )[0]).reshape(-1)[0]) for _ in range(5)]
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-6)
+
+
+def test_ir_dgc_sparse_wire_is_all_gather_of_topk(rng):
+    """Traffic proxy: the sparse branch's HLO contains all-gathers of the
+    k-sized (index, value) buffers and NO full-size all-reduce for the
+    gradient exchange (the dense fallback would)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.registry import get_op_def
+    from paddle_tpu.parallel.env import dgc_axis_context
+    from jax.sharding import PartitionSpec as P
+
+    dim = 1024
+    mesh = make_mesh((8,), ("data",))
+    lowering = get_op_def("dgc_momentum").lower
+
+    def local(p, g, u, v, lr, step):
+        with dgc_axis_context("data"):
+            outs = lowering(
+                {"Param": [p], "Grad": [g], "U": [u], "V": [v],
+                 "LearningRate": [lr], "CurrentStep": [step]},
+                {"mu": 0.9, "rampup_begin_step": 0.0, "rampup_step": 1.0,
+                 "sparsity": [0.999]},
+            )
+        return outs["ParamOut"][0], outs["UOut"][0], outs["VOut"][0]
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P("data"), P("data"), P("data"), P(), P()),
+        out_specs=(P(), P("data"), P("data")),
+        check_vma=False,
+    )
+    args = (
+        jnp.zeros((dim,)), jnp.ones((8, dim)) * 0.1,
+        jnp.zeros((8, 1, dim)), jnp.zeros((8, 1, dim)),
+        jnp.asarray(0.1), jnp.asarray(100.0),
+    )
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    assert "all-gather" in hlo, "sparse exchange must all_gather (idx, vals)"
+    # k = ceil(1024 * 0.001) = 1 -> gathered buffers are tiny; the dense
+    # gradient itself (f32[1024] per shard) must NOT be all-reduced
+    import re
+    dense_ar = [
+        m for m in re.findall(r"all-reduce[^\n]*", hlo)
+        if f"[{dim}]" in m or f"{dim}]" in m.split("(")[0]
+    ]
+    assert not dense_ar, dense_ar[:3]
+
+
+def test_ir_dgc_fresh_scope_behind_warm_cache(rng):
+    """Code-review r4: re-running a cached DGC CompiledProgram against a
+    FRESH scope must re-expand the declared-shape U/V state, not feed it
+    into the per-shard step."""
+    import paddle_tpu as fluid
+
+    main, startup, loss = _build_dgc_program(rampup_begin=2)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = rng.randn(8, 16).astype("float32")
+    ys = rng.randn(8, 1).astype("float32")
+    for _ in range(2):  # second iteration hits the warm compile cache
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            out = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            assert np.isfinite(np.asarray(out[0])).all()
+            uname = [n for n in
+                     (v.name for v in main.global_block().vars.values())
+                     if "dgc_u" in n][0]
+            assert np.asarray(sc.find_var(uname)).shape[0] == 8
+
+
+def test_ir_dgc_nonscalar_fetch_raises(rng):
+    import paddle_tpu as fluid
+    from paddle_tpu.utils.enforce import EnforceError
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 16])
+        y = fluid.data("y", [8, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, sparsity=[0.9],
+        ).minimize(loss)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((8, 16), "float32"),
+            "y": np.zeros((8, 1), "float32")}
+    with pytest.raises(EnforceError, match="scalar"):
+        exe.run(prog, feed=feed, fetch_list=[pred])
+
+
+def test_ir_dgc_moe_program_falls_back_dense(rng):
+    """moe_ffn opens its own shard_map on the data axis; DGC must warn and
+    keep the dense fused form instead of nesting manual regions."""
+    import warnings as _w
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 16])
+        y = fluid.data("y", [8, 16])
+        h, aux = fluid.layers.moe_ffn(x, num_experts=8, d_ff=32,
+                                      expert_axis="data")
+        loss = fluid.layers.elementwise_add(
+            fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(h, y))),
+            fluid.layers.scale(aux, scale=0.01),
+        )
+        fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, sparsity=[0.9],
+        ).minimize(loss)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(8, 16).astype("float32"),
+            "y": rng.randn(8, 16).astype("float32")}
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        out = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert any("dense fused form" in str(r.message) for r in rec), [
+        str(r.message) for r in rec
+    ]

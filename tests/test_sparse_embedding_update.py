@@ -1,0 +1,175 @@
+"""SelectedRows-analog sparse embedding updates (VERDICT r3 item 6).
+
+reference: paddle/fluid/framework/selected_rows.h:32 (rows+values grad
+representation), operators/optimizers/sgd_op.h sparse branch (row-wise
+scatter update), operators/sum_op.h SelectedRows branch (duplicate-row
+segment sum). Here the sparse_weight_update pass fuses
+lookup_table_grad + sgd into one sgd_sparse row-scatter.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.utils.flags import flags
+
+
+def _build(vocab, dim, B, S, sparse=True):
+    old = flags.sparse_embedding_update
+    flags.sparse_embedding_update = sparse
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            ids = fluid.data("ids", [B, S], dtype="int64")
+            y = fluid.data("y", [B, S, dim])
+            emb = fluid.layers.embedding(
+                ids, size=[vocab, dim],
+                param_attr=fluid.ParamAttr(
+                    name=f"emb_w_{sparse}",
+                    initializer=fluid.initializer.NormalInitializer(0, 0.1),
+                ),
+            )
+            loss = fluid.layers.mean(
+                fluid.layers.square(fluid.layers.elementwise_sub(emb, y))
+            )
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        return main, startup, loss
+    finally:
+        flags.sparse_embedding_update = old
+
+
+def test_rewrite_applies_and_matches_dense(rng):
+    """The pass rewrites the program (no [V, D] grad var, sgd_sparse op
+    present) and training matches the dense form step for step."""
+    vocab, dim, B, S = 50, 8, 4, 6
+    ids = rng.randint(0, vocab, (B, S)).astype("int64")
+    # ensure duplicate ids in the batch: their grads must segment-sum
+    ids[0, :3] = 7
+    y = rng.randn(B, S, dim).astype("float32")
+    curves = {}
+    weights = {}
+    for sparse in (False, True):
+        main, startup, loss = _build(vocab, dim, B, S, sparse)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            w0 = np.asarray(sc.find_var(f"emb_w_{sparse}")).copy()
+            weights.setdefault("init", []).append(w0)
+            curves[sparse] = [
+                float(np.asarray(exe.run(
+                    main, feed={"ids": ids, "y": y}, fetch_list=[loss]
+                )[0]).reshape(-1)[0])
+                for _ in range(6)
+            ]
+            weights[sparse] = np.asarray(sc.find_var(f"emb_w_{sparse}"))
+        # the rewrite is applied at first execution (deferred so a
+        # wrapping PipelineOptimizer can still veto it)
+        types = [op.type for op in main.global_block().ops]
+        if sparse:
+            assert "sgd_sparse" in types, types
+            assert "sgd" not in types, types
+            assert not any(
+                n.endswith("@GRAD") and "emb_w" in n
+                for n in main.global_block().vars
+            ), [n for n in main.global_block().vars if "@GRAD" in n]
+        else:
+            assert "sgd" in types and "sgd_sparse" not in types
+    np.testing.assert_allclose(weights["init"][0], weights["init"][1])
+    np.testing.assert_allclose(curves[False], curves[True], rtol=1e-5)
+    np.testing.assert_allclose(weights[False], weights[True], rtol=1e-5,
+                               atol=1e-7)
+    # untouched rows stay exactly at init
+    untouched = sorted(set(range(vocab)) - set(ids.reshape(-1).tolist()))
+    np.testing.assert_array_equal(
+        weights[True][untouched], weights["init"][1][untouched]
+    )
+
+
+def test_rewrite_skipped_when_grad_shared(rng):
+    """Grad clip consumes the dense grad -> the pass must leave the dense
+    form in place (multi-consumer safety)."""
+    vocab, dim, B, S = 20, 4, 2, 3
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.data("ids", [B, S], dtype="int64")
+        y = fluid.data("y", [B, S, dim])
+        emb = fluid.layers.embedding(ids, size=[vocab, dim])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(emb, y))
+        )
+        fluid.optimizer.SGD(
+            learning_rate=0.1,
+            grad_clip=fluid.clip.GradientClipByGlobalNorm(1.0),
+        ).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(
+        main,
+        feed={
+            "ids": rng.randint(0, vocab, (B, S)).astype("int64"),
+            "y": rng.randn(B, S, dim).astype("float32"),
+        },
+        fetch_list=[loss],
+    )
+    assert np.isfinite(np.asarray(out[0])).all()
+    types = [op.type for op in main.global_block().ops]
+    assert "sgd" in types and "sgd_sparse" not in types, types
+
+
+def test_padding_idx_rows_not_updated(rng):
+    vocab, dim, B, S, pad = 30, 4, 2, 5, 3
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.data("ids", [B, S], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[vocab, dim], padding_idx=pad,
+            param_attr=fluid.ParamAttr(name="emb_pad"),
+        )
+        loss = fluid.layers.mean(emb)
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        w0 = np.asarray(sc.find_var("emb_pad")).copy()
+        idv = rng.randint(0, vocab, (B, S)).astype("int64")
+        idv[:, 0] = pad
+        exe.run(main, feed={"ids": idv}, fetch_list=[loss])
+        w1 = np.asarray(sc.find_var("emb_pad"))
+    assert any(op.type == "sgd_sparse" for op in main.global_block().ops)
+    np.testing.assert_array_equal(w0[pad], w1[pad])
+    touched = sorted(set(idv.reshape(-1).tolist()) - {pad})
+    assert not np.allclose(w0[touched], w1[touched])
+
+
+def test_pipeline_optimizer_keeps_dense_form(rng):
+    """Code-review r4: PipelineOptimizer(SGD) sets _num_microbatches AFTER
+    the inner minimize; the deferred rewrite must see it and keep the dense
+    sgd (sgd_sparse cannot microbatch)."""
+    vocab, dim, B, S = 20, 4, 4, 3
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.data("ids", [B, S], dtype="int64")
+        y = fluid.data("y", [B, S, dim])
+        emb = fluid.layers.embedding(ids, size=[vocab, dim])
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(emb, y))
+        )
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), num_microbatches=2
+        ).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(
+        main,
+        feed={
+            "ids": rng.randint(0, vocab, (B, S)).astype("int64"),
+            "y": rng.randn(B, S, dim).astype("float32"),
+        },
+        fetch_list=[loss],
+    )
+    assert np.isfinite(np.asarray(out[0])).all()
+    types = [op.type for op in main.global_block().ops]
+    assert "sgd" in types and "sgd_sparse" not in types, types
