@@ -206,8 +206,19 @@ class CompiledProgram:
                     return axis_sizes.get(ax, 1) > 1
                 return False
 
+            def _batch_stat_writeback(op):
+                # ops whose persistable write-back is computed FROM the
+                # batch (running stats, class centers): per-shard execution
+                # would store shard-varying values through a replicated
+                # out_spec — silently wrong state
+                return (
+                    op.type in ("batch_norm", "data_norm", "center_loss")
+                    and not op.attrs.get("is_test", False)
+                )
+
             manual_ops = {
-                op.type for op in block.ops if _opens_shard_map(op)
+                op.type for op in block.ops
+                if _opens_shard_map(op) or _batch_stat_writeback(op)
             }
             multi_axis = any(
                 s > 1 for a, s in axis_sizes.items() if a != batch_axis
@@ -232,18 +243,21 @@ class CompiledProgram:
             for n in sorted(dgc_state):
                 if not scope.has_var(n):
                     continue
-                arr = np.asarray(scope.find_var(n))
+                val = scope.find_var(n)
+                # .shape alone — no host transfer on the steady-state path
+                cur = tuple(np.shape(val))
                 declared = tuple(
                     d for d in (block._find_var_recursive(n).shape or ())
                 )
-                if tuple(arr.shape) == declared:
+                if cur == declared:
+                    arr = np.asarray(val)
                     scope.set(
                         n,
                         np.broadcast_to(arr, (n_batch,) + declared).copy(),
                     )
-                elif tuple(arr.shape) != (n_batch,) + declared:
+                elif cur != (n_batch,) + declared:
                     raise EnforceError(
-                        f"dgc accumulator {n} has shape {arr.shape}, "
+                        f"dgc accumulator {n} has shape {cur}, "
                         f"expected {declared} or {(n_batch,) + declared}"
                     )
         if entry is None:
@@ -284,14 +298,19 @@ class CompiledProgram:
                     static = [d for d in shape if d and d > 0]
                     dynamic = any(d in (-1, None) or (d and d < 0)
                                   for d in shape)
-                    if dynamic or int(np.prod(static or [1])) > 1:
+                    non_float = fv is None or (
+                        fv.dtype is not None and "float" not in str(fv.dtype)
+                    )
+                    if dynamic or non_float or \
+                            int(np.prod(static or [1])) > 1:
                         raise EnforceError(
-                            f"fetch '{n}' (declared shape {list(shape)}) is "
-                            "not a scalar: DGC sparse-exchange mode runs the "
-                            "block per-shard and can only fetch scalar "
-                            "losses/metrics (cross-shard means). Fetch "
-                            "scalars, or disable the sparse exchange with "
-                            "FLAGS_dgc_sparse_exchange=0"
+                            f"fetch '{n}' (declared shape {list(shape)}, "
+                            f"dtype {getattr(fv, 'dtype', None)}) is not a "
+                            "scalar float: DGC sparse-exchange mode runs "
+                            "the block per-shard and can only fetch scalar "
+                            "float losses/metrics (cross-shard means). "
+                            "Fetch those, or disable the sparse exchange "
+                            "with FLAGS_dgc_sparse_exchange=0"
                         )
 
                 def step(feed_vals, donated_vals, readonly_vals, rng_key):

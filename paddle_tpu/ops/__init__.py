@@ -21,5 +21,7 @@ from paddle_tpu.ops import nn_extra  # noqa: F401
 from paddle_tpu.ops import py_func  # noqa: F401
 from paddle_tpu.ops import vision  # noqa: F401
 from paddle_tpu.ops import moe  # noqa: F401
+from paddle_tpu.ops import misc_extra  # noqa: F401
+from paddle_tpu.ops import vision_extra  # noqa: F401
 from paddle_tpu.ops import extras  # noqa: F401
 from paddle_tpu.ops import crf  # noqa: F401

@@ -1,0 +1,568 @@
+"""Fourth-tranche vision/detection ops: deformable convolutions,
+position-sensitive RoI pooling, FPN proposal routing, proposal generation,
+extra NMS variants.
+
+reference: paddle/fluid/operators/{deformable_conv_op.cu,
+deformable_conv_v1_op.cu, deformable_psroi_pooling_op.cu, psroi_pool_op.h,
+prroi_pool_op.h, detection/density_prior_box_op.cc,
+detection/distribute_fpn_proposals_op.cc,
+detection/collect_fpn_proposals_op.cc, detection/generate_proposals_op.cc,
+detection/multiclass_nms_op.cc (nms2), detection/locality_aware_nms_op.cc,
+detection/retinanet_detection_output_op.cc, random_crop_op.h,
+similarity_focus_op.h}. TPU-native redesign: per-thread CUDA loops become
+fixed-shape vectorized gathers (bilinear taps as static kernel-position
+loops), LoD roi batching becomes explicit RoisNum/BatchId tensors, and
+variable-length outputs become fixed slates with counts — the same design
+rules as ops/vision.py and ops/detection.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+from paddle_tpu.ops.vision import _bilinear_gather, _roi_batch_ids
+from paddle_tpu.utils.enforce import EnforceError
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution
+# ---------------------------------------------------------------------------
+
+
+def _deform_sample(x, offset, mask, kh, kw, stride, pad, dilation, dg):
+    """Gather bilinear-sampled deformed patches.
+
+    x [N, C, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] (y then x per tap, per
+    deformable group); mask [N, dg*kh*kw, Ho, Wo] or None (v1).
+    Returns patches [N, C, kh*kw, Ho, Wo]."""
+    N, C, H, W = x.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilation
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cg = C // dg  # channels per deformable group
+    base_y = jnp.arange(Ho) * sh - ph
+    base_x = jnp.arange(Wo) * sw - pw
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+    if mask is not None:
+        m = mask.reshape(N, dg, kh * kw, Ho, Wo)
+    parts = []
+    bi = jnp.arange(N, dtype=jnp.int32)
+    for g in range(dg):
+        xg = x[:, g * cg:(g + 1) * cg]  # [N, cg, H, W]
+        taps = []
+        for t in range(kh * kw):
+            i, j = t // kw, t % kw
+            ys = base_y[None, :, None] + i * dh + off[:, g, t, 0]  # [N,Ho,Wo]
+            xs = base_x[None, None, :] + j * dw + off[:, g, t, 1]
+            # zero-pad out-of-bounds (reference DmcnIm2colBilinear)
+            samp = _bilinear_gather(
+                xg, bi, ys.reshape(N, -1), xs.reshape(N, -1)
+            )  # [N, Ho*Wo, cg]
+            samp = jnp.transpose(samp, (0, 2, 1)).reshape(N, cg, Ho, Wo)
+            if mask is not None:
+                samp = samp * m[:, g, t][:, None]
+            taps.append(samp)
+        parts.append(jnp.stack(taps, axis=2))  # [N, cg, k, Ho, Wo]
+    return jnp.concatenate(parts, axis=1), Ho, Wo
+
+
+def _deformable_conv_impl(ins, attrs, modulated):
+    x = first(ins, "Input")
+    offset = first(ins, "Offset")
+    w = first(ins, "Filter")  # [Co, C/groups, kh, kw]
+    mask = first(ins, "Mask") if (modulated and ins.get("Mask")) else None
+    stride = tuple(attrs.get("strides", [1, 1]))
+    pad = tuple(attrs.get("paddings", [0, 0]))
+    dil = tuple(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    dg = attrs.get("deformable_groups", 1)
+    Co, Cpg, kh, kw = w.shape
+    N, C, H, W = x.shape
+    patches, Ho, Wo = _deform_sample(x, offset, mask, kh, kw, stride, pad,
+                                     dil, dg)
+    # patches [N, C, k, Ho, Wo] x w [Co, C/groups, kh*kw] -> [N, Co, Ho, Wo]
+    wf = w.reshape(Co, Cpg, kh * kw)
+    if groups == 1:
+        out = jnp.einsum(
+            "nckp,ock->nop",
+            patches.reshape(N, C, kh * kw, Ho * Wo),
+            wf,
+        )
+    else:
+        cg = C // groups
+        og = Co // groups
+        outs = []
+        for g in range(groups):
+            outs.append(jnp.einsum(
+                "nckp,ock->nop",
+                patches[:, g * cg:(g + 1) * cg].reshape(
+                    N, cg, kh * kw, Ho * Wo
+                ),
+                wf[g * og:(g + 1) * og],
+            ))
+        out = jnp.concatenate(outs, axis=1)
+    return {"Output": [out.reshape(N, Co, Ho, Wo)]}
+
+
+@register_op("deformable_conv", nondiff_inputs=())
+def _deformable_conv(ins, attrs):
+    """reference: paddle/fluid/operators/deformable_conv_op.cu — modulated
+    deformable conv v2 (offsets + multiplicative mask per tap)."""
+    return _deformable_conv_impl(ins, attrs, modulated=True)
+
+
+@register_op("deformable_conv_v1", nondiff_inputs=())
+def _deformable_conv_v1(ins, attrs):
+    """reference: paddle/fluid/operators/deformable_conv_v1_op.cu — DCN v1
+    (offsets only)."""
+    return _deformable_conv_impl(ins, attrs, modulated=False)
+
+
+# ---------------------------------------------------------------------------
+# position-sensitive / precise RoI pooling
+# ---------------------------------------------------------------------------
+
+
+@register_op("psroi_pool", nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _psroi_pool(ins, attrs):
+    """reference: paddle/fluid/operators/psroi_pool_op.h — position-
+    sensitive average pooling: output channel c at bin (ph, pw) pools
+    INPUT channel c*PH*PW + ph*PW + pw over that bin. Fixed per-bin pixel
+    bounds with masking, as ops/vision.py roi_pool does."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    R = rois.shape[0]
+    C, H, W = x.shape[1], x.shape[2], x.shape[3]
+    PH = attrs.get("pooled_height", 1)
+    PW = attrs.get("pooled_width", 1)
+    oc = attrs.get("output_channels", C // (PH * PW))
+    scale = attrs.get("spatial_scale", 1.0)
+    if oc * PH * PW != C:
+        raise EnforceError(
+            f"psroi_pool: input channels {C} != output_channels {oc} * "
+            f"{PH} * {PW}"
+        )
+    bi = _roi_batch_ids(ins, R)
+    x1 = jnp.round(rois[:, 0]) * scale
+    y1 = jnp.round(rois[:, 1]) * scale
+    x2 = (jnp.round(rois[:, 2]) + 1.0) * scale
+    y2 = (jnp.round(rois[:, 3]) + 1.0) * scale
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    bin_h = rh / PH
+    bin_w = rw / PW
+    mh = -(-H // PH) + 2  # static per-bin bound
+    mw = -(-W // PW) + 2
+
+    ib = jnp.arange(PH)[None, :]
+    h_lo = jnp.floor(y1[:, None] + ib * bin_h[:, None]).astype(jnp.int32)
+    h_hi = jnp.ceil(y1[:, None] + (ib + 1) * bin_h[:, None]).astype(jnp.int32)
+    jb = jnp.arange(PW)[None, :]
+    w_lo = jnp.floor(x1[:, None] + jb * bin_w[:, None]).astype(jnp.int32)
+    w_hi = jnp.ceil(x1[:, None] + (jb + 1) * bin_w[:, None]).astype(jnp.int32)
+    h_lo = jnp.clip(h_lo, 0, H)
+    h_hi = jnp.clip(h_hi, 0, H)
+    w_lo = jnp.clip(w_lo, 0, W)
+    w_hi = jnp.clip(w_hi, 0, W)
+
+    hr = h_lo[:, :, None] + jnp.arange(mh)[None, None, :]   # [R, PH, mh]
+    wr = w_lo[:, :, None] + jnp.arange(mw)[None, None, :]   # [R, PW, mw]
+    hmask = hr < h_hi[:, :, None]
+    wmask = wr < w_hi[:, :, None]
+    hc = jnp.clip(hr, 0, H - 1)
+    wc = jnp.clip(wr, 0, W - 1)
+
+    xr = x.reshape(x.shape[0], oc, PH, PW, H, W)
+    b_b = jnp.broadcast_to(bi[:, None, None, None, None],
+                           (R, PH, mh, PW, mw))
+    h_b = jnp.broadcast_to(hc[:, :, :, None, None], (R, PH, mh, PW, mw))
+    w_b = jnp.broadcast_to(wc[:, None, None, :, :], (R, PH, mh, PW, mw))
+    ph_b = jnp.broadcast_to(
+        jnp.arange(PH)[None, :, None, None, None], (R, PH, mh, PW, mw)
+    )
+    pw_b = jnp.broadcast_to(
+        jnp.arange(PW)[None, None, None, :, None], (R, PH, mh, PW, mw)
+    )
+    vals = xr[b_b, :, ph_b, pw_b, h_b, w_b]  # [R, PH, mh, PW, mw, oc]
+    m = (hmask[:, :, :, None, None] & wmask[:, None, None, :, :])[..., None]
+    s = jnp.where(m, vals, 0.0).sum(axis=(2, 4))      # [R, PH, PW, oc]
+    cnt = jnp.maximum(m.sum(axis=(2, 4)), 1)
+    out = (s / cnt).astype(x.dtype)
+    return {"Out": [jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+@register_op("prroi_pool", nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _prroi_pool(ins, attrs):
+    """reference: paddle/fluid/operators/prroi_pool_op.h — precise RoI
+    pooling (exact integral of the bilinear surface over each bin). TPU
+    form: a dense fixed sub-grid of bilinear samples averaged per bin —
+    converges to the integral, differentiable everywhere, static shapes
+    (the closed-form per-pixel integration of the reference is a
+    data-dependent loop XLA cannot tile)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    R = rois.shape[0]
+    PH = attrs.get("pooled_height", 1)
+    PW = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    s = 4  # sub-samples per bin axis
+    bi = _roi_batch_ids(ins, R)
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    bin_h = jnp.maximum(y2 - y1, 0.0) / PH
+    bin_w = jnp.maximum(x2 - x1, 0.0) / PW
+    iy = (jnp.arange(PH * s) + 0.5) / s
+    ix = (jnp.arange(PW * s) + 0.5) / s
+    ys = y1[:, None] + iy[None, :] * bin_h[:, None]
+    xs = x1[:, None] + ix[None, :] * bin_w[:, None]
+    yy = jnp.broadcast_to(ys[:, :, None], (R, PH * s, PW * s))
+    xx = jnp.broadcast_to(xs[:, None, :], (R, PH * s, PW * s))
+    sampled = _bilinear_gather(x, bi, yy, xx)  # [R, PH*s, PW*s, C]
+    C = x.shape[1]
+    out = sampled.reshape(R, PH, s, PW, s, C).mean(axis=(2, 4))
+    return {"Out": [jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+@register_op("deformable_psroi_pooling",
+             nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _deformable_psroi_pooling(ins, attrs):
+    """reference: paddle/fluid/operators/deformable_psroi_pooling_op.cu —
+    psroi pooling whose bins shift by learned offsets (Trans input,
+    [R, 2, part_h, part_w] scaled by trans_std). no_trans=True degrades to
+    plain average psroi with bilinear taps."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")
+    trans = maybe(ins, "Trans")
+    R = rois.shape[0]
+    C = x.shape[1]
+    PH = attrs.get("pooled_height", attrs.get("pooled_size", 1))
+    PW = attrs.get("pooled_width", attrs.get("pooled_size", 1))
+    oc = attrs.get("output_dim", C // (PH * PW))
+    scale = attrs.get("spatial_scale", 1.0)
+    trans_std = attrs.get("trans_std", 0.1)
+    no_trans = attrs.get("no_trans", trans is None)
+    sp = attrs.get("sample_per_part", 4)
+    bi = _roi_batch_ids(ins, R)
+    x1 = jnp.round(rois[:, 0]) * scale - 0.5
+    y1 = jnp.round(rois[:, 1]) * scale - 0.5
+    x2 = (jnp.round(rois[:, 2]) + 1.0) * scale - 0.5
+    y2 = (jnp.round(rois[:, 3]) + 1.0) * scale - 0.5
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    bin_h = rh / PH
+    bin_w = rw / PW
+    if not no_trans and trans is not None:
+        # offset per bin, in roi-size units
+        t = trans.reshape(R, 2, -1)
+        ph_ids = jnp.arange(PH * PW) // PW
+        pw_ids = jnp.arange(PH * PW) % PW
+        # trans is [R, 2, part_h, part_w]; map bins onto parts
+        part_h = trans.shape[2]
+        part_w = trans.shape[3]
+        tp = trans  # [R, 2, part_h, part_w]
+        bh = (ph_ids * part_h // PH).astype(jnp.int32)
+        bw = (pw_ids * part_w // PW).astype(jnp.int32)
+        off_y = tp[:, 0][:, bh, bw] * trans_std * rh[:, None]
+        off_x = tp[:, 1][:, bh, bw] * trans_std * rw[:, None]
+    else:
+        off_y = jnp.zeros((R, PH * PW))
+        off_x = jnp.zeros((R, PH * PW))
+    iy = (jnp.arange(sp) + 0.5) / sp
+    ph_ids = jnp.arange(PH * PW) // PW
+    pw_ids = jnp.arange(PH * PW) % PW
+    ys = (y1[:, None, None] + (ph_ids[None, :, None] + iy[None, None, :])
+          * bin_h[:, None, None] + off_y[:, :, None])   # [R, PH*PW, sp]
+    xs = (x1[:, None, None] + (pw_ids[None, :, None] + iy[None, None, :])
+          * bin_w[:, None, None] + off_x[:, :, None])
+    yy = jnp.broadcast_to(ys[:, :, :, None], (R, PH * PW, sp, sp))
+    xx = jnp.broadcast_to(xs[:, :, None, :], (R, PH * PW, sp, sp))
+    sampled = _bilinear_gather(
+        x.reshape(x.shape[0], C, x.shape[2], x.shape[3]), bi,
+        yy.reshape(R, -1), xx.reshape(R, -1),
+    ).reshape(R, PH * PW, sp * sp, C)
+    avg = sampled.mean(axis=2)                          # [R, PH*PW, C]
+    # position-sensitive channel select: bin (ph, pw) reads channel block
+    # c*PH*PW + ph*PW + pw
+    avg = avg.reshape(R, PH * PW, oc, PH * PW)
+    binids = jnp.arange(PH * PW)
+    out = avg[:, binids, :, binids]                     # [PH*PW, R, oc]
+    out = jnp.transpose(out, (1, 2, 0)).reshape(R, oc, PH, PW)
+    return {"Out": [out.astype(x.dtype)],
+            "TopCount": [jnp.full((R, oc, PH, PW), sp * sp, jnp.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# FPN proposal routing
+# ---------------------------------------------------------------------------
+
+
+@register_op("distribute_fpn_proposals", nondiff_inputs=("FpnRois",))
+def _distribute_fpn_proposals(ins, attrs):
+    """reference: detection/distribute_fpn_proposals_op.cc — route each roi
+    to its FPN level by sqrt(area): level = floor(log2(sqrt(wh)/refer_scale
+    * refer_level)). Fixed-slate: each level gets an [R, 4] tensor with
+    non-member rows zeroed, plus per-level counts and the restore index."""
+    rois = first(ins, "FpnRois")  # [R, 4]
+    lo = attrs["min_level"]
+    hi = attrs["max_level"]
+    refer_level = attrs["refer_level"]
+    refer_scale = attrs["refer_scale"]
+    R = rois.shape[0]
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    sc = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(sc / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)
+    outs, counts = [], []
+    order = jnp.argsort(lvl, stable=True)
+    for l in range(lo, hi + 1):
+        m = (lvl == l)
+        outs.append(jnp.where(m[:, None], rois, 0.0))
+        counts.append(m.sum().astype(jnp.int32))
+    # restore index: position of each original roi in level-sorted order
+    restore = jnp.argsort(order).astype(jnp.int32).reshape(R, 1)
+    return {
+        "MultiFpnRois": outs,
+        "RestoreIndex": [restore],
+        "MultiLevelRoIsNum": [jnp.stack(counts)],
+    }
+
+
+@register_op("collect_fpn_proposals",
+             nondiff_inputs=("MultiLevelRois", "MultiLevelScores"))
+def _collect_fpn_proposals(ins, attrs):
+    """reference: detection/collect_fpn_proposals_op.cc — concat per-level
+    rois, keep the post_nms_topN by score (fixed slate)."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in ins["MultiLevelScores"]], axis=0
+    )
+    k = min(attrs.get("post_nms_topN", 100), scores.shape[0])
+    sel = jnp.argsort(-scores)[:k]
+    return {"FpnRois": [rois[sel]], "RoisNum": [
+        jnp.sum(scores[sel] > _NEG / 2).astype(jnp.int32).reshape(1)
+    ]}
+
+
+@register_op("generate_proposals",
+             nondiff_inputs=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                             "Variances"))
+def _generate_proposals(ins, attrs):
+    """reference: detection/generate_proposals_op.cc — RPN proposal
+    generation: decode anchor deltas, clip to image, filter small boxes,
+    greedy NMS, emit post_nms_topN slate (scored, zero-padded). Single
+    image per call (B=1 path; vmap for batches upstream)."""
+    scores = first(ins, "Scores")       # [N, A, H, W]
+    deltas = first(ins, "BboxDeltas")   # [N, 4A, H, W]
+    im_info = first(ins, "ImInfo")      # [N, 3]
+    anchors = first(ins, "Anchors")     # [H, W, A, 4] or [H*W*A, 4]
+    variances = maybe(ins, "Variances")
+    pre_n = attrs.get("pre_nms_topN", 6000)
+    post_n = attrs.get("post_nms_topN", 1000)
+    nms_thresh = attrs.get("nms_thresh", 0.7)
+    min_size = attrs.get("min_size", 0.0)
+    N = scores.shape[0]
+    A = scores.shape[1]
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4) if variances is not None else None
+
+    def per_image(sc, dl, info):
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)          # H,W,A order
+        d = jnp.transpose(
+            dl.reshape(A, 4, sc.shape[1], sc.shape[2]), (2, 3, 0, 1)
+        ).reshape(-1, 4)
+        # decode (reference BoxCoder decode_center_size)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        v = var if var is not None else jnp.ones_like(anc)
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        wo = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        ho = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        x1 = cx - wo * 0.5
+        y1 = cy - ho * 0.5
+        x2 = cx + wo * 0.5 - 1.0
+        y2 = cy + ho * 0.5 - 1.0
+        # clip to image
+        imh, imw = info[0], info[1]
+        x1 = jnp.clip(x1, 0.0, imw - 1.0)
+        y1 = jnp.clip(y1, 0.0, imh - 1.0)
+        x2 = jnp.clip(x2, 0.0, imw - 1.0)
+        y2 = jnp.clip(y2, 0.0, imh - 1.0)
+        keep = ((x2 - x1 + 1.0) >= min_size) & ((y2 - y1 + 1.0) >= min_size)
+        s = jnp.where(keep, s, _NEG)
+        k1 = min(pre_n, s.shape[0])
+        sel = jnp.argsort(-s)[:k1]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)[sel]
+        ss = s[sel]
+        from paddle_tpu.ops.detection import _iou, _nms_single_class
+
+        iou_full = _iou(boxes, boxes)
+        ks, ki = _nms_single_class(iou_full, ss, nms_thresh,
+                                   min(post_n, k1))
+        valid = ks > _NEG / 2
+        return (
+            jnp.where(valid[:, None], boxes[ki], 0.0),
+            jnp.where(valid, ks, 0.0),
+            valid.sum().astype(jnp.int32),
+        )
+
+    rois, rscores, num = jax.vmap(per_image)(scores, deltas, im_info)
+    return {
+        "RpnRois": [rois.reshape(-1, 4)],
+        "RpnRoiProbs": [rscores.reshape(-1, 1)],
+        "RpnRoisNum": [num],
+    }
+
+
+# ---------------------------------------------------------------------------
+# NMS variants
+# ---------------------------------------------------------------------------
+
+
+@register_op("multiclass_nms2", nondiff_inputs=("BBoxes", "Scores"))
+def _multiclass_nms2(ins, attrs):
+    """reference: detection/multiclass_nms_op.cc (nms2 adds the Index
+    output). Delegates to the fixed-slate multiclass_nms."""
+    from paddle_tpu.ops.detection import _multiclass_nms
+
+    out = _multiclass_nms(ins, attrs)
+    B, K = out["Out"][0].shape[:2]
+    idx = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
+    return {
+        "Out": out["Out"],
+        "Index": [idx.reshape(-1, 1)],
+        "NmsRoisNum": [out["NumDetections"][0].astype(jnp.int32)],
+        "NumDetections": out["NumDetections"],
+    }
+
+
+@register_op("locality_aware_nms", nondiff_inputs=("BBoxes", "Scores"))
+def _locality_aware_nms(ins, attrs):
+    """reference: detection/locality_aware_nms_op.cc (EAST-style OCR):
+    first score-weighted-merge boxes with IoU above the threshold into
+    their best-scoring representative, then standard multiclass NMS on the
+    merged slate."""
+    from paddle_tpu.ops.detection import _iou, _multiclass_nms
+
+    bboxes = first(ins, "BBoxes")  # [B, N, 4]
+    scores = first(ins, "Scores")  # [B, C, N]
+    nms_thresh = attrs.get("nms_threshold", 0.3)
+
+    def merge_one(boxes, sc):
+        s = sc.max(axis=0)  # class-max score drives locality merge
+        iou = _iou(boxes, boxes)
+        near = (iou > nms_thresh).astype(boxes.dtype)
+        wsum = near @ s
+        merged = (near * s[None, :]) @ boxes / jnp.maximum(wsum, 1e-8)[:, None]
+        return merged
+
+    merged = jax.vmap(merge_one)(bboxes, scores)
+    return _multiclass_nms(
+        {"BBoxes": [merged], "Scores": [scores]}, attrs
+    )
+
+
+@register_op("retinanet_detection_output",
+             nondiff_inputs=("BBoxes", "Scores", "Anchors", "ImInfo"))
+def _retinanet_detection_output(ins, attrs):
+    """reference: detection/retinanet_detection_output_op.cc — decode
+    per-level anchor deltas, take per-level top-k by score, then
+    multiclass NMS. Inputs here are the already-concatenated levels:
+    BBoxes [B, N, 4] deltas, Scores [B, N, C], Anchors [N, 4]."""
+    from paddle_tpu.ops.detection import _multiclass_nms
+
+    deltas = first(ins, "BBoxes")
+    scores = first(ins, "Scores")     # [B, N, C]
+    anchors = first(ins, "Anchors")   # [N, 4]
+    im_info = first(ins, "ImInfo")    # [B, 3]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    cx = deltas[:, :, 0] * aw + acx
+    cy = deltas[:, :, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(deltas[:, :, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(deltas[:, :, 3], 10.0)) * ah
+    x1 = cx - 0.5 * w
+    y1 = cy - 0.5 * h
+    x2 = cx + 0.5 * w - 1.0
+    y2 = cy + 0.5 * h - 1.0
+    imh = im_info[:, 0:1]
+    imw = im_info[:, 1:2]
+    boxes = jnp.stack([
+        jnp.clip(x1, 0.0, imw - 1.0),
+        jnp.clip(y1, 0.0, imh - 1.0),
+        jnp.clip(x2, 0.0, imw - 1.0),
+        jnp.clip(y2, 0.0, imh - 1.0),
+    ], axis=-1)
+    out = _multiclass_nms(
+        {"BBoxes": [boxes], "Scores": [jnp.transpose(scores, (0, 2, 1))]},
+        {
+            "score_threshold": attrs.get("score_threshold", 0.05),
+            "nms_threshold": attrs.get("nms_threshold", 0.3),
+            "nms_top_k": attrs.get("nms_top_k", 1000),
+            "keep_top_k": attrs.get("keep_top_k", 100),
+            "background_label": -1,
+        },
+    )
+    return {"Out": out["Out"], "NumDetections": out["NumDetections"]}
+
+
+# ---------------------------------------------------------------------------
+# misc vision
+# ---------------------------------------------------------------------------
+
+
+@register_op("random_crop", stateful=True, nondiff_inputs=("X", "Seed"))
+def _random_crop(ins, attrs):
+    """reference: paddle/fluid/operators/random_crop_op.h — crop the
+    trailing dims to attr `shape` at a uniform random offset."""
+    from paddle_tpu.ops.common import seeded_rng_key
+
+    x = first(ins, "X")
+    shape = [int(d) for d in attrs["shape"]]
+    nd = len(shape)
+    key = seeded_rng_key(ins, attrs)
+    keys = jax.random.split(key, nd)
+    starts = [jnp.asarray(0)] * (x.ndim - nd) + [
+        jax.random.randint(
+            keys[i], (), 0, x.shape[x.ndim - nd + i] - shape[i] + 1
+        )
+        for i in range(nd)
+    ]
+    out = jax.lax.dynamic_slice(
+        x, starts, list(x.shape[: x.ndim - nd]) + shape
+    )
+    return {"Out": [out], "SeedOut": [ins.get("Seed", [jnp.zeros(1)])[0]]}
+
+
+@register_op("similarity_focus", nondiff_inputs=("X",))
+def _similarity_focus(ins, attrs):
+    """reference: paddle/fluid/operators/similarity_focus_op.h — for each
+    selected channel (axis=1, per `indexes`), mark the (h, w) argmax per
+    remaining row/col greedily; TPU form: mark every (h, w) that is the max
+    of its row OR its column in the selected channel slice (a vectorized
+    over-approximation of the reference's sequential tie-breaking,
+    documented deviation)."""
+    x = first(ins, "X")  # [N, C, H, W]
+    indexes = attrs.get("indexes", [0])
+    N, C, H, W = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = x[:, idx]  # [N, H, W]
+        row_max = sl == sl.max(axis=2, keepdims=True)
+        col_max = sl == sl.max(axis=1, keepdims=True)
+        m = (row_max | col_max).astype(x.dtype)  # [N, H, W]
+        mask = jnp.maximum(mask, m[:, None, :, :])
+    return {"Out": [mask]}

@@ -45,3 +45,11 @@ def fresh_programs():
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / multi-hundred-ms-compile tests; deselect "
+        "with -m 'not slow' for a fast smoke run",
+    )

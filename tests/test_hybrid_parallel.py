@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 
 import paddle_tpu  # noqa: F401  (jax config)

@@ -356,3 +356,35 @@ def test_ir_dgc_moe_program_falls_back_dense(rng):
     assert any("dense fused form" in str(r.message) for r in rec), [
         str(r.message) for r in rec
     ]
+
+
+def test_ir_dgc_batchnorm_falls_back_dense(rng):
+    """batch_norm running stats are batch-dependent write-backs: per-shard
+    DGC would store shard-varying values — must warn and run dense."""
+    import warnings as _w
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [8, 4])
+        y = fluid.data("y", [8, 1])
+        h = fluid.layers.batch_norm(fluid.layers.fc(x, size=4))
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, sparsity=[0.9],
+        ).minimize(loss)
+    mesh = make_mesh((8,), ("data",))
+    prog = fluid.CompiledProgram(main).with_parallel(
+        mesh=mesh, loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(8, 4).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        out = exe.run(prog, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert any("dense fused form" in str(r.message) for r in rec)

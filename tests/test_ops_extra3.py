@@ -1,0 +1,521 @@
+"""OpTest-style numeric tests for the third/fourth op tranches
+(ops/misc_extra.py, ops/vision_extra.py) — numpy references per op,
+modeled on the reference's test_*_op.py files."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op_def
+
+import paddle_tpu  # noqa: F401  (registers ops)
+
+
+def lower(op, ins, attrs=None):
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return get_op_def(op).lower(ins, attrs or {})
+
+
+def test_trivial_math_shape(rng):
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(
+        lower("minus", {"X": [x], "Y": [y]})["Out"][0], x - y
+    )
+    out = lower("fill", {}, {"shape": [2, 3], "value": list(range(6)),
+                             "dtype": "float32"})["Out"][0]
+    np.testing.assert_allclose(out, np.arange(6).reshape(2, 3))
+    np.testing.assert_allclose(
+        lower("fill_any_like", {"X": [x]}, {"value": 2.5})["Out"][0],
+        np.full_like(x, 2.5),
+    )
+    b = rng.rand(2, 3) > 0.5
+    np.testing.assert_array_equal(
+        lower("reduce_all", {"X": [b]}, {"dim": [1]})["Out"][0],
+        b.all(axis=1),
+    )
+    np.testing.assert_array_equal(
+        lower("reduce_any", {"X": [b]}, {"reduce_all": True})["Out"][0],
+        b.any(),
+    )
+    x3 = rng.randn(2, 1, 3, 1).astype("float32")
+    assert lower("squeeze", {"X": [x3]}, {"axes": [1]})["Out"][0].shape == \
+        (2, 3, 1)
+    assert lower("squeeze", {"X": [x3]}, {})["Out"][0].shape == (2, 3)
+    assert lower("flatten", {"X": [x3]}, {"axis": 2})["Out"][0].shape == \
+        (2, 3)
+    c = lower("crop", {"X": [x]}, {"shape": [2, 2], "offsets": [1, 1]})
+    np.testing.assert_allclose(c["Out"][0], x[1:3, 1:3])
+
+
+def test_cross_entropy2_and_teacher_student(rng):
+    p = rng.rand(4, 5).astype("float32") * 0.8 + 0.1
+    lab = rng.randint(0, 5, (4, 1)).astype("int64")
+    out = lower("cross_entropy2", {"X": [p], "Label": [lab]})
+    expect = -np.log(p[np.arange(4), lab[:, 0]])
+    np.testing.assert_allclose(out["Y"][0].reshape(-1), expect, rtol=1e-5)
+
+    x = rng.randn(6).astype("float32")
+    # labels: -2 (z=0), -1 (z=1), 0.3 (z=0,z'=0.3), 1.4 (z=1,z'=0.4)
+    lab2 = np.array([-2.0, -1.0, 0.3, 1.4, -2.0, 1.0], "float32")
+    y = lower("teacher_student_sigmoid_loss",
+              {"X": [x.reshape(-1, 1)], "Label": [lab2.reshape(-1, 1)]}
+              )["Y"][0].reshape(-1)
+
+    def ce(xv, z):
+        return max(xv, 0) - xv * z + np.log1p(np.exp(-abs(xv)))
+
+    expect2 = [
+        ce(x[0], 0.0), ce(x[1], 1.0),
+        ce(x[2], 0.0) + ce(x[2], 0.3),
+        ce(x[3], 1.0) + ce(x[3], 0.4 if False else 1.4 - 1.0),
+        ce(x[4], 0.0), ce(x[5], 1.0) + ce(x[5], 0.0),
+    ]
+    np.testing.assert_allclose(y, expect2, rtol=1e-5)
+
+
+def test_fsp_matrix(rng):
+    x = rng.randn(2, 3, 4, 5).astype("float32")
+    y = rng.randn(2, 6, 4, 5).astype("float32")
+    out = lower("fsp", {"X": [x], "Y": [y]})["Out"][0]
+    expect = np.einsum("nchw,ndhw->ncd", x, y) / 20.0
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_sample_logits_accidental_hits(rng):
+    logits = rng.randn(3, 50).astype("float32")
+    labels = rng.randint(0, 50, (3, 2)).astype("int64")
+    outs = lower(
+        "sample_logits",
+        {"Logits": [logits], "Labels": [labels],
+         "__rng_key__": [jax.random.PRNGKey(0)]},
+        {"num_samples": 8, "remove_accidental_hits": True},
+    )
+    samples = np.asarray(outs["Samples"][0])
+    sampled = np.asarray(outs["SampledLogits"][0])
+    assert samples.shape == (3, 10) and sampled.shape == (3, 10)
+    np.testing.assert_array_equal(samples[:, :2], labels)
+    # any accidental hit among negatives is crushed to huge negative
+    for i in range(3):
+        for j in range(2, 10):
+            if samples[i, j] in labels[i]:
+                assert sampled[i, j] < -1e18
+
+
+def test_proximal_updates(rng):
+    p = rng.randn(5).astype("float32")
+    g = rng.randn(5).astype("float32")
+    lr = np.array([0.1], "float32")
+    out = lower("proximal_gd", {"Param": [p], "Grad": [g],
+                                "LearningRate": [lr]},
+                {"l1": 0.05, "l2": 0.1})["ParamOut"][0]
+    prox = p - 0.1 * g
+    expect = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0) / (
+        1 + 0.1 * 0.1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    m = np.abs(rng.randn(5)).astype("float32")
+    outs = lower("proximal_adagrad",
+                 {"Param": [p], "Grad": [g], "Moment": [m],
+                  "LearningRate": [lr]}, {"l1": 0.0, "l2": 0.1})
+    m2 = m + g * g
+    lr_eff = 0.1 / np.sqrt(m2)
+    np.testing.assert_allclose(
+        outs["ParamOut"][0], (p - lr_eff * g) / (1 + lr_eff * 0.1),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(outs["MomentOut"][0], m2, rtol=1e-6)
+
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m, n]
+
+
+def test_edit_distance_matches_dp(rng):
+    B, Tm, Tn = 5, 7, 6
+    hyps = rng.randint(0, 4, (B, Tm)).astype("int64")
+    refs = rng.randint(0, 4, (B, Tn)).astype("int64")
+    hl = rng.randint(1, Tm + 1, (B,)).astype("int64")
+    rl = rng.randint(1, Tn + 1, (B,)).astype("int64")
+    out = lower("edit_distance",
+                {"Hyps": [hyps], "Refs": [refs],
+                 "HypsLength": [hl], "RefsLength": [rl]})["Out"][0]
+    expect = [
+        _levenshtein(list(hyps[i, :hl[i]]), list(refs[i, :rl[i]]))
+        for i in range(B)
+    ]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), expect)
+
+
+def test_edit_distance_normalized_and_full_length(rng):
+    hyps = np.array([[1, 2, 3]], dtype="int64")
+    refs = np.array([[1, 3, 3, 4]], dtype="int64")
+    out = lower("edit_distance", {"Hyps": [hyps], "Refs": [refs]},
+                {"normalized": True})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [2.0 / 4.0])
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.2, 0.5, 0.6], "float32").reshape(-1, 1)
+    label = np.array([1, 0, 0, 1], "float32").reshape(-1, 1)
+    qid = np.array([0, 0, 0, 0], "int64").reshape(-1, 1)
+    outs = lower("positive_negative_pair",
+                 {"Score": [score], "Label": [label], "QueryID": [qid]})
+    # pairs (hi-label vs lo-label): (0,1)+, (0,2)+, (3,1)+, (3,2)+ -> 4 pos
+    assert float(np.asarray(outs["PositivePair"][0])[0]) == 4.0
+    assert float(np.asarray(outs["NegativePair"][0])[0]) == 0.0
+
+
+def test_match_matrix_tensor(rng):
+    x = rng.randn(2, 3, 4).astype("float32")
+    y = rng.randn(2, 5, 6).astype("float32")
+    w = rng.randn(4, 2, 6).astype("float32")
+    out = lower("match_matrix_tensor", {"X": [x], "Y": [y], "W": [w]}
+                )["Out"][0]
+    expect = np.einsum("bid,dte,bje->btij", x, w, y)
+    np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+
+def test_rnn_units(rng):
+    B, H = 3, 4
+    # lstm_unit
+    x = rng.randn(B, 4 * H).astype("float32")
+    c_prev = rng.randn(B, H).astype("float32")
+    outs = lower("lstm_unit", {"X": [x], "C_prev": [c_prev]},
+                 {"forget_bias": 1.0})
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, f, o, g = (x[:, :H], x[:, H:2*H], x[:, 2*H:3*H], x[:, 3*H:])
+    c = sig(f + 1.0) * c_prev + sig(i) * np.tanh(g)
+    np.testing.assert_allclose(outs["C"][0], c, rtol=1e-4)
+    np.testing.assert_allclose(outs["H"][0], sig(o) * np.tanh(c), rtol=1e-4)
+
+    # gru_unit
+    xp = rng.randn(B, 3 * H).astype("float32")
+    h_prev = rng.randn(B, H).astype("float32")
+    w = rng.randn(H, 3 * H).astype("float32")
+    outs = lower("gru_unit", {"Input": [xp], "HiddenPrev": [h_prev],
+                              "Weight": [w]})
+    gates = xp[:, :2*H] + h_prev @ w[:, :2*H]
+    u = sig(gates[:, :H])
+    r = sig(gates[:, H:])
+    c2 = np.tanh(xp[:, 2*H:] + (r * h_prev) @ w[:, 2*H:])
+    np.testing.assert_allclose(
+        outs["Hidden"][0], u * h_prev + (1 - u) * c2, rtol=1e-4
+    )
+
+    # lstmp shapes
+    T, P = 5, 2
+    xs = rng.randn(B, T, 4 * H).astype("float32")
+    wp = rng.randn(P, 4 * H).astype("float32")
+    proj = rng.randn(H, P).astype("float32")
+    outs = lower("lstmp", {"Input": [xs], "Weight": [wp],
+                           "ProjWeight": [proj]})
+    assert outs["Projection"][0].shape == (B, T, P)
+    assert np.isfinite(np.asarray(outs["Projection"][0])).all()
+
+
+def test_hash_deterministic():
+    x = np.array([[1, 2], [1, 2], [3, 4]], dtype="int64")
+    o1 = np.asarray(lower("hash", {"X": [x]},
+                          {"mod_by": 1000, "num_hash": 3})["Out"][0])
+    o2 = np.asarray(lower("hash", {"X": [x]},
+                          {"mod_by": 1000, "num_hash": 3})["Out"][0])
+    assert o1.shape == (3, 3, 1)
+    np.testing.assert_array_equal(o1, o2)
+    np.testing.assert_array_equal(o1[0], o1[1])  # same row -> same hash
+    assert (o1[0] != o1[2]).any()
+    assert (o1 >= 0).all() and (o1 < 1000).all()
+
+
+def test_sampling_id(rng):
+    probs = np.zeros((4, 6), "float32")
+    probs[np.arange(4), [1, 3, 5, 0]] = 1.0
+    out = lower("sampling_id",
+                {"X": [probs], "__rng_key__": [jax.random.PRNGKey(0)]})
+    np.testing.assert_array_equal(np.asarray(out["Out"][0]), [1, 3, 5, 0])
+
+
+def test_gaussian_random_batch_size_like(rng):
+    ref = np.zeros((7, 3), "float32")
+    out = lower("gaussian_random_batch_size_like",
+                {"Input": [ref], "__rng_key__": [jax.random.PRNGKey(0)]},
+                {"shape": [-1, 5], "mean": 2.0, "std": 0.1})["Out"][0]
+    assert out.shape == (7, 5)
+    assert abs(float(np.asarray(out).mean()) - 2.0) < 0.1
+
+
+def test_max_pool3d_with_index(rng):
+    x = rng.randn(1, 1, 4, 4, 4).astype("float32")
+    outs = lower("max_pool3d_with_index", {"X": [x]},
+                 {"ksize": [2, 2, 2], "strides": [2, 2, 2]})
+    out = np.asarray(outs["Out"][0])
+    mask = np.asarray(outs["Mask"][0])
+    assert out.shape == (1, 1, 2, 2, 2)
+    expect = x.reshape(1, 1, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 1, 2, 2, 2, 8).max(-1)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    # mask indexes into the flattened input volume
+    flat = x.reshape(-1)
+    np.testing.assert_allclose(flat[mask.reshape(-1)], out.reshape(-1))
+
+
+def test_shrink_rnn_memory():
+    x = np.arange(12, dtype="float32").reshape(4, 3)
+    table = np.array([5, 4, 2, 1], dtype="int64")  # sorted desc lengths
+    out = lower("shrink_rnn_memory",
+                {"X": [x], "I": [np.array([3], "int64")],
+                 "RankTable": [table]})["Out"][0]
+    # step 3: sequences with length > 3 -> first 2 rows stay
+    np.testing.assert_allclose(np.asarray(out)[:2], x[:2])
+    np.testing.assert_allclose(np.asarray(out)[2:], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# vision_extra
+# ---------------------------------------------------------------------------
+
+
+def test_deformable_conv_zero_offset_matches_conv(rng):
+    """With zero offsets and unit mask, DCN == standard convolution."""
+    N, C, H, W, Co, k = 1, 2, 5, 5, 3, 3
+    x = rng.randn(N, C, H, W).astype("float32")
+    w = rng.randn(Co, C, k, k).astype("float32")
+    offset = np.zeros((N, 2 * k * k, H - 2, W - 2), "float32")
+    mask = np.ones((N, k * k, H - 2, W - 2), "float32")
+    out = lower("deformable_conv",
+                {"Input": [x], "Offset": [offset], "Mask": [mask],
+                 "Filter": [w]},
+                {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1]})["Output"][0]
+
+    expect = np.zeros((N, Co, H - 2, W - 2), "float32")
+    for o in range(Co):
+        for i in range(H - 2):
+            for j in range(W - 2):
+                expect[0, o, i, j] = np.sum(
+                    x[0, :, i:i + k, j:j + k] * w[o]
+                )
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_v1_shift_offset(rng):
+    """A whole-pixel offset equals sampling the shifted image (out-of-
+    bounds rows fade to 0, the kernel's zero-padding)."""
+    x = np.arange(25, dtype="float32").reshape(1, 1, 5, 5)
+    w = np.ones((1, 1, 1, 1), "float32")
+    offset = np.zeros((1, 2, 5, 5), "float32")
+    offset[:, 0] = 1.0  # shift +1 in y for the single 1x1 tap
+    out = lower("deformable_conv_v1",
+                {"Input": [x], "Offset": [offset], "Filter": [w]},
+                {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1]})["Output"][0]
+    expect = np.vstack([x[0, 0, 1:5, :], np.zeros((1, 5), "float32")])
+    np.testing.assert_allclose(np.asarray(out)[0, 0], expect)
+
+
+def test_psroi_pool(rng):
+    PH = PW = 2
+    oc = 2
+    C = oc * PH * PW
+    x = rng.randn(1, C, 6, 6).astype("float32")
+    rois = np.array([[0, 0, 3, 3]], "float32")
+    out = lower("psroi_pool", {"X": [x], "ROIs": [rois]},
+                {"pooled_height": PH, "pooled_width": PW,
+                 "output_channels": oc, "spatial_scale": 1.0})["Out"][0]
+    assert out.shape == (1, oc, PH, PW)
+    # bin (0,0) of channel c pools input channel c*4+0 over rows 0..1
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 0, 0, 0], x[0, 0, 0:2, 0:2].mean(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 1, 1, 1], x[0, 7, 2:4, 2:4].mean(), rtol=1e-5
+    )
+
+
+def test_prroi_pool_constant_field(rng):
+    x = np.full((1, 3, 8, 8), 2.5, "float32")
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], "float32")
+    out = lower("prroi_pool", {"X": [x], "ROIs": [rois]},
+                {"pooled_height": 2, "pooled_width": 2,
+                 "spatial_scale": 1.0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-5)
+
+
+def test_distribute_and_collect_fpn(rng):
+    rois = np.array([
+        [0, 0, 10, 10],      # small -> low level
+        [0, 0, 224, 224],    # refer scale -> refer level
+        [0, 0, 500, 500],    # large -> high level
+    ], "float32")
+    outs = lower("distribute_fpn_proposals", {"FpnRois": [rois]},
+                 {"min_level": 2, "max_level": 5, "refer_level": 4,
+                  "refer_scale": 224})
+    counts = np.asarray(outs["MultiLevelRoIsNum"][0])
+    assert counts.sum() == 3
+    assert counts[2] == 1  # the 224 box sits at refer_level=4 (index 2)
+    multi = [np.asarray(t) for t in outs["MultiFpnRois"]]
+    scores = [np.asarray([0.9]), np.asarray([0.1]),
+              np.asarray([0.5]), np.asarray([0.2])]
+    col = lower("collect_fpn_proposals",
+                {"MultiLevelRois": [t[:1] for t in multi],
+                 "MultiLevelScores": scores},
+                {"post_nms_topN": 2})
+    assert np.asarray(col["FpnRois"][0]).shape == (2, 4)
+
+
+def test_generate_proposals_basic(rng):
+    H = W = 4
+    A = 2
+    scores = rng.rand(1, A, H, W).astype("float32")
+    deltas = np.zeros((1, 4 * A, H, W), "float32")
+    anchors = np.zeros((H, W, A, 4), "float32")
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    for a in range(A):
+        anchors[:, :, a, 0] = xs * 4
+        anchors[:, :, a, 1] = ys * 4
+        anchors[:, :, a, 2] = xs * 4 + 7
+        anchors[:, :, a, 3] = ys * 4 + 7
+    im_info = np.array([[16.0, 16.0, 1.0]], "float32")
+    outs = lower("generate_proposals",
+                 {"Scores": [scores], "BboxDeltas": [deltas],
+                  "ImInfo": [im_info], "Anchors": [anchors]},
+                 {"pre_nms_topN": 12, "post_nms_topN": 5,
+                  "nms_thresh": 0.5, "min_size": 2.0})
+    rois = np.asarray(outs["RpnRois"][0])
+    assert rois.shape == (5, 4)
+    assert (rois >= 0).all() and (rois <= 15).all()
+    assert int(outs["RpnRoisNum"][0][0]) >= 1
+
+
+def test_multiclass_nms2_and_locality_aware(rng):
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.array([[[0.9, 0.85, 0.7]]], "float32")  # [B=1, C=1, N=3]
+    outs = lower("multiclass_nms2", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.1, "nms_threshold": 0.5,
+                  "keep_top_k": 3, "background_label": -1})
+    out = np.asarray(outs["Out"][0])
+    assert int(outs["NumDetections"][0][0]) == 2  # overlap suppressed
+    la = lower("locality_aware_nms", {"BBoxes": [boxes], "Scores": [scores]},
+               {"score_threshold": 0.1, "nms_threshold": 0.5,
+                "keep_top_k": 3, "background_label": -1})
+    assert int(la["NumDetections"][0][0]) >= 1
+
+
+def test_retinanet_detection_output(rng):
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    deltas = np.zeros((1, 2, 4), "float32")
+    scores = np.array([[[0.9, 0.1], [0.8, 0.2]]], "float32")  # [B, N, C]
+    im_info = np.array([[40.0, 40.0, 1.0]], "float32")
+    outs = lower("retinanet_detection_output",
+                 {"BBoxes": [deltas], "Scores": [scores],
+                  "Anchors": [anchors], "ImInfo": [im_info]},
+                 {"score_threshold": 0.05, "nms_threshold": 0.5,
+                  "keep_top_k": 5})
+    assert int(outs["NumDetections"][0][0]) >= 2
+
+
+def test_random_crop_and_similarity_focus(rng):
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    out = lower("random_crop",
+                {"X": [x], "__rng_key__": [jax.random.PRNGKey(1)]},
+                {"shape": [5, 5]})["Out"][0]
+    assert out.shape == (2, 3, 5, 5)
+    sf = lower("similarity_focus", {"X": [x]}, {"indexes": [1]})["Out"][0]
+    sf = np.asarray(sf)
+    assert sf.shape == x.shape and set(np.unique(sf)) <= {0.0, 1.0}
+    # the global argmax of the selected channel is always marked
+    n, hw = 0, np.unravel_index(np.argmax(x[0, 1]), (8, 8))
+    assert sf[0, 0, hw[0], hw[1]] == 1.0
+
+
+def test_quant_ops_roundtrip(rng):
+    x = rng.randn(4, 6).astype("float32")
+    q = lower("fake_quantize_abs_max", {"X": [x]}, {"bit_length": 8})
+    scale = float(np.asarray(q["OutScale"][0])[0])
+    assert abs(scale - np.abs(x).max()) < 1e-6
+    deq = lower("fake_dequantize_max_abs",
+                {"X": [q["Out"][0]], "Scale": [q["OutScale"][0]]},
+                {"max_range": 127.0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(deq), x, atol=scale / 100)
+
+    cq = lower("fake_channel_wise_quantize_abs_max", {"X": [x]},
+               {"bit_length": 8})
+    assert np.asarray(cq["OutScale"][0]).shape == (4,)
+    cdq = lower("fake_channel_wise_dequantize_max_abs",
+                {"X": [cq["Out"][0]], "Scales": [cq["OutScale"][0]]},
+                {"quant_bits": [8]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(cdq), x, atol=0.05)
+
+    mv = lower("fake_quantize_moving_average_abs_max",
+               {"X": [x], "InScale": [np.ones(1, "float32")],
+                "InState": [np.ones(1, "float32")],
+                "InAccum": [np.ones(1, "float32")]},
+               {"moving_rate": 0.9})
+    assert "OutState" in mv and "OutAccum" in mv
+    rng_q = lower("fake_quantize_range_abs_max",
+                  {"X": [x], "InScale": [np.zeros(1, "float32")]},
+                  {"bit_length": 8})
+    assert float(np.asarray(rng_q["OutScale"][0])[0]) >= np.abs(x).max() - 1e-6
+    dq = lower("dequantize_abs_max",
+               {"X": [np.array([[127.0]], "float32")],
+                "Scale": [np.array([2.0], "float32")]},
+               {"max_range": 127.0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(dq), [[2.0]])
+
+
+@pytest.mark.parametrize("op,make", [
+    ("fsp", lambda rng: (
+        {"X": [rng.randn(1, 2, 3, 3).astype("float32")],
+         "Y": [rng.randn(1, 2, 3, 3).astype("float32")]}, {}, "Out")),
+    ("match_matrix_tensor", lambda rng: (
+        {"X": [rng.randn(1, 2, 3).astype("float32")],
+         "Y": [rng.randn(1, 2, 4).astype("float32")],
+         "W": [rng.randn(3, 2, 4).astype("float32")]}, {}, "Out")),
+    ("psroi_pool", lambda rng: (
+        {"X": [rng.randn(1, 4, 6, 6).astype("float32")],
+         "ROIs": [np.array([[0, 0, 4, 4]], "float32")]},
+        {"pooled_height": 2, "pooled_width": 2, "output_channels": 1,
+         "spatial_scale": 1.0}, "Out")),
+    ("deformable_conv", lambda rng: (
+        {"Input": [rng.randn(1, 2, 5, 5).astype("float32")],
+         "Offset": [rng.randn(1, 2 * 9, 3, 3).astype("float32") * 0.3],
+         "Mask": [rng.rand(1, 9, 3, 3).astype("float32")],
+         "Filter": [rng.randn(2, 2, 3, 3).astype("float32")]},
+        {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1]},
+        "Output")),
+])
+def test_numeric_gradients(rng, op, make):
+    """Finite-difference check of the first float input's gradient through
+    the registered lowering (the OpTest pattern, reference:
+    python/paddle/fluid/tests/unittests/op_test.py check_grad)."""
+    ins, attrs, out_name = make(rng)
+    key0 = next(iter(ins))
+
+    def f(x0):
+        ins2 = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+        ins2[key0] = [x0] + ins2[key0][1:]
+        return jnp.sum(get_op_def(op).lower(ins2, attrs)[out_name][0])
+
+    x0 = jnp.asarray(ins[key0][0])
+    g = np.asarray(jax.grad(f)(x0))
+    eps = 1e-3
+    flat = np.asarray(x0).reshape(-1).copy()
+    for idx in rng.choice(flat.size, size=min(6, flat.size), replace=False):
+        fp = flat.copy(); fp[idx] += eps
+        fm = flat.copy(); fm[idx] -= eps
+        num = (f(jnp.asarray(fp.reshape(x0.shape)))
+               - f(jnp.asarray(fm.reshape(x0.shape)))) / (2 * eps)
+        np.testing.assert_allclose(
+            g.reshape(-1)[idx], float(num), rtol=5e-2, atol=5e-3
+        )

@@ -16,6 +16,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as fluid
 from paddle_tpu.core.ir import Program, program_guard
 from paddle_tpu.incubate.checkpoint import AutoCheckpoint, HeartBeatMonitor
