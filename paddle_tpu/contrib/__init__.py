@@ -5,3 +5,4 @@ Reference: python/paddle/fluid/contrib/ (slim/quantization, mixed_precision).
 
 from paddle_tpu.contrib import quantize  # noqa: F401
 from paddle_tpu.contrib import slim  # noqa: F401
+from paddle_tpu.contrib import nas  # noqa: F401

@@ -1,0 +1,146 @@
+"""Neural architecture search: token search spaces + simulated-annealing
+controller + a light-NAS driver.
+
+reference: python/paddle/fluid/contrib/slim/nas/{search_space.py,
+light_nas_strategy.py, search_agent.py} and contrib/slim/searcher/
+controller.py SAController. The reference splits the loop across a
+controller SERVER and socket search agents (multi-machine trials); here
+trials run in-process on the Executor — each candidate is one jit-compiled
+train/eval program, so a trial is one XLA compile + a short train, and the
+annealing loop is plain Python around it. FLOPs constraints take the place
+of the reference's latency lookup tables.
+"""
+
+import math
+
+import numpy as np
+
+__all__ = ["SearchSpace", "SAController", "light_nas_search"]
+
+
+class SearchSpace:
+    """Architecture search space contract (reference: nas/search_space.py).
+
+    Subclasses define:
+      init_tokens()  -> list[int]         starting architecture
+      range_table()  -> list[int]         tokens[i] ranges over [0, table[i])
+      create_net(tokens) -> (startup_program, train_program, eval_program,
+                             train_fetch, eval_fetch)  — eval_fetch's first
+                             element is the reward metric (higher = better)
+    """
+
+    def init_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController:
+    """Simulated-annealing token controller (reference:
+    slim/searcher/controller.py:59 SAController — same accept rule:
+    accept if reward improves, else with prob exp(dr/T), T decaying by
+    reduce_rate per iteration)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        # -inf, not the reference's -1: rewards like -loss are routinely
+        # below -1, and a -1 floor would leave best_tokens None forever
+        self._reward = -np.inf
+        self._tokens = None
+        self._max_reward = -np.inf
+        self._best_tokens = None
+        self._constrain_func = None
+        self._iter = 0
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._tokens = list(init_tokens)
+        self._constrain_func = constrain_func
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * (
+            self._reduce_rate ** self._iter
+        )
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+            min((reward - self._reward) / max(temperature, 1e-9), 0.0)
+        ):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token or self._tokens)
+        for _ in range(100):
+            new_tokens = list(tokens)
+            index = int(len(self._range_table) * self._rng.random_sample())
+            r = self._range_table[index]
+            if r > 1:
+                new_tokens[index] = (
+                    new_tokens[index] + self._rng.randint(r - 1) + 1
+                ) % r
+            if self._constrain_func is None or self._constrain_func(
+                new_tokens
+            ):
+                return new_tokens
+        return tokens  # constraint too tight: stay put
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+
+def light_nas_search(space, exe, train_feeds, eval_feeds, steps_per_trial=20,
+                     search_steps=10, controller=None, constrain_func=None,
+                     scope_factory=None):
+    """Run the light-NAS loop (reference: nas/light_nas_strategy.py
+    on_compression_begin): for `search_steps` rounds, materialize the
+    candidate network, train it `steps_per_trial` steps, read the reward
+    from the FIRST eval fetch, and anneal.
+
+    train_feeds/eval_feeds: iterables of feed dicts (cycled).
+    Returns (best_tokens, max_reward, history)."""
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    controller = controller or SAController()
+    controller.reset(space.range_table(), space.init_tokens(),
+                     constrain_func)
+    history = []
+    tokens = list(space.init_tokens())
+    for step in range(search_steps):
+        startup, train_prog, eval_prog, train_fetch, eval_fetch = \
+            space.create_net(tokens)
+        sc = scope_factory() if scope_factory else Scope()
+        with scope_guard(sc):
+            exe.run(startup)
+            ti = 0
+            for _ in range(steps_per_trial):
+                feed = train_feeds[ti % len(train_feeds)]
+                ti += 1
+                exe.run(train_prog, feed=feed, fetch_list=list(train_fetch))
+            rewards = []
+            for feed in eval_feeds:
+                out = exe.run(eval_prog, feed=feed,
+                              fetch_list=[eval_fetch[0]])
+                rewards.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        reward = float(np.mean(rewards))
+        controller.update(tokens, reward)
+        history.append((list(tokens), reward))
+        tokens = controller.next_tokens()
+    return controller.best_tokens, controller.max_reward, history

@@ -1,0 +1,427 @@
+"""Layer builders for the third/fourth op tranches — the fluid.layers.*
+user surface over ops/misc_extra.py and ops/vision_extra.py.
+
+reference: python/paddle/fluid/layers/{nn.py, loss.py, detection.py} —
+edit_distance, sampled_softmax_with_cross_entropy, teacher_student_
+sigmoid_loss, crop, hash, psroi_pool, prroi_pool, deformable_conv,
+deformable_roi_pooling, fsp (slim distillation uses the op directly),
+sampling_id, gaussian_random_batch_size_like, random_crop,
+similarity_focus, generate_proposals, distribute_fpn_proposals,
+collect_fpn_proposals, retinanet_detection_output, locality_aware_nms.
+"""
+
+import numpy as np
+
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = [
+    "edit_distance",
+    "sampled_softmax_with_cross_entropy",
+    "teacher_student_sigmoid_loss",
+    "fsp_matrix",
+    "crop",
+    "hash",
+    "sampling_id",
+    "gaussian_random_batch_size_like",
+    "random_crop",
+    "similarity_focus",
+    "psroi_pool",
+    "prroi_pool",
+    "deformable_conv",
+    "deformable_roi_pooling",
+    "generate_proposals",
+    "distribute_fpn_proposals",
+    "collect_fpn_proposals",
+    "retinanet_detection_output",
+    "locality_aware_nms",
+    "proximal_gd",  # exposed for parity; normally reached via optimizers
+]
+
+
+def _out(helper, dtype, stop_gradient=False):
+    v = helper.create_variable_for_type_inference(dtype)
+    v.stop_gradient = stop_gradient
+    return v
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference: python/paddle/fluid/layers/loss.py:352 — padded+lengths
+    form only (LoD-free); ignored_tokens is unsupported here (filter ids
+    upstream)."""
+    helper = LayerHelper("edit_distance")
+    out = _out(helper, "float32", stop_gradient=True)
+    seq_num = _out(helper, "int64", stop_gradient=True)
+    ins = {"Hyps": [input.name], "Refs": [label.name]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length.name]
+        ins["RefsLength"] = [label_length.name]
+    helper.append_op(
+        "edit_distance", ins,
+        {"Out": [out.name], "SequenceNum": [seq_num.name]},
+        {"normalized": normalized},
+    )
+    return out, seq_num
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference: python/paddle/fluid/layers/loss.py:1007 — sample_logits op
+    followed by softmax_with_cross_entropy on the sampled slate (true
+    labels re-indexed to positions [0, num_true))."""
+    from paddle_tpu.layers import nn as nn_layers
+
+    helper = LayerHelper("sample_logits")
+    samples = _out(helper, "int64", stop_gradient=True)
+    probabilities = _out(helper, "float32", stop_gradient=True)
+    sampled_logits = _out(helper, logits.dtype)
+    sampled_label = _out(helper, "int64", stop_gradient=True)
+    ins = {"Logits": [logits.name], "Labels": [label.name]}
+    if use_customized_samples:
+        ins["CustomizedSamples"] = [customized_samples.name]
+        ins["CustomizedProbabilities"] = [customized_probabilities.name]
+    helper.append_op(
+        "sample_logits", ins,
+        {"Samples": [samples.name], "Probabilities": [probabilities.name],
+         "SampledLogits": [sampled_logits.name],
+         "SampledLabels": [sampled_label.name]},
+        {"num_samples": num_samples,
+         "use_customized_samples": use_customized_samples,
+         "remove_accidental_hits": remove_accidental_hits, "seed": seed},
+    )
+    loss = nn_layers.softmax_with_cross_entropy(
+        sampled_logits, sampled_label
+    )
+    return loss
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: python/paddle/fluid/layers/loss.py teacher_student_
+    sigmoid_loss."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "teacher_student_sigmoid_loss",
+        {"X": [input.name], "Label": [label.name]},
+        {"Y": [out.name]},
+        {"soft_max_up_bound": soft_max_up_bound,
+         "soft_max_lower_bound": soft_max_lower_bound},
+    )
+    return out
+
+
+def fsp_matrix(x, y):
+    """reference: python/paddle/fluid/contrib/slim uses the fsp op for
+    distillation; exposed as a layer for direct use."""
+    helper = LayerHelper("fsp")
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "fsp", {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]}, {}
+    )
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference: python/paddle/fluid/layers/nn.py:8024 (static form)."""
+    helper = LayerHelper("crop", name=name)
+    out = _out(helper, x.dtype)
+    attrs = {}
+    ins = {"X": [x.name]}
+    if hasattr(shape, "name"):
+        ins["Y"] = [shape.name]
+    else:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op("crop", ins, {"Out": [out.name]}, attrs)
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: python/paddle/fluid/layers/nn.py hash."""
+    helper = LayerHelper("hash", name=name)
+    out = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "hash", {"X": [input.name]}, {"Out": [out.name]},
+        {"mod_by": hash_size, "num_hash": num_hash},
+    )
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """reference: python/paddle/fluid/layers/nn.py sampling_id."""
+    helper = LayerHelper("sampling_id")
+    out = _out(helper, dtype, stop_gradient=True)
+    helper.append_op(
+        "sampling_id", {"X": [x.name]}, {"Out": [out.name]}, {"seed": seed}
+    )
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """reference: python/paddle/fluid/layers/nn.py."""
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = _out(helper, dtype)
+    helper.append_op(
+        "gaussian_random_batch_size_like", {"Input": [input.name]},
+        {"Out": [out.name]},
+        {"shape": list(shape), "input_dim_idx": input_dim_idx,
+         "output_dim_idx": output_dim_idx, "mean": mean, "std": std,
+         "seed": seed},
+    )
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """reference: python/paddle/fluid/layers/nn.py random_crop."""
+    helper = LayerHelper("random_crop")
+    out = _out(helper, x.dtype)
+    seed_out = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "random_crop", {"X": [x.name]},
+        {"Out": [out.name], "SeedOut": [seed_out.name]},
+        {"shape": list(shape), "seed": seed or 0},
+    )
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: python/paddle/fluid/layers/nn.py similarity_focus."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "similarity_focus", {"X": [input.name]}, {"Out": [out.name]},
+        {"axis": axis, "indexes": list(indexes)},
+    )
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None, rois_num=None):
+    """reference: python/paddle/fluid/layers/nn.py:12626."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = _out(helper, input.dtype)
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    helper.append_op(
+        "psroi_pool", ins, {"Out": [out.name]},
+        {"output_channels": output_channels, "spatial_scale": spatial_scale,
+         "pooled_height": pooled_height, "pooled_width": pooled_width},
+    )
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, name=None, rois_num=None):
+    """reference: python/paddle/fluid/layers/nn.py:12692."""
+    helper = LayerHelper("prroi_pool", name=name)
+    out = _out(helper, input.dtype)
+    ins = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num.name]
+    helper.append_op(
+        "prroi_pool", ins, {"Out": [out.name]},
+        {"spatial_scale": spatial_scale, "pooled_height": pooled_height,
+         "pooled_width": pooled_width},
+    )
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """reference: python/paddle/fluid/layers/nn.py:13105 — DCN v2
+    (modulated=True, with mask) or v1 (modulated=False)."""
+    from paddle_tpu.initializer import NormalInitializer
+    from paddle_tpu.layers import nn as nn_layers
+
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         name=name)
+    C = input.shape[1]
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    k = _pair(filter_size)
+    w = helper.create_parameter(
+        helper.param_attr if param_attr is not None else ParamAttr(
+            initializer=NormalInitializer(
+                0.0, 1.0 / float(np.sqrt(C * k[0] * k[1]))
+            )
+        ),
+        shape=[num_filters, C // groups, k[0], k[1]], dtype=input.dtype,
+    )
+    out = _out(helper, input.dtype)
+    ins = {"Input": [input.name], "Offset": [offset.name],
+           "Filter": [w.name]}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = [mask.name]
+    helper.append_op(
+        op_type, ins, {"Output": [out.name]},
+        {"strides": _pair(stride), "paddings": _pair(padding),
+         "dilations": _pair(dilation), "groups": groups,
+         "deformable_groups": deformable_groups},
+    )
+    if bias_attr:
+        b = helper.create_parameter(
+            bias_attr if isinstance(bias_attr, ParamAttr) else ParamAttr(),
+            shape=[num_filters], dtype=input.dtype,
+        )
+        out = nn_layers.elementwise_add(out, b, axis=1)
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """reference: python/paddle/fluid/layers/nn.py deformable_roi_pooling."""
+    helper = LayerHelper("deformable_psroi_pooling", name=name)
+    out = _out(helper, input.dtype)
+    top_count = _out(helper, "float32", stop_gradient=True)
+    C = input.shape[1]
+    output_dim = (
+        C // (pooled_height * pooled_width) if position_sensitive else C
+    )
+    helper.append_op(
+        "deformable_psroi_pooling",
+        {"X": [input.name], "ROIs": [rois.name], "Trans": [trans.name]},
+        {"Out": [out.name], "TopCount": [top_count.name]},
+        {"no_trans": no_trans, "spatial_scale": spatial_scale,
+         "output_dim": output_dim, "pooled_height": pooled_height,
+         "pooled_width": pooled_width,
+         "sample_per_part": sample_per_part, "trans_std": trans_std},
+    )
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    """reference: python/paddle/fluid/layers/detection.py
+    generate_proposals."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _out(helper, scores.dtype, stop_gradient=True)
+    probs = _out(helper, scores.dtype, stop_gradient=True)
+    num = _out(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        "generate_proposals",
+        {"Scores": [scores.name], "BboxDeltas": [bbox_deltas.name],
+         "ImInfo": [im_info.name], "Anchors": [anchors.name],
+         "Variances": [variances.name]},
+        {"RpnRois": [rois.name], "RpnRoiProbs": [probs.name],
+         "RpnRoisNum": [num.name]},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+    )
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """reference: python/paddle/fluid/layers/detection.py
+    distribute_fpn_proposals."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_lvl = max_level - min_level + 1
+    outs = [_out(helper, fpn_rois.dtype, stop_gradient=True)
+            for _ in range(n_lvl)]
+    restore = _out(helper, "int32", stop_gradient=True)
+    counts = _out(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        "distribute_fpn_proposals", {"FpnRois": [fpn_rois.name]},
+        {"MultiFpnRois": [o.name for o in outs],
+         "RestoreIndex": [restore.name],
+         "MultiLevelRoIsNum": [counts.name]},
+        {"min_level": min_level, "max_level": max_level,
+         "refer_level": refer_level, "refer_scale": refer_scale},
+    )
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """reference: python/paddle/fluid/layers/detection.py
+    collect_fpn_proposals."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = _out(helper, multi_rois[0].dtype, stop_gradient=True)
+    num = _out(helper, "int32", stop_gradient=True)
+    helper.append_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": [r.name for r in multi_rois],
+         "MultiLevelScores": [s.name for s in multi_scores]},
+        {"FpnRois": [out.name], "RoisNum": [num.name]},
+        {"post_nms_topN": post_nms_top_n},
+    )
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """reference: python/paddle/fluid/layers/detection.py
+    retinanet_detection_output (concatenated-levels form)."""
+    helper = LayerHelper("retinanet_detection_output")
+    out = _out(helper, scores.dtype, stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "retinanet_detection_output",
+        {"BBoxes": [bboxes.name], "Scores": [scores.name],
+         "Anchors": [anchors.name], "ImInfo": [im_info.name]},
+        {"Out": [out.name], "NumDetections": [num.name]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+    )
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """reference: python/paddle/fluid/layers/detection.py
+    locality_aware_nms."""
+    helper = LayerHelper("locality_aware_nms", name=name)
+    out = _out(helper, scores.dtype, stop_gradient=True)
+    num = _out(helper, "int64", stop_gradient=True)
+    helper.append_op(
+        "locality_aware_nms",
+        {"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        {"Out": [out.name], "NumDetections": [num.name]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label},
+    )
+    return out
+
+
+def proximal_gd(param, grad, learning_rate, l1=0.0, l2=0.0):
+    """Direct op access (the reference reaches proximal updates through
+    optimizer classes; exposed for parity testing)."""
+    helper = LayerHelper("proximal_gd")
+    out = _out(helper, param.dtype)
+    helper.append_op(
+        "proximal_gd",
+        {"Param": [param.name], "Grad": [grad.name],
+         "LearningRate": [learning_rate.name]},
+        {"ParamOut": [out.name]},
+        {"l1": l1, "l2": l2},
+    )
+    return out

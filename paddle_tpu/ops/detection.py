@@ -249,7 +249,7 @@ def _multiclass_nms(ins, attrs):
 
     def per_image(boxes, sc):
         iou_full = _iou(boxes, boxes)  # once per image, shared by classes
-        slates_s, slates_l, slates_b = [], [], []
+        slates_s, slates_l, slates_b, slates_i = [], [], [], []
         for c in range(C):
             if c == background:
                 continue
@@ -259,9 +259,11 @@ def _multiclass_nms(ins, attrs):
             slates_s.append(ks)
             slates_l.append(jnp.full(ks.shape, c, jnp.float32))
             slates_b.append(boxes[ki])
+            slates_i.append(ki)
         all_s = jnp.concatenate(slates_s)
         all_l = jnp.concatenate(slates_l)
         all_b = jnp.concatenate(slates_b)
+        all_i = jnp.concatenate(slates_i)
         k = min(keep_top_k, all_s.shape[0])
         sel = jnp.argsort(-all_s)[:k]
         s = all_s[sel]
@@ -274,10 +276,11 @@ def _multiclass_nms(ins, attrs):
             ],
             axis=1,
         )
-        return out, valid.sum().astype(jnp.int64)
+        kept = jnp.where(valid, all_i[sel], -1).astype(jnp.int32)
+        return out, valid.sum().astype(jnp.int64), kept
 
-    out, num = jax.vmap(per_image)(bboxes, scores)
-    return {"Out": [out], "NumDetections": [num]}
+    out, num, kept = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": [out], "NumDetections": [num], "Index": [kept]}
 
 
 @register_op("bipartite_match", nondiff_inputs=("DistMat",))

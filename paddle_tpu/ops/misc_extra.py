@@ -65,10 +65,12 @@ def _reduce_any(ins, attrs):
 
 
 def _bool_reduce(ins, attrs, fn):
+    from paddle_tpu.ops.common import reduce_axes
+
     x = first(ins, "X").astype(bool)
     if attrs.get("reduce_all", False):
         return fn(x)
-    dims = tuple(attrs.get("dim", [0]))
+    dims = reduce_axes(attrs, x.ndim)
     return fn(x, axis=dims, keepdims=attrs.get("keep_dim", False))
 
 
@@ -122,12 +124,15 @@ def _gaussian_random_bsl(ins, attrs):
     shape = list(attrs["shape"])
     idx_in = attrs.get("input_dim_idx", 0)
     idx_out = attrs.get("output_dim_idx", 0)
+    from paddle_tpu.ops.common import np_dtype
+
     shape[idx_out] = ref.shape[idx_in]
     key = seeded_rng_key(ins, attrs)
+    dt = jnp.dtype(np_dtype(attrs))
     out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
         key, tuple(shape), jnp.float32
     )
-    return {"Out": [out]}
+    return {"Out": [out.astype(dt)]}
 
 
 # ---------------------------------------------------------------------------
@@ -516,16 +521,16 @@ def _lstmp(ins, attrs):
         if attrs.get("proj_clip", 0.0) > 0:
             pc = attrs["proj_clip"]
             r = jnp.clip(r, -pc, pc)
-        return (r, c), (r, h)
+        return (r, c), (r, c)
 
     r0 = jnp.zeros((B, P), x.dtype)
     c0 = jnp.zeros((B, H), x.dtype)
-    (_, _), (rs, hs) = jax.lax.scan(
+    (_, _), (rs, cs) = jax.lax.scan(
         step, (r0, c0), jnp.transpose(x, (1, 0, 2))
     )
     return {
         "Projection": [jnp.transpose(rs, (1, 0, 2))],
-        "Cell": [jnp.transpose(hs, (1, 0, 2))],
+        "Cell": [jnp.transpose(cs, (1, 0, 2))],
     }
 
 

@@ -259,25 +259,21 @@ def _deformable_psroi_pooling(ins, attrs):
     rw = jnp.maximum(x2 - x1, 0.1)
     bin_h = rh / PH
     bin_w = rw / PW
+    ph_ids = jnp.arange(PH * PW) // PW
+    pw_ids = jnp.arange(PH * PW) % PW
     if not no_trans and trans is not None:
-        # offset per bin, in roi-size units
-        t = trans.reshape(R, 2, -1)
-        ph_ids = jnp.arange(PH * PW) // PW
-        pw_ids = jnp.arange(PH * PW) % PW
-        # trans is [R, 2, part_h, part_w]; map bins onto parts
+        # trans [R, 2, part_h, part_w]: per-bin offsets in roi-size units;
+        # map each pooled bin onto its part cell
         part_h = trans.shape[2]
         part_w = trans.shape[3]
-        tp = trans  # [R, 2, part_h, part_w]
         bh = (ph_ids * part_h // PH).astype(jnp.int32)
         bw = (pw_ids * part_w // PW).astype(jnp.int32)
-        off_y = tp[:, 0][:, bh, bw] * trans_std * rh[:, None]
-        off_x = tp[:, 1][:, bh, bw] * trans_std * rw[:, None]
+        off_y = trans[:, 0][:, bh, bw] * trans_std * rh[:, None]
+        off_x = trans[:, 1][:, bh, bw] * trans_std * rw[:, None]
     else:
         off_y = jnp.zeros((R, PH * PW))
         off_x = jnp.zeros((R, PH * PW))
     iy = (jnp.arange(sp) + 0.5) / sp
-    ph_ids = jnp.arange(PH * PW) // PW
-    pw_ids = jnp.arange(PH * PW) % PW
     ys = (y1[:, None, None] + (ph_ids[None, :, None] + iy[None, None, :])
           * bin_h[:, None, None] + off_y[:, :, None])   # [R, PH*PW, sp]
     xs = (x1[:, None, None] + (pw_ids[None, :, None] + iy[None, None, :])
@@ -322,13 +318,15 @@ def _distribute_fpn_proposals(ins, attrs):
     lvl = jnp.floor(jnp.log2(sc / refer_scale + 1e-6)) + refer_level
     lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)
     outs, counts = [], []
-    order = jnp.argsort(lvl, stable=True)
     for l in range(lo, hi + 1):
         m = (lvl == l)
         outs.append(jnp.where(m[:, None], rois, 0.0))
         counts.append(m.sum().astype(jnp.int32))
-    # restore index: position of each original roi in level-sorted order
-    restore = jnp.argsort(order).astype(jnp.int32).reshape(R, 1)
+    # restore contract (reference: concat(level outputs)[restore[i]] ==
+    # original roi i): our fixed slates keep every roi at its ORIGINAL row
+    # within its level's [R, 4] slate, so the concat position of roi i is
+    # (level(i) - lo) * R + i
+    restore = ((lvl - lo) * R + jnp.arange(R, dtype=jnp.int32)).reshape(R, 1)
     return {
         "MultiFpnRois": outs,
         "RestoreIndex": [restore],
@@ -345,11 +343,17 @@ def _collect_fpn_proposals(ins, attrs):
     scores = jnp.concatenate(
         [s.reshape(-1) for s in ins["MultiLevelScores"]], axis=0
     )
+    # zero-padded slate rows (distribute_fpn_proposals' non-member slots)
+    # are degenerate boxes — they must not compete with real proposals
+    degenerate = (rois[:, 2] <= rois[:, 0]) & (rois[:, 3] <= rois[:, 1])
+    scores = jnp.where(degenerate, _NEG, scores)
     k = min(attrs.get("post_nms_topN", 100), scores.shape[0])
     sel = jnp.argsort(-scores)[:k]
-    return {"FpnRois": [rois[sel]], "RoisNum": [
-        jnp.sum(scores[sel] > _NEG / 2).astype(jnp.int32).reshape(1)
-    ]}
+    valid = scores[sel] > _NEG / 2
+    return {
+        "FpnRois": [jnp.where(valid[:, None], rois[sel], 0.0)],
+        "RoisNum": [valid.sum().astype(jnp.int32).reshape(1)],
+    }
 
 
 @register_op("generate_proposals",
@@ -433,15 +437,15 @@ def _generate_proposals(ins, attrs):
 @register_op("multiclass_nms2", nondiff_inputs=("BBoxes", "Scores"))
 def _multiclass_nms2(ins, attrs):
     """reference: detection/multiclass_nms_op.cc (nms2 adds the Index
-    output). Delegates to the fixed-slate multiclass_nms."""
+    output — WHICH input boxes survived, so consumers can gather original
+    features). Delegates to the fixed-slate multiclass_nms, whose per-class
+    slates carry the original box ids; empty slots are -1."""
     from paddle_tpu.ops.detection import _multiclass_nms
 
     out = _multiclass_nms(ins, attrs)
-    B, K = out["Out"][0].shape[:2]
-    idx = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (B, K))
     return {
         "Out": out["Out"],
-        "Index": [idx.reshape(-1, 1)],
+        "Index": [out["Index"][0].reshape(-1, 1)],
         "NmsRoisNum": [out["NumDetections"][0].astype(jnp.int32)],
         "NumDetections": out["NumDetections"],
     }

@@ -519,3 +519,173 @@ def test_numeric_gradients(rng, op, make):
         np.testing.assert_allclose(
             g.reshape(-1)[idx], float(num), rtol=5e-2, atol=5e-3
         )
+
+
+def test_layer_builders_program_path(rng):
+    """The fluid.layers.* surface over the new ops builds and runs."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.ir import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        hyp = fluid.data("hyp", [2, 4], dtype="int64")
+        ref = fluid.data("ref", [2, 4], dtype="int64")
+        dist, _ = fluid.layers.edit_distance(hyp, ref, normalized=False)
+
+        logits = fluid.data("logits", [4, 100])
+        lab = fluid.data("lab", [4, 1], dtype="int64")
+        ssce = fluid.layers.sampled_softmax_with_cross_entropy(
+            logits, lab, num_samples=10
+        )
+
+        x = fluid.data("x", [2, 8, 6, 6])
+        rois = fluid.data("rois", [3, 4])
+        ps = fluid.layers.psroi_pool(x, rois, output_channels=2,
+                                     spatial_scale=1.0, pooled_height=2,
+                                     pooled_width=2)
+        pr = fluid.layers.prroi_pool(x, rois, 1.0, 2, 2)
+        ts = fluid.layers.fsp_matrix(
+            fluid.data("fa", [2, 3, 5, 5]), fluid.data("fb", [2, 4, 5, 5])
+        )
+        h = fluid.layers.hash(fluid.data("ids", [5, 2], dtype="int64"),
+                              hash_size=1000, num_hash=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed={
+        "hyp": rng.randint(0, 5, (2, 4)).astype("int64"),
+        "ref": rng.randint(0, 5, (2, 4)).astype("int64"),
+        "logits": rng.randn(4, 100).astype("float32"),
+        "lab": rng.randint(0, 100, (4, 1)).astype("int64"),
+        "x": rng.randn(2, 8, 6, 6).astype("float32"),
+        "rois": np.abs(rng.rand(3, 4) * 4).astype("float32"),
+        "fa": rng.randn(2, 3, 5, 5).astype("float32"),
+        "fb": rng.randn(2, 4, 5, 5).astype("float32"),
+        "ids": rng.randint(0, 9, (5, 2)).astype("int64"),
+    }, fetch_list=[dist, ssce, ps, pr, ts, h])
+    assert outs[0].shape == (2, 1)
+    assert outs[1].shape == (4, 1) and np.isfinite(outs[1]).all()
+    assert outs[2].shape == (3, 2, 2, 2)
+    assert outs[3].shape == (3, 8, 2, 2)
+    assert outs[4].shape == (2, 3, 4)
+    assert outs[5].shape == (5, 2, 1)
+
+
+def test_layer_deformable_conv_trains(rng):
+    import paddle_tpu as fluid
+    from paddle_tpu.core.ir import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = fluid.data("img", [1, 3, 8, 8])
+        off = fluid.data("off", [1, 18, 6, 6])
+        msk = fluid.data("msk", [1, 9, 6, 6])
+        y = fluid.layers.deformable_conv(
+            img, off, msk, num_filters=4, filter_size=3
+        )
+        loss = fluid.layers.mean(fluid.layers.square(y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"img": rng.randn(1, 3, 8, 8).astype("float32"),
+            "off": (rng.randn(1, 18, 6, 6) * 0.2).astype("float32"),
+            "msk": rng.rand(1, 9, 6, 6).astype("float32")}
+    c = [float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]
+                          ).reshape(-1)[0]) for _ in range(8)]
+    assert np.isfinite(c).all() and c[-1] < c[0]
+
+
+def test_lstmp_cell_output_is_cell_state(rng):
+    """Code-review r4: Cell must be the cell state c, not o*tanh(c)."""
+    B, T, H, P = 2, 3, 4, 2
+    xs = rng.randn(B, T, 4 * H).astype("float32")
+    wp = rng.randn(P, 4 * H).astype("float32")
+    proj = rng.randn(H, P).astype("float32")
+    outs = lower("lstmp", {"Input": [xs], "Weight": [wp],
+                           "ProjWeight": [proj]})
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    r = np.zeros((B, P), "float32")
+    c = np.zeros((B, H), "float32")
+    for t in range(T):
+        gates = xs[:, t] + r @ wp
+        i, f = sig(gates[:, :H]), sig(gates[:, H:2*H])
+        g = np.tanh(gates[:, 2*H:3*H])
+        o = sig(gates[:, 3*H:])
+        c = f * c + i * g
+        r = (o * np.tanh(c)) @ proj
+    np.testing.assert_allclose(
+        np.asarray(outs["Cell"][0])[:, -1], c, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs["Projection"][0])[:, -1], r, rtol=1e-4
+    )
+
+
+def test_multiclass_nms2_index_points_at_kept_boxes(rng):
+    """Code-review r4: Index identifies WHICH input boxes survived."""
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    # box 1 has the best score but overlaps box 0; box 2 is separate
+    scores = np.array([[[0.5, 0.9, 0.7]]], "float32")
+    outs = lower("multiclass_nms2", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.1, "nms_threshold": 0.5,
+                  "keep_top_k": 3, "background_label": -1})
+    idx = np.asarray(outs["Index"][0]).reshape(-1)
+    n = int(np.asarray(outs["NumDetections"][0])[0])
+    assert n == 2
+    assert set(idx[:n].tolist()) == {1, 2}, idx
+    assert (idx[n:] == -1).all()
+
+
+def test_fpn_restore_roundtrip(rng):
+    """concat(level slates)[restore[i]] == original roi i."""
+    rois = np.abs(rng.rand(6, 2)) * 20
+    rois = np.concatenate([rois, rois + [[30, 30]] * 6], axis=1
+                          ).astype("float32")
+    outs = lower("distribute_fpn_proposals", {"FpnRois": [rois]},
+                 {"min_level": 2, "max_level": 5, "refer_level": 4,
+                  "refer_scale": 24})
+    concat = np.concatenate([np.asarray(t) for t in outs["MultiFpnRois"]])
+    restore = np.asarray(outs["RestoreIndex"][0]).reshape(-1)
+    np.testing.assert_allclose(concat[restore], rois, rtol=1e-6)
+
+
+def test_collect_fpn_skips_padding_rows(rng):
+    """Zero-padded slate rows must not outrank real proposals."""
+    lvl1 = np.array([[1, 1, 5, 5], [0, 0, 0, 0]], "float32")
+    lvl2 = np.array([[0, 0, 0, 0], [2, 2, 9, 9]], "float32")
+    scores = [np.array([0.2, 0.0], "float32"),
+              np.array([0.0, 0.1], "float32")]
+    outs = lower("collect_fpn_proposals",
+                 {"MultiLevelRois": [lvl1, lvl2],
+                  "MultiLevelScores": scores},
+                 {"post_nms_topN": 3})
+    rois = np.asarray(outs["FpnRois"][0])
+    n = int(np.asarray(outs["RoisNum"][0])[0])
+    assert n == 2, (n, rois)
+    got = {tuple(r) for r in rois[:n].tolist()}
+    assert got == {(1, 1, 5, 5), (2, 2, 9, 9)}, got
+
+
+def test_reduce_int_dim_and_gaussian_dtype(rng):
+    b = rng.rand(2, 3) > 0.5
+    np.testing.assert_array_equal(
+        np.asarray(lower("reduce_all", {"X": [b]}, {"dim": 1})["Out"][0]),
+        b.all(axis=1),
+    )
+    out = lower("gaussian_random_batch_size_like",
+                {"Input": [np.zeros((3, 2), "float32")],
+                 "__rng_key__": [jax.random.PRNGKey(0)]},
+                {"shape": [-1, 4], "dtype": "float16"})["Out"][0]
+    assert str(out.dtype) == "float16"
+
+
+def test_nas_controller_handles_below_minus_one_rewards():
+    from paddle_tpu.contrib.nas import SAController
+
+    c = SAController(seed=0)
+    c.reset([3, 3], [0, 0])
+    c.update([0, 0], -7.5)
+    c.update([1, 0], -5.0)
+    c.update([2, 0], -9.0)
+    assert c.best_tokens == [1, 0]
+    assert c.max_reward == -5.0

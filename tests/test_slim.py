@@ -123,3 +123,52 @@ def test_distillation_merge_and_losses(rng):
     np.testing.assert_array_equal(
         t_w1_before, np.asarray(scope.find_var("teacher/t_w1"))
     )
+
+
+def test_light_nas_finds_wider_net(rng):
+    """NAS analog (reference: slim/nas/) — the SA loop must discover that a
+    wider hidden layer fits the quadratic target better (reward = -eval
+    loss), beating the deliberately-bad init tokens."""
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.nas import SAController, SearchSpace, \
+        light_nas_search
+    from paddle_tpu.core.ir import Program, program_guard
+
+    widths = [1, 2, 16]
+    x_np = rng.randn(32, 8).astype("float32")
+    w_true = rng.randn(8, 8).astype("float32")
+    y_np = np.tanh(x_np @ w_true).astype("float32")
+
+    class MLPSpace(SearchSpace):
+        def init_tokens(self):
+            return [0]  # worst width
+
+        def range_table(self):
+            return [len(widths)]
+
+        def create_net(self, tokens):
+            h = widths[tokens[0]]
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                x = fluid.data("x", [32, 8])
+                y = fluid.data("y", [32, 8])
+                hid = fluid.layers.fc(x, size=h, act="tanh")
+                pred = fluid.layers.fc(hid, size=8)
+                loss = fluid.layers.mean(fluid.layers.square(
+                    fluid.layers.elementwise_sub(pred, y)))
+                neg = fluid.layers.scale(loss, scale=-1.0)  # reward
+                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+            eval_prog = main.clone(for_test=True)
+            return startup, main, eval_prog, [loss], [neg]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = [{"x": x_np, "y": y_np}]
+    best, max_reward, history = light_nas_search(
+        MLPSpace(), exe, feed, feed, steps_per_trial=60, search_steps=6,
+        controller=SAController(seed=3),
+    )
+    assert best is not None
+    assert widths[best[0]] > 1, (best, history)
+    rewards = [r for _, r in history]
+    assert max_reward == max(rewards)
+    assert max_reward > rewards[0], history

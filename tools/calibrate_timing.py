@@ -131,6 +131,36 @@ def main():
     print(f"F chained dispatch x{INNER} + final scalar fetch: "
           f"{1e3*dt/INNER:.2f} ms/step {fl/dt/1e12:.1f} TF/s")
 
+    # --- third stage: PURE-matmul roofline sweep (VERDICT r3 item 2) ----
+    # The E/F ground truth chains tanh between matmuls; the tanh (VPU) can
+    # cap the MXU. A pure x@w chain over a size sweep measures achievable
+    # matmul peak — the denominator that makes "frac_of_roofline"
+    # interpretable against the 394 TF/s book number.
+    print("\npure-matmul roofline sweep (fori_loop, scalar fetch):")
+    for n in (2048, 4096, 8192):
+        xs = jax.device_put(
+            jax.random.normal(key, (n, n), jnp.bfloat16), dev
+        )
+        ws = jax.device_put(
+            jax.random.normal(key, (n, n), jnp.bfloat16), dev
+        )
+        inner = max(8, (4096 // n) ** 3 * 50)
+
+        @jax.jit
+        def pure(z, wz):
+            def body(_, y):
+                return y @ wz
+            return jnp.sum(
+                jax.lax.fori_loop(0, inner, body, z).astype(jnp.float32)
+            )
+
+        _ = np.asarray(pure(xs, ws))
+        t0 = time.perf_counter()
+        _ = np.asarray(pure(xs, ws))
+        dt = time.perf_counter() - t0
+        tf = 2 * n * n * n * inner / dt / 1e12
+        print(f"  n={n}: x{inner} matmuls, {1e3*dt:.0f} ms, {tf:.1f} TF/s")
+
 
 if __name__ == "__main__":
     main()
