@@ -118,6 +118,8 @@ def light_nas_search(space, exe, train_feeds, eval_feeds, steps_per_trial=20,
     Returns (best_tokens, max_reward, history)."""
     from paddle_tpu.core.scope import Scope, scope_guard
 
+    train_feeds = list(train_feeds)  # cycled + re-read every trial
+    eval_feeds = list(eval_feeds)
     controller = controller or SAController()
     controller.reset(space.range_table(), space.init_tokens(),
                      constrain_func)

@@ -54,6 +54,13 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     out = _out(helper, "float32", stop_gradient=True)
     seq_num = _out(helper, "int64", stop_gradient=True)
     ins = {"Hyps": [input.name], "Refs": [label.name]}
+    if (input_length is None) != (label_length is None):
+        from paddle_tpu.utils.enforce import EnforceError
+
+        raise EnforceError(
+            "edit_distance: provide BOTH input_length and label_length "
+            "(padded form), or neither (full-width rows)"
+        )
     if input_length is not None:
         ins["HypsLength"] = [input_length.name]
         ins["RefsLength"] = [label_length.name]

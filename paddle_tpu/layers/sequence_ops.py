@@ -20,6 +20,13 @@ __all__ = [
     "sequence_conv",
     "sequence_first_step",
     "sequence_last_step",
+    "sequence_expand",
+    "sequence_reshape",
+    "sequence_scatter",
+    "lod_reset",
+    "chunk_eval",
+    "beam_search",
+    "beam_search_decode",
 ]
 
 
@@ -192,3 +199,144 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         )
         out = helper.append_bias_op(out, b, axis=2)
     return helper.append_activation(out)
+
+
+def sequence_expand(x, y=None, y_length=None, ref_level=-1, max_repeat=8,
+                    name=None):
+    """reference: python/paddle/fluid/layers/sequence_lod.py
+    sequence_expand — padded form: repeat row i y_length[i] times into a
+    [B, max_repeat, ...] slate (see ops/sequence.py)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    outl = helper.create_variable_for_type_inference("int32")
+    outl.stop_gradient = True
+    ins = {"X": [x.name]}
+    if y_length is not None:
+        ins["YLength"] = [y_length.name]
+    elif y is not None:
+        ins["Y"] = [y.name]
+    helper.append_op(
+        "sequence_expand", ins,
+        {"Out": [out.name], "OutLength": [outl.name]},
+        {"ref_level": ref_level, "max_repeat": max_repeat},
+    )
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """reference: sequence_lod.py sequence_reshape."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("sequence_reshape", name=name)
+    return _one(helper, "sequence_reshape", {"X": [input.name]},
+                {"new_dim": new_dim}, input.dtype)
+
+
+def sequence_scatter(input, index, updates, ids_length=None, name=None):
+    """reference: sequence_lod.py sequence_scatter (padded per-row form)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("sequence_scatter", name=name)
+    ins = {"X": [input.name], "Ids": [index.name],
+           "Updates": [updates.name]}
+    if ids_length is not None:
+        ins["IdsLength"] = [ids_length.name]
+    return _one(helper, "sequence_scatter", ins, {}, input.dtype)
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """reference: python/paddle/fluid/layers/nn.py lod_reset — data passes
+    through; new lengths ride as a second output for sequence ops."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    outs = {"Out": [out.name]}
+    ins = {"X": [x.name]}
+    if y is not None:
+        ins["Y"] = [y.name]
+        outl = helper.create_variable_for_type_inference("int32")
+        outl.stop_gradient = True
+        outs["OutLength"] = [outl.name]
+    helper.append_op("lod_reset", ins, outs, {})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1,
+               excluded_chunk_types=None, seq_length=None):
+    """reference: python/paddle/fluid/layers/nn.py chunk_eval."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("chunk_eval")
+
+    def mk(dtype):
+        v = helper.create_variable_for_type_inference(dtype)
+        v.stop_gradient = True
+        return v
+
+    precision, recall, f1 = mk("float32"), mk("float32"), mk("float32")
+    n_inf, n_lab, n_cor = mk("int64"), mk("int64"), mk("int64")
+    ins = {"Inference": [input.name], "Label": [label.name]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length.name]
+    helper.append_op(
+        "chunk_eval", ins,
+        {"Precision": [precision.name], "Recall": [recall.name],
+         "F1-Score": [f1.name], "NumInferChunks": [n_inf.name],
+         "NumLabelChunks": [n_lab.name],
+         "NumCorrectChunks": [n_cor.name]},
+        {"chunk_scheme": chunk_scheme, "num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": excluded_chunk_types or []},
+    )
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=True):
+    """reference: python/paddle/fluid/layers/rnn.py beam_search — fixed-
+    beam single step (see ops/sequence.py _beam_search for the contract)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference("float32")
+    parent = helper.create_variable_for_type_inference("int32")
+    for v in (sel_ids, sel_scores, parent):
+        v.stop_gradient = True
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": [pre_ids.name], "pre_scores": [pre_scores.name],
+         "ids": [ids.name], "scores": [scores.name]},
+        {"selected_ids": [sel_ids.name],
+         "selected_scores": [sel_scores.name],
+         "parent_idx": [parent.name]},
+        {"beam_size": beam_size, "end_id": end_id, "level": level,
+         "is_accumulated": is_accumulated},
+    )
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, parents, scores, beam_size=None, end_id=0,
+                       name=None):
+    """reference: python/paddle/fluid/layers/rnn.py beam_search_decode —
+    stacked [T, B, W] step outputs backtracked to [B, W, T] sentences."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference("int64")
+    sc = helper.create_variable_for_type_inference("float32")
+    sent.stop_gradient = True
+    sc.stop_gradient = True
+    helper.append_op(
+        "beam_search_decode",
+        {"Ids": [ids.name], "Parents": [parents.name],
+         "Scores": [scores.name]},
+        {"SentenceIds": [sent.name], "SentenceScores": [sc.name]},
+        {"beam_size": beam_size or 0, "end_id": end_id},
+    )
+    return sent, sc

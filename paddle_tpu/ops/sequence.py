@@ -279,3 +279,241 @@ def _sequence_conv(ins, attrs):
     stacked = jnp.concatenate(cols, axis=-1)  # [B, S, ctx*F]
     out = jnp.einsum("bsf,fo->bso", stacked, w)
     return {"Out": [jnp.where(mask[..., None], out, 0).astype(x.dtype)]}
+
+
+@register_op("sequence_expand", nondiff_inputs=("Length", "YLength", "Y"))
+def _sequence_expand(ins, attrs):
+    """reference: paddle/fluid/operators/sequence_ops/sequence_expand_op.h —
+    repeat sequence i of X `YLength[i]` times. Padded form: X [B, S, ...]
+    (or [B, ...] for ref_level row-expand), YLength [B] repeat counts;
+    output [B, R_max, S, ...] with rows beyond YLength[i] zeroed (the LoD
+    concat of the reference becomes an explicit repeat axis)."""
+    x = first(ins, "X")
+    yl = maybe(ins, "YLength")
+    if yl is None:
+        y = first(ins, "Y")
+        yl = jnp.full((x.shape[0],), y.shape[1] if y.ndim > 1 else 1,
+                      jnp.int32)
+    yl = yl.reshape(-1).astype(jnp.int32)
+    rmax = attrs.get("max_repeat", 8)  # static bound on per-row repeats
+    B = x.shape[0]
+    reps = jnp.arange(rmax)[None, :] < yl[:, None]      # [B, R]
+    tiled = jnp.broadcast_to(
+        x[:, None], (B, rmax) + tuple(x.shape[1:])
+    )
+    # fill with x's OWN dtype: a 0.0 float fill would silently promote
+    # int64 token ids to float
+    out = jnp.where(reps.reshape((B, rmax) + (1,) * (x.ndim - 1)),
+                    tiled, jnp.zeros((), x.dtype))
+    return {"Out": [out], "OutLength": [yl]}
+
+
+@register_op("sequence_reshape", nondiff_inputs=("Length",))
+def _sequence_reshape(ins, attrs):
+    """reference: sequence_ops/sequence_reshape_op.h — re-chunk the token
+    stream to `new_dim` features: [B, S, D] -> [B, S*D/new_dim, new_dim]."""
+    x = first(ins, "X")
+    new_dim = attrs["new_dim"]
+    B, S, D = x.shape
+    if (S * D) % new_dim:
+        raise EnforceError(
+            f"sequence_reshape: S*D={S*D} not divisible by new_dim={new_dim}"
+        )
+    return {"Out": [x.reshape(B, S * D // new_dim, new_dim)]}
+
+
+@register_op("sequence_scatter", nondiff_inputs=("Ids", "IdsLength"))
+def _sequence_scatter(ins, attrs):
+    """reference: sequence_ops/sequence_scatter_op.h — per-row scatter-add
+    of Updates into X at Ids. Padded form: X [B, N], Ids [B, K],
+    Updates [B, K], optional IdsLength [B] masking the tail."""
+    x = first(ins, "X")
+    ids = first(ins, "Ids").astype(jnp.int32)
+    upd = first(ins, "Updates")
+    idl = maybe(ins, "IdsLength")
+    if idl is not None:
+        mask = jnp.arange(ids.shape[1])[None, :] < idl.reshape(-1, 1)
+        upd = jnp.where(mask, upd, jnp.zeros((), upd.dtype))
+    B = x.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+    return {"Out": [x.at[rows, ids].add(upd)]}
+
+
+@register_op("lod_reset", nondiff_inputs=("Y",))
+def _lod_reset(ins, attrs):
+    """reference: lod_reset_op.h — reassigns sequence boundaries. On the
+    padded+lengths representation the DATA is unchanged; the new lengths
+    (Y or target_lod) ride through as OutLength for downstream sequence
+    ops."""
+    x = first(ins, "X")
+    y = maybe(ins, "Y")
+    out = {"Out": [x]}
+    if y is not None:
+        out["OutLength"] = [y.reshape(-1).astype(jnp.int32)]
+    return out
+
+
+@register_op("chunk_eval", nondiff_inputs=("Inference", "Label", "SeqLength"))
+def _chunk_eval(ins, attrs):
+    """reference: paddle/fluid/operators/chunk_eval_op.h — chunk-level
+    precision/recall/F1 for IOB tagging. Tags encode (chunk_type, pos) as
+    tag = chunk_type * num_tag + pos with IOB pos: 0=B, 1=I. Padded
+    [B, S] int tags + SeqLength [B]. A chunk starts at a B tag; it spans
+    following I tags of the same type; two chunk sets are compared by
+    (start, end, type) equality, vectorized as per-position start/segment
+    matching."""
+    scheme = attrs.get("chunk_scheme", "IOB")
+    if scheme != "IOB":
+        raise EnforceError(
+            f"chunk_eval: only the IOB scheme is implemented (got "
+            f"{scheme!r}); IOE/IOBES/plain need their own tag decoders"
+        )
+    inf = first(ins, "Inference").reshape(
+        first(ins, "Inference").shape[0], -1
+    ).astype(jnp.int32)
+    lab = first(ins, "Label").reshape(inf.shape).astype(jnp.int32)
+    sl = maybe(ins, "SeqLength")
+    num_tag = 2  # IOB: B, I
+    nct = attrs.get("num_chunk_types", 1)
+    excluded = attrs.get("excluded_chunk_types", []) or []
+    B, S = inf.shape
+    valid = (
+        jnp.arange(S)[None, :] < sl.reshape(-1, 1)
+        if sl is not None else jnp.ones((B, S), bool)
+    )
+
+    def chunks(tags):
+        # reference tag encoding: type*num_tag + pos for real chunks; the
+        # single O (outside) tag is id num_chunk_types*num_tag and NEVER
+        # starts or continues a chunk
+        is_o = tags >= nct * num_tag
+        ctype = jnp.where(is_o, -1, tags // num_tag)
+        pos = tags % num_tag
+        in_chunk = valid & ~is_o
+        is_b = (pos == 0) & in_chunk
+        prev_t = jnp.concatenate(
+            [jnp.full((B, 1), -2, jnp.int32), ctype[:, :-1]], axis=1
+        )
+        # a chunk also starts at an I tag whose predecessor is a different
+        # type or O (conventional IOB repair, matching the reference's
+        # segmentation)
+        start = is_b | ((pos == 1) & (ctype != prev_t) & in_chunk)
+        if excluded:
+            for e in excluded:
+                start = start & (ctype != e)
+        return start, ctype, in_chunk
+
+    s_inf, t_inf, in_inf = chunks(inf)
+    s_lab, t_lab, in_lab = chunks(lab)
+
+    # a chunk spans from its start to the position before the next chunk
+    # start OR the first non-chunk (O / invalid) position
+    def chunk_end(start, in_chunk):
+        idx = jnp.arange(S)[None, :]
+        boundary = start | ~in_chunk
+        nxt = jnp.where(boundary, idx, S + 1)
+        rev = jnp.flip(nxt, axis=1)
+        runmin = jax.lax.associative_scan(jnp.minimum, rev, axis=1)
+        nxt_at = jnp.flip(runmin, axis=1)  # min boundary index >= position
+        after = jnp.concatenate(
+            [nxt_at[:, 1:], jnp.full((B, 1), S + 1)], axis=1
+        )
+        return after
+
+    end_inf = chunk_end(s_inf, in_inf)
+    end_lab = chunk_end(s_lab, in_lab)
+    seq_end = (
+        sl.reshape(-1, 1).astype(jnp.int32)
+        if sl is not None else jnp.full((B, 1), S, jnp.int32)
+    )
+    e_inf = jnp.minimum(end_inf, seq_end)
+    e_lab = jnp.minimum(end_lab, seq_end)
+    match = s_inf & s_lab & (t_inf == t_lab) & (e_inf == e_lab)
+    n_inf = s_inf.sum()
+    n_lab = s_lab.sum()
+    n_cor = match.sum()
+    f = jnp.float32
+    precision = n_cor.astype(f) / jnp.maximum(n_inf.astype(f), 1.0)
+    recall = n_cor.astype(f) / jnp.maximum(n_lab.astype(f), 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-8)
+    i64 = jnp.int64
+    return {
+        "Precision": [precision.reshape(1)],
+        "Recall": [recall.reshape(1)],
+        "F1-Score": [f1.reshape(1)],
+        "NumInferChunks": [n_inf.astype(i64).reshape(1)],
+        "NumLabelChunks": [n_lab.astype(i64).reshape(1)],
+        "NumCorrectChunks": [n_cor.astype(i64).reshape(1)],
+    }
+
+
+@register_op("beam_search", nondiff_inputs=("pre_ids", "pre_scores", "ids",
+                                            "scores"))
+def _beam_search(ins, attrs):
+    """reference: paddle/fluid/operators/beam_search_op.h — ONE beam step.
+    Fixed-beam form: pre_ids [B, W], pre_scores [B, W], scores [B, W, K]
+    (log-probs of the K expansions per live beam). Selects the global top-W
+    (per batch) of pre_scores + scores; beams already ended (pre_id ==
+    end_id) keep exactly one continuation (the end token, score carried).
+    Returns selected_ids [B, W], selected_scores [B, W] and parent_idx
+    [B, W] (which source beam each selection extends)."""
+    pre_ids = first(ins, "pre_ids").astype(jnp.int32)
+    pre_scores = first(ins, "pre_scores").astype(jnp.float32)
+    ids = first(ins, "ids").astype(jnp.int32)      # [B, W, K]
+    scores = first(ins, "scores").astype(jnp.float32)
+    end_id = attrs.get("end_id", 0)
+    B, W, K = scores.shape
+    ended = pre_ids == end_id                      # [B, W]
+    # is_accumulated (reference default True): `scores` already include the
+    # beam history, so adding pre_scores would double-count it; False means
+    # per-step log-probs that accumulate here
+    if attrs.get("is_accumulated", True):
+        live_scores = scores
+    else:
+        live_scores = pre_scores[:, :, None] + scores
+    # ended beams: only expansion 0 is live, forced to end_id at carried
+    # score; live beams get their (accumulated) expansion scores
+    exp_scores = jnp.where(
+        ended[:, :, None], pre_scores[:, :, None], live_scores
+    )
+    first_k = jnp.arange(K)[None, None, :] == 0
+    exp_valid = jnp.where(ended[:, :, None], first_k, True)
+    exp_scores = jnp.where(exp_valid, exp_scores, -jnp.inf)
+    exp_ids = jnp.where(ended[:, :, None], end_id, ids)
+    flat = exp_scores.reshape(B, W * K)
+    top_s, top_i = jax.lax.top_k(flat, W)          # [B, W]
+    parent = (top_i // K).astype(jnp.int32)
+    sel_ids = jnp.take_along_axis(
+        exp_ids.reshape(B, W * K), top_i, axis=1
+    )
+    return {
+        "selected_ids": [sel_ids],
+        "selected_scores": [top_s],
+        "parent_idx": [parent],
+    }
+
+
+@register_op("beam_search_decode", nondiff_inputs=("Ids", "Parents",
+                                                   "Scores"))
+def _beam_search_decode(ins, attrs):
+    """reference: paddle/fluid/operators/beam_search_decode_op.h — backtrack
+    stacked per-step selections into full sequences. Fixed form: Ids /
+    Parents [T, B, W] from T beam_search steps, Scores [B, W] final beam
+    scores. Returns SentenceIds [B, W, T] (end-padded) and SentenceScores
+    [B, W]: lane w holds the full history of final beam w, reconstructed by
+    walking parent pointers backward with a lax.scan."""
+    ids = first(ins, "Ids").astype(jnp.int32)       # [T, B, W]
+    parents = first(ins, "Parents").astype(jnp.int32)
+    scores = first(ins, "Scores").astype(jnp.float32)  # [B, W]
+    T, B, W = ids.shape
+    lane0 = jnp.broadcast_to(jnp.arange(W)[None], (B, W))
+
+    def back(lane, t):
+        tok = jnp.take_along_axis(ids[t], lane, axis=1)     # [B, W]
+        lane_next = jnp.take_along_axis(parents[t], lane, axis=1)
+        return lane_next, tok
+
+    _, toks = jax.lax.scan(back, lane0, jnp.arange(T - 1, -1, -1))
+    # toks [T, B, W] in reverse time order -> [B, W, T] forward
+    sent = jnp.flip(jnp.transpose(toks, (1, 2, 0)), axis=2)
+    return {"SentenceIds": [sent], "SentenceScores": [scores]}

@@ -340,3 +340,158 @@ def test_anchor_generator_layer(rng):
     assert a.shape == (3, 3, 1, 4)
     # center cell anchor: center at (1.5*16)=24, square of size 32
     np.testing.assert_allclose(a[1, 1, 0], [8, 8, 40, 40], atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# r4 tranche: sequence_expand/reshape/scatter, lod_reset, chunk_eval,
+# beam_search (+decode)
+# ---------------------------------------------------------------------------
+
+
+def _lower(op, ins, attrs=None):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return get_op_def(op).lower(ins, attrs or {})
+
+
+def test_sequence_expand_reshape_scatter(rng):
+    x = rng.randn(3, 4).astype("float32")
+    yl = np.array([2, 0, 3], "int64")
+    out = _lower("sequence_expand", {"X": [x], "YLength": [yl]},
+                 {"max_repeat": 4})["Out"][0]
+    out = np.asarray(out)
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out[0, :2], np.stack([x[0]] * 2))
+    np.testing.assert_allclose(out[0, 2:], 0.0)
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[2, :3], np.stack([x[2]] * 3))
+
+    x2 = rng.randn(2, 4, 6).astype("float32")
+    r = np.asarray(_lower("sequence_reshape", {"X": [x2]},
+                          {"new_dim": 8})["Out"][0])
+    assert r.shape == (2, 3, 8)
+    np.testing.assert_allclose(r.reshape(2, -1), x2.reshape(2, -1))
+
+    base = np.zeros((2, 6), "float32")
+    ids = np.array([[1, 1, 4], [0, 5, 5]], "int64")
+    upd = np.ones((2, 3), "float32")
+    sc = np.asarray(_lower("sequence_scatter",
+                           {"X": [base], "Ids": [ids], "Updates": [upd]}
+                           )["Out"][0])
+    np.testing.assert_allclose(sc[0], [0, 2, 0, 0, 1, 0])
+    np.testing.assert_allclose(sc[1], [1, 0, 0, 0, 0, 2])
+
+
+def test_chunk_eval_iob(rng):
+    # tags: type*2 + pos, pos 0=B 1=I; two types
+    # label:  B0 I0 | B1 | B0      inference: B0 I0 | B0 | B0
+    lab = np.array([[0, 1, 2, 0]], "int64")
+    inf = np.array([[0, 1, 0, 0]], "int64")
+    outs = _lower("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                  {"chunk_scheme": "IOB", "num_chunk_types": 2})
+    n_inf = int(np.asarray(outs["NumInferChunks"][0])[0])
+    n_lab = int(np.asarray(outs["NumLabelChunks"][0])[0])
+    n_cor = int(np.asarray(outs["NumCorrectChunks"][0])[0])
+    assert (n_inf, n_lab) == (3, 3)
+    # correct: the first chunk [0,1] type0 and the last single B0 chunk
+    assert n_cor == 2
+    p = float(np.asarray(outs["Precision"][0])[0])
+    np.testing.assert_allclose(p, 2 / 3, rtol=1e-5)
+
+
+def test_beam_search_step_and_decode(rng):
+    """3-step beam search over a tiny hand-built distribution: the decoded
+    best lane must equal the brute-force best path."""
+    import jax.numpy as jnp
+
+    B, W, K, V = 1, 2, 3, 10
+    end_id = 0
+    rs = np.random.RandomState(0)
+    pre_ids = np.full((B, W), 5, "int64")
+    pre_scores = np.array([[0.0, -0.5]], "float32")
+    all_ids, all_parents = [], []
+    for t in range(3):
+        ids = rs.randint(1, V, (B, W, K)).astype("int64")
+        scores = np.log(rs.rand(B, W, K).astype("float32") + 1e-3)
+        outs = _lower("beam_search",
+                      {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                       "ids": [ids], "scores": [scores]},
+                      {"end_id": end_id, "beam_size": W,
+                       "is_accumulated": False})
+        pre_ids = np.asarray(outs["selected_ids"][0]).astype("int64")
+        pre_scores = np.asarray(outs["selected_scores"][0])
+        all_ids.append(pre_ids.copy())
+        all_parents.append(np.asarray(outs["parent_idx"][0]).copy())
+    dec = _lower("beam_search_decode",
+                 {"Ids": [np.stack(all_ids)],
+                  "Parents": [np.stack(all_parents)],
+                  "Scores": [pre_scores]})
+    sent = np.asarray(dec["SentenceIds"][0])  # [B, W, T]
+    assert sent.shape == (1, 2, 3)
+    # lane w's last token must be the step-3 selection for lane w
+    np.testing.assert_array_equal(sent[0, :, -1], all_ids[-1][0])
+    # walking parents manually reproduces lane 0's history
+    lane = 0
+    toks = []
+    for t in (2, 1, 0):
+        toks.append(all_ids[t][0, lane])
+        lane = all_parents[t][0, lane]
+    np.testing.assert_array_equal(sent[0, 0], toks[::-1])
+
+
+def test_beam_search_ended_beam_keeps_end_token():
+    import numpy as np
+
+    pre_ids = np.array([[0, 7]], "int64")   # beam 0 already ended
+    pre_scores = np.array([[5.0, 0.1]], "float32")
+    ids = np.array([[[1, 2], [3, 4]]], "int64")
+    scores = np.log(np.array([[[0.9, 0.05], [0.6, 0.3]]], "float32"))
+    outs = _lower("beam_search",
+                  {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                   "ids": [ids], "scores": [scores]}, {"end_id": 0})
+    sel = np.asarray(outs["selected_ids"][0])[0]
+    sc = np.asarray(outs["selected_scores"][0])[0]
+    # the ended beam survives as end_id with its carried score 5.0
+    assert 0 in sel.tolist()
+    assert abs(sc[sel.tolist().index(0)] - 5.0) < 1e-6
+
+
+def test_chunk_eval_outside_tag_not_a_chunk():
+    """Code-review r4: the O tag (id num_chunk_types*2) must not start or
+    extend chunks — B0 I0 O O is exactly ONE chunk."""
+    lab = np.array([[0, 1, 2, 2]], "int64")
+    outs = _lower("chunk_eval", {"Inference": [lab], "Label": [lab]},
+                  {"chunk_scheme": "IOB", "num_chunk_types": 1})
+    assert int(np.asarray(outs["NumLabelChunks"][0])[0]) == 1
+    assert int(np.asarray(outs["NumCorrectChunks"][0])[0]) == 1
+    # chunk broken by O: B0 O B0 -> two chunks
+    lab2 = np.array([[0, 2, 0]], "int64")
+    outs2 = _lower("chunk_eval", {"Inference": [lab2], "Label": [lab2]},
+                   {"chunk_scheme": "IOB", "num_chunk_types": 1})
+    assert int(np.asarray(outs2["NumLabelChunks"][0])[0]) == 2
+
+
+def test_sequence_expand_keeps_int_dtype(rng):
+    ids = rng.randint(0, 9, (2, 3)).astype("int64")
+    yl = np.array([2, 1], "int64")
+    out = _lower("sequence_expand", {"X": [ids], "YLength": [yl]},
+                 {"max_repeat": 3})["Out"][0]
+    assert "int" in str(out.dtype), out.dtype
+
+
+def test_beam_search_accumulated_scores():
+    """is_accumulated=True (reference default): scores already carry the
+    history, pre_scores must NOT be re-added for live beams."""
+    pre_ids = np.array([[3, 7]], "int64")
+    pre_scores = np.array([[100.0, 200.0]], "float32")
+    ids = np.array([[[1, 2], [3, 4]]], "int64")
+    scores = np.array([[[-1.0, -2.0], [-3.0, -4.0]]], "float32")
+    outs = _lower("beam_search",
+                  {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                   "ids": [ids], "scores": [scores]},
+                  {"end_id": 0, "is_accumulated": True})
+    sc = np.asarray(outs["selected_scores"][0])[0]
+    np.testing.assert_allclose(sorted(sc, reverse=True), [-1.0, -2.0])
