@@ -222,7 +222,7 @@ def sequence_expand(x, y=None, y_length=None, ref_level=-1, max_repeat=8,
         {"Out": [out.name], "OutLength": [outl.name]},
         {"ref_level": ref_level, "max_repeat": max_repeat},
     )
-    return out
+    return out, outl
 
 
 def sequence_reshape(input, new_dim, name=None):
@@ -255,13 +255,14 @@ def lod_reset(x, y=None, target_lod=None, name=None):
     out = helper.create_variable_for_type_inference(x.dtype)
     outs = {"Out": [out.name]}
     ins = {"X": [x.name]}
+    outl = None
     if y is not None:
         ins["Y"] = [y.name]
         outl = helper.create_variable_for_type_inference("int32")
         outl.stop_gradient = True
         outs["OutLength"] = [outl.name]
     helper.append_op("lod_reset", ins, outs, {})
-    return out
+    return (out, outl) if outl is not None else out
 
 
 def chunk_eval(input, label, chunk_scheme="IOB", num_chunk_types=1,

@@ -1,0 +1,210 @@
+"""Fused CPU-op parity family: compositions the reference hand-fused for
+CPU inference (reference: paddle/fluid/operators/fused/{fusion_lstm_op.cc,
+fusion_gru_op.cc, fused_embedding_seq_pool_op.cc,
+fusion_seqconv_eltadd_relu_op.cc, fusion_repeated_fc_relu_op.cc,
+fusion_squared_mat_sub_op.cc, fusion_seqpool_concat_op.cc,
+fusion_seqpool_cvm_concat_op.cc}).
+
+On TPU these are compositions of existing lowerings — XLA fuses the
+arithmetic; registering the op names keeps reference programs loadable.
+Padded+lengths tensor contract as ops/sequence.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+
+@register_op("fusion_lstm", nondiff_inputs=("Length",))
+def _fusion_lstm(ins, attrs):
+    """reference: fused/fusion_lstm_op.cc — LSTM with the x-projection
+    folded in. X [B, S, M], WeightX [M, 4D], WeightH [D, 4D], Bias [1, 4D]
+    (peepholes unsupported -> loud error). Gate order i, f, c, o
+    (reference computeCtHt order ct = f*c + i*tanh(c_in))."""
+    from paddle_tpu.utils.enforce import EnforceError
+
+    if attrs.get("use_peepholes", False):
+        raise EnforceError("fusion_lstm: peephole connections unsupported")
+    x = first(ins, "X")
+    wx = first(ins, "WeightX")
+    wh = first(ins, "WeightH")
+    b = maybe(ins, "Bias")
+    lengths = maybe(ins, "Length")
+    B, S, M = x.shape
+    D = wh.shape[0]
+    h0 = maybe(ins, "H0")
+    c0 = maybe(ins, "C0")
+    h = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
+    gx = jnp.einsum("bsm,mg->bsg", x, wx)
+    if b is not None:
+        gx = gx + b.reshape(1, 1, -1)
+
+    def step(carry, inp):
+        h, c = carry
+        g_x, t = inp
+        gates = g_x + h @ wh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        if lengths is not None:
+            alive = (t < lengths.reshape(-1, 1))
+            h_new = jnp.where(alive, h_new, h)
+            c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h, c),
+        (jnp.swapaxes(gx, 0, 1), jnp.arange(S)),
+    )
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+    }
+
+
+@register_op("fusion_gru", nondiff_inputs=("Length",))
+def _fusion_gru(ins, attrs):
+    """reference: fused/fusion_gru_op.cc — GRU with folded x-projection,
+    Paddle gate order (update u | reset r | candidate c),
+    h = u*h_prev + (1-u)*c (origin_mode=False default matches gru_unit)."""
+    x = first(ins, "X")
+    wx = first(ins, "WeightX")   # [M, 3D]
+    wh = first(ins, "WeightH")   # [D, 3D]
+    b = maybe(ins, "Bias")
+    lengths = maybe(ins, "Length")
+    B, S, M = x.shape
+    D = wh.shape[0]
+    h0 = maybe(ins, "H0")
+    h = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    origin = attrs.get("origin_mode", False)
+    gx = jnp.einsum("bsm,mg->bsg", x, wx)
+    if b is not None:
+        gx = gx + b.reshape(1, 1, -1)
+
+    def step(h, inp):
+        g_x, t = inp
+        gates = g_x[:, : 2 * D] + h @ wh[:, : 2 * D]
+        u = jax.nn.sigmoid(gates[:, :D])
+        r = jax.nn.sigmoid(gates[:, D:])
+        c = jnp.tanh(g_x[:, 2 * D:] + (r * h) @ wh[:, 2 * D:])
+        if origin:
+            h_new = (1.0 - u) * h + u * c
+        else:
+            h_new = u * h + (1.0 - u) * c
+        if lengths is not None:
+            h_new = jnp.where(t < lengths.reshape(-1, 1), h_new, h)
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(
+        step, h, (jnp.swapaxes(gx, 0, 1), jnp.arange(S))
+    )
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)]}
+
+
+@register_op("fused_embedding_seq_pool", nondiff_inputs=("Ids", "Length"))
+def _fused_embedding_seq_pool(ins, attrs):
+    """reference: fused/fused_embedding_seq_pool_op.cc — lookup + sum-pool
+    over the sequence axis. Ids [B, S] (+Length), W [V, D] -> [B, D]."""
+    w = first(ins, "W")
+    ids = first(ins, "Ids")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    lengths = maybe(ins, "Length")
+    emb = jnp.take(w, ids, axis=0)  # [B, S, D]
+    pad = attrs.get("padding_idx", -1)
+    mask = jnp.ones(ids.shape, bool)
+    if pad is not None and pad >= 0:
+        mask = mask & (ids != pad)
+    if lengths is not None:
+        mask = mask & (
+            jnp.arange(ids.shape[1])[None, :] < lengths.reshape(-1, 1)
+        )
+    return {"Out": [jnp.where(mask[..., None], emb, 0.0).sum(axis=1)]}
+
+
+@register_op("fusion_seqconv_eltadd_relu", nondiff_inputs=("Length",))
+def _fusion_seqconv_eltadd_relu(ins, attrs):
+    """reference: fused/fusion_seqconv_eltadd_relu_op.cc — sequence_conv +
+    bias + relu."""
+    from paddle_tpu.core.registry import get_op_def
+
+    conv = get_op_def("sequence_conv").lower(
+        {k: v for k, v in ins.items() if k in ("X", "Filter", "Length")},
+        {"contextLength": attrs.get("contextLength", 3),
+         "contextStart": attrs.get("contextStart", -1),
+         "contextStride": attrs.get("contextStride", 1)},
+    )["Out"][0]
+    b = first(ins, "Bias")
+    return {"Out": [jax.nn.relu(conv + b.reshape(1, 1, -1))]}
+
+
+@register_op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ins, attrs):
+    """reference: fused/fusion_repeated_fc_relu_op.cc — N x (fc + relu)."""
+    x = first(ins, "X")
+    ws = ins["W"]
+    bs = ins["Bias"]
+    for w, b in zip(ws, bs):
+        x = jax.nn.relu(x @ w + b.reshape(1, -1))
+    return {"Out": [x]}
+
+
+@register_op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ins, attrs):
+    """reference: fused/fusion_squared_mat_sub_op.cc —
+    scalar * ((x@y)^2 - (x^2)@(y^2)) (the pairwise-interaction trick)."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    s = attrs.get("scalar", 1.0)
+    return {"Out": [s * (jnp.square(x @ y) - jnp.square(x) @ jnp.square(y))]}
+
+
+@register_op("fusion_seqpool_concat", nondiff_inputs=("Length",))
+def _fusion_seqpool_concat(ins, attrs):
+    """reference: fused/fusion_seqpool_concat_op.cc — sum/avg/sqrt pool of
+    each input sequence, concatenated on features."""
+    pools = _pool_all(ins, attrs)
+    return {"Out": [jnp.concatenate(pools, axis=1)]}
+
+
+@register_op("fusion_seqpool_cvm_concat", nondiff_inputs=("CVM", "Length"))
+def _fusion_seqpool_cvm_concat(ins, attrs):
+    """reference: fused/fusion_seqpool_cvm_concat_op.cc — seqpool + CVM
+    log transform + concat (the CTR tower input builder)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    pools = _pool_all(ins, attrs)
+    cvm = ins.get("CVM")
+    outs = []
+    for p in pools:
+        if attrs.get("use_cvm", True) and cvm is not None:
+            p = get_op_def("cvm").lower(
+                {"X": [p], "CVM": cvm}, {"use_cvm": True}
+            )["Y"][0]
+        outs.append(p)
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+def _pool_all(ins, attrs):
+    ptype = attrs.get("pooltype", "SUM").upper()
+    lengths = ins.get("Length")
+    pools = []
+    for i, x in enumerate(ins["X"]):
+        l = lengths[i] if lengths and i < len(lengths) else None
+        mask = (
+            jnp.arange(x.shape[1])[None, :] < l.reshape(-1, 1)
+            if l is not None else jnp.ones(x.shape[:2], bool)
+        )
+        m = mask[..., None]
+        s = jnp.where(m, x, 0.0).sum(axis=1)
+        if ptype == "SUM":
+            pools.append(s)
+        else:
+            n = jnp.maximum(mask.sum(axis=1, keepdims=True).astype(x.dtype),
+                            1.0)
+            pools.append(s / (jnp.sqrt(n) if ptype == "SQRT" else n))
+    return pools

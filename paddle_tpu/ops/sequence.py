@@ -291,11 +291,17 @@ def _sequence_expand(ins, attrs):
     x = first(ins, "X")
     yl = maybe(ins, "YLength")
     if yl is None:
-        y = first(ins, "Y")
+        y = maybe(ins, "Y")
+        if y is None:
+            raise EnforceError(
+                "sequence_expand needs YLength (per-row repeat counts) or "
+                "Y (whose row width supplies them)"
+            )
         yl = jnp.full((x.shape[0],), y.shape[1] if y.ndim > 1 else 1,
                       jnp.int32)
-    yl = yl.reshape(-1).astype(jnp.int32)
     rmax = attrs.get("max_repeat", 8)  # static bound on per-row repeats
+    # OutLength must describe the EMITTED slate: clamp to the static bound
+    yl = jnp.minimum(yl.reshape(-1).astype(jnp.int32), rmax)
     B = x.shape[0]
     reps = jnp.arange(rmax)[None, :] < yl[:, None]      # [B, R]
     tiled = jnp.broadcast_to(
@@ -397,20 +403,24 @@ def _chunk_eval(ins, attrs):
         # a chunk also starts at an I tag whose predecessor is a different
         # type or O (conventional IOB repair, matching the reference's
         # segmentation)
-        start = is_b | ((pos == 1) & (ctype != prev_t) & in_chunk)
+        raw_start = is_b | ((pos == 1) & (ctype != prev_t) & in_chunk)
+        start = raw_start
         if excluded:
+            # excluded-type chunks are not COUNTED but still TERMINATE the
+            # preceding chunk: boundaries use raw_start
             for e in excluded:
                 start = start & (ctype != e)
-        return start, ctype, in_chunk
+        return start, raw_start, ctype, in_chunk
 
-    s_inf, t_inf, in_inf = chunks(inf)
-    s_lab, t_lab, in_lab = chunks(lab)
+    s_inf, raw_inf, t_inf, in_inf = chunks(inf)
+    s_lab, raw_lab, t_lab, in_lab = chunks(lab)
 
     # a chunk spans from its start to the position before the next chunk
-    # start OR the first non-chunk (O / invalid) position
-    def chunk_end(start, in_chunk):
+    # start (counted OR excluded) OR the first non-chunk (O / invalid)
+    # position
+    def chunk_end(raw_start, in_chunk):
         idx = jnp.arange(S)[None, :]
-        boundary = start | ~in_chunk
+        boundary = raw_start | ~in_chunk
         nxt = jnp.where(boundary, idx, S + 1)
         rev = jnp.flip(nxt, axis=1)
         runmin = jax.lax.associative_scan(jnp.minimum, rev, axis=1)
@@ -420,8 +430,8 @@ def _chunk_eval(ins, attrs):
         )
         return after
 
-    end_inf = chunk_end(s_inf, in_inf)
-    end_lab = chunk_end(s_lab, in_lab)
+    end_inf = chunk_end(raw_inf, in_inf)
+    end_lab = chunk_end(raw_lab, in_lab)
     seq_end = (
         sl.reshape(-1, 1).astype(jnp.int32)
         if sl is not None else jnp.full((B, 1), S, jnp.int32)

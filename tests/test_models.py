@@ -62,3 +62,95 @@ def test_bert_infer_clone_no_dropout(rng):
     a = exe.run(infer, feed=batch, fetch_list=[fetches[0]])[0]
     b = exe.run(infer, feed=batch, fetch_list=[fetches[0]])[0]
     np.testing.assert_allclose(a, b)
+
+
+def test_mobilenet_v1_v2_train_step(rng):
+    """MobileNet family (model zoo parity): one train step each, finite
+    loss, depthwise convs lower through grouped conv2d."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import mobilenet
+
+    for version in (1, 2):
+        main, startup, feeds, fetches = mobilenet.build_mobilenet_train(
+            version=version, class_dim=10, lr=0.1,
+            image_shape=(3, 32, 32),
+        )
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(startup)
+            out = exe.run(main, feed={
+                "img": rng.randn(2, 3, 32, 32).astype("float32"),
+                "label": rng.randint(0, 10, (2, 1)).astype("int64"),
+            }, fetch_list=[fetches[0]])
+        assert np.isfinite(np.asarray(out[0])).all(), version
+
+
+def test_fusion_ops(rng):
+    """fused/ op family: numeric parity with their unfused compositions."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    def lower(op, ins, attrs=None):
+        ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+        return get_op_def(op).lower(ins, attrs or {})
+
+    # fusion_squared_mat_sub
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    out = lower("fusion_squared_mat_sub", {"X": [x], "Y": [y]},
+                {"scalar": 0.5})["Out"][0]
+    np.testing.assert_allclose(
+        out, 0.5 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2)), rtol=1e-4
+    )
+
+    # fusion_repeated_fc_relu
+    w1 = rng.randn(4, 6).astype("float32")
+    w2 = rng.randn(6, 3).astype("float32")
+    b1 = rng.randn(6).astype("float32")
+    b2 = rng.randn(3).astype("float32")
+    out = lower("fusion_repeated_fc_relu",
+                {"X": [x], "W": [w1, w2], "Bias": [b1, b2]})["Out"][0]
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    # fused_embedding_seq_pool
+    w = rng.randn(20, 4).astype("float32")
+    ids = rng.randint(0, 20, (2, 5)).astype("int64")
+    ln = np.array([3, 5], "int64")
+    out = lower("fused_embedding_seq_pool",
+                {"W": [w], "Ids": [ids], "Length": [ln]})["Out"][0]
+    ref = np.stack([w[ids[0, :3]].sum(0), w[ids[1]].sum(0)])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    # fusion_gru == gru_unit stepped manually
+    B, S, M, D = 2, 4, 3, 5
+    xs = rng.randn(B, S, M).astype("float32")
+    wx = rng.randn(M, 3 * D).astype("float32")
+    wh = rng.randn(D, 3 * D).astype("float32")
+    out = np.asarray(lower("fusion_gru",
+                           {"X": [xs], "WeightX": [wx], "WeightH": [wh]}
+                           )["Hidden"][0])
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    h = np.zeros((B, D), "float32")
+    for t in range(S):
+        gx = xs[:, t] @ wx
+        gates = gx[:, :2*D] + h @ wh[:, :2*D]
+        u, r = sig(gates[:, :D]), sig(gates[:, D:])
+        c = np.tanh(gx[:, 2*D:] + (r * h) @ wh[:, 2*D:])
+        h = u * h + (1 - u) * c
+    np.testing.assert_allclose(out[:, -1], h, rtol=1e-4)
+
+    # fusion_lstm shape/finiteness + length masking
+    wx4 = rng.randn(M, 4 * D).astype("float32")
+    wh4 = rng.randn(D, 4 * D).astype("float32")
+    ln2 = np.array([2, 4], "int64")
+    outs = lower("fusion_lstm",
+                 {"X": [xs], "WeightX": [wx4], "WeightH": [wh4],
+                  "Length": [ln2]})
+    hid = np.asarray(outs["Hidden"][0])
+    assert hid.shape == (B, S, D) and np.isfinite(hid).all()
+    # masked tail keeps the last live hidden
+    np.testing.assert_allclose(hid[0, 2], hid[0, 3])

@@ -495,3 +495,29 @@ def test_beam_search_accumulated_scores():
                   {"end_id": 0, "is_accumulated": True})
     sc = np.asarray(outs["selected_scores"][0])[0]
     np.testing.assert_allclose(sorted(sc, reverse=True), [-1.0, -2.0])
+
+
+def test_chunk_eval_excluded_type_terminates(rng):
+    """Code-review r4: an excluded-type chunk still terminates the
+    preceding chunk (boundaries use raw starts)."""
+    # label: B0 B1 ; inference: B0 O — B0 spans [0,1) in BOTH
+    lab = np.array([[0, 2]], "int64")
+    inf = np.array([[0, 4]], "int64")  # O = nct*2 = 4
+    outs = _lower("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                  {"chunk_scheme": "IOB", "num_chunk_types": 2,
+                   "excluded_chunk_types": [1]})
+    assert int(np.asarray(outs["NumLabelChunks"][0])[0]) == 1
+    assert int(np.asarray(outs["NumInferChunks"][0])[0]) == 1
+    assert int(np.asarray(outs["NumCorrectChunks"][0])[0]) == 1
+
+
+def test_sequence_expand_clamps_outlength(rng):
+    x = rng.randn(2, 3).astype("float32")
+    yl = np.array([12, 1], "int64")
+    outs = _lower("sequence_expand", {"X": [x], "YLength": [yl]},
+                  {"max_repeat": 8})
+    np.testing.assert_array_equal(np.asarray(outs["OutLength"][0]), [8, 1])
+    from paddle_tpu.utils.enforce import EnforceError
+    import pytest as _pytest
+    with _pytest.raises(EnforceError, match="YLength"):
+        _lower("sequence_expand", {"X": [x]}, {})
