@@ -1,0 +1,296 @@
+"""Dygraph-to-static AST transform for data-dependent `if`.
+
+reference: python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py
+(IfElseTransformer) — the reference rewrites Python `if` on tensors into
+layers.cond sub-blocks. TPU-native form: the rewritten `if` evaluates BOTH
+branches and selects per returned tensor with the `where` op — the
+lax.select lowering XLA would pick for cheap branches anyway, and it needs
+no sub-block machinery under trace capture. Eager calls keep plain Python
+branching (values exist, __bool__ works).
+
+Contract (documented limits, loud failures otherwise):
+- only `if`/`elif`/`else` on tensor predicates are transformed; `for`/
+  `while` over tensors still raise the capture-guard error (use
+  layers.while_loop);
+- both branches run under trace: side-effecting branches (py_func, prints,
+  state write-backs) are NOT eligible;
+- branch variables must be assignable by simple names; `return`/`break`/
+  `continue` inside a transformed `if` are rejected at transform time.
+"""
+
+import ast
+import inspect
+import textwrap
+
+__all__ = ["convert_ifelse", "ast_transform"]
+
+_HELPER = "__paddle_tpu_select_if__"
+
+
+def _assigned_names(stmts):
+    """Simple Name targets assigned anywhere in `stmts` — at THIS function
+    scope (nested def/lambda bodies have their own locals)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._collect(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass  # nested scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def _collect(self, t):
+            if isinstance(t, ast.Name):
+                if t.id not in names:
+                    names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._collect(e)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _has_flow_escape(stmts):
+    """Control flow that would escape the `if` being converted: `return`
+    at this function scope, or break/continue NOT owned by a loop inside
+    the branch. Nested function defs are their own scope."""
+
+    class V(ast.NodeVisitor):
+        found = False
+        loop_depth = 0
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+        visit_AsyncFor = _loop
+
+        def visit_FunctionDef(self, node):
+            pass  # own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _IfTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.count = 0
+
+    def visit_If(self, node):
+        self.generic_visit(node)  # innermost-first
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            # leave THIS `if` as plain Python: static predicates still work
+            # (eager bool), data-dependent ones hit the loud capture guard
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        # every assigned name becomes a helper parameter fed by a lazy
+        # thunk of its current value (or an _Undefined placeholder): the
+        # helpers' return tuple then never references an unbound free
+        # variable, and read-before-write inside a branch sees the value
+        # from before the `if` (Python closure-write rule workaround)
+        params = list(names)
+        n = self.count
+        self.count += 1
+        tname = f"__pt_true_{n}"
+        fname = f"__pt_false_{n}"
+        ret = ast.Return(
+            value=ast.Tuple(
+                elts=[ast.Name(id=x, ctx=ast.Load()) for x in names],
+                ctx=ast.Load(),
+            )
+        )
+        def fn_args():
+            return ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=x) for x in params],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            )
+
+        tdef = ast.FunctionDef(
+            name=tname,
+            args=fn_args(),
+            body=list(node.body) + [ret],
+            decorator_list=[],
+        )
+        fdef = ast.FunctionDef(
+            name=fname,
+            args=fn_args(),
+            body=(list(node.orelse) + [ret]) if node.orelse else [ret],
+            decorator_list=[],
+        )
+        # current values of read-write branch vars travel as LAZY thunks:
+        # a default argument would evaluate at def time and explode when
+        # the name is only assigned inside the `if` itself
+        thunks = ast.Tuple(
+            elts=[
+                ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=x, ctx=ast.Load()),
+                )
+                for x in params
+            ],
+            ctx=ast.Load(),
+        )
+        call = ast.Call(
+            func=ast.Name(id=_HELPER, ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  thunks],
+            keywords=[],
+        )
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=x, ctx=ast.Store()) for x in names],
+                    ctx=ast.Store(),
+                )],
+                value=call,
+            )
+        else:
+            assign = ast.Expr(value=call)
+        return [tdef, fdef, assign]
+
+
+class _Undefined:
+    """Placeholder for a branch variable with no value yet: any use inside
+    the branch (before its own assignment) fails loudly."""
+
+    def _boom(self, *a, **k):
+        raise RuntimeError(
+            "converted `if`: this variable has no value on every path "
+            "(it was assigned in only one branch, or not before the "
+            "`if`); assign it on all paths before using it"
+        )
+
+    __getattr__ = __call__ = __add__ = __radd__ = __mul__ = __rmul__ = \
+        __sub__ = __rsub__ = __truediv__ = __rtruediv__ = __bool__ = _boom
+
+
+def _select_if(pred, true_fn, false_fn, thunks=()):
+    """Runtime dispatch: eager bool -> Python branch; symbolic tensor ->
+    run BOTH branches and `where`-select each returned value. `thunks`
+    lazily read the CURRENT values of read-write branch variables."""
+    from paddle_tpu.dygraph.base import trace_op
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    vals = []
+    for th in thunks:
+        try:
+            vals.append(th())
+        except (NameError, UnboundLocalError):
+            vals.append(_Undefined())
+    if not isinstance(pred, VarBase) or pred.value is not None:
+        return true_fn(*vals) if pred else false_fn(*vals)
+    if not thunks:
+        raise RuntimeError(
+            "a data-dependent `if` whose branches assign no variables is "
+            "side-effect-only and cannot be converted to a select; use "
+            "layers.cond or restructure"
+        )
+    tv = true_fn(*vals)
+    fv = false_fn(*vals)
+    tv = tv if isinstance(tv, tuple) else (tv,)
+    fv = fv if isinstance(fv, tuple) else (fv,)
+    outs = []
+    for t, f in zip(tv, fv):
+        if isinstance(t, _Undefined) or isinstance(f, _Undefined):
+            # the variable exists on one path only (branch-local temp, loop
+            # var, nested def): no select possible. Mirror Python: fine if
+            # never used after the `if`, loud on use.
+            outs.append(_Undefined())
+            continue
+        if isinstance(t, VarBase) or isinstance(f, VarBase):
+            outs.append(trace_op(
+                "where", {"Condition": [pred], "X": [t], "Y": [f]}, {}
+            )["Out"][0])
+        else:
+            raise RuntimeError(
+                "converted `if` produced a non-tensor branch value under "
+                "trace; only tensor-valued branches can be selected "
+                f"(got {type(t).__name__}/{type(f).__name__})"
+            )
+    # always a tuple: the rewritten assignment unpacks a tuple target
+    return tuple(outs)
+
+
+def ast_transform(fn):
+    """Rewrite `fn`'s data-dependent `if` statements. Returns the
+    transformed function, or None when the source cannot be transformed
+    (caller falls back to plain tracing + the loud capture guard)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []  # avoid re-applying @declarative etc.
+    tr = _IfTransformer()
+    tr.visit(tree)
+    if tr.count == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, f"<ast_transform {fn.__name__}>", "exec")
+    except (SyntaxError, ValueError):
+        return None
+    glb = dict(getattr(fn, "__globals__", {}))
+    glb[_HELPER] = _select_if
+    # re-bind the function's closure-free form; closures over outer locals
+    # cannot be rebuilt from source -> bail to the fallback
+    if getattr(fn, "__closure__", None):
+        return None
+    loc = {}
+    exec(code, glb, loc)
+    out = loc.get(fdef.name)
+    if out is None:
+        return None
+    out.__wrapped_original__ = fn
+    return out
+
+
+def convert_ifelse(fn):
+    """Public decorator: transform if possible, else return fn unchanged
+    (plain trace + loud guard)."""
+    return ast_transform(fn) or fn

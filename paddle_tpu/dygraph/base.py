@@ -128,6 +128,11 @@ class _CaptureContext:
         self.main_program = main_program
         self.startup_program = startup_program
         self.var_map = {}  # id(VarBase) -> static Variable
+        # id() keys are only stable while the object lives: keep a strong
+        # reference to every mapped VarBase, or a freed temporary (e.g. the
+        # scalar constant `x * 2.0` materializes) lets a LATER temporary
+        # reuse its id and silently alias its static var
+        self._retained = []
 
     def to_static_var(self, vb):
         from paddle_tpu.dygraph.varbase import VarBase
@@ -176,6 +181,7 @@ class _CaptureContext:
                 },
             )
         self.var_map[id(vb)] = sv
+        self._retained.append(vb)
         return sv
 
 

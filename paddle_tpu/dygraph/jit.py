@@ -112,7 +112,13 @@ def _signature(args):
 
 def declarative(fn):
     """Decorator: compile a dygraph function to a static program per input
-    signature (reference: dygraph_to_static @declarative)."""
+    signature (reference: dygraph_to_static @declarative). Data-dependent
+    `if` statements are AST-converted to both-branch `where` selection
+    (dygraph/ast_transform.py, the reference's IfElseTransformer analog);
+    non-convertible control flow keeps the loud capture-guard error."""
+    from paddle_tpu.dygraph.ast_transform import convert_ifelse
+
+    traced_fn = convert_ifelse(fn)
     cache = {}
 
     def wrapper(*args):
@@ -124,7 +130,7 @@ def declarative(fn):
 
             class _FnLayer:
                 def __call__(self, *xs):
-                    return fn(*xs)
+                    return traced_fn(*xs)
 
             _, traced = TracedLayer.trace(_FnLayer(), vb_args)
             cache[key] = traced
