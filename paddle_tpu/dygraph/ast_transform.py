@@ -240,6 +240,16 @@ def _select_if(pred, true_fn, false_fn, thunks=()):
             outs.append(_Undefined())
             continue
         if isinstance(t, VarBase) or isinstance(f, VarBase):
+            # mixed tensor/scalar branches (`y = 0.0` before the if, then
+            # `y = x * 2` inside): promote the plain value to a constant
+            # tensor so the select works
+            from paddle_tpu.dygraph.base import to_variable
+            import numpy as _np
+
+            if not isinstance(t, VarBase):
+                t = to_variable(_np.asarray(t, dtype=_np.dtype(f.dtype)))
+            if not isinstance(f, VarBase):
+                f = to_variable(_np.asarray(f, dtype=_np.dtype(t.dtype)))
             outs.append(trace_op(
                 "where", {"Condition": [pred], "X": [t], "Y": [f]}, {}
             )["Out"][0])
@@ -275,7 +285,12 @@ def ast_transform(fn):
         code = compile(tree, f"<ast_transform {fn.__name__}>", "exec")
     except (SyntaxError, ValueError):
         return None
-    glb = dict(getattr(fn, "__globals__", {}))
+    # exec against the LIVE module globals (not a snapshot): names defined
+    # or monkeypatched after decoration, and recursion through the module
+    # global, must resolve. The helper key is collision-safe.
+    glb = getattr(fn, "__globals__", None)
+    if glb is None:
+        return None
     glb[_HELPER] = _select_if
     # re-bind the function's closure-free form; closures over outer locals
     # cannot be rebuilt from source -> bail to the fallback

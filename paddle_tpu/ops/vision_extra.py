@@ -570,3 +570,168 @@ def _similarity_focus(ins, attrs):
         m = (row_max | col_max).astype(x.dtype)  # [N, H, W]
         mask = jnp.maximum(mask, m[:, None, :, :])
     return {"Out": [mask]}
+
+
+@register_op("density_prior_box", nondiff_inputs=("Input", "Image"))
+def _density_prior_box(ins, attrs):
+    """reference: detection/density_prior_box_op.h — density-sampled prior
+    boxes: for each feature cell, each (fixed_size, density) pairs with
+    each fixed_ratio and tiles density^2 shifted centers. Output
+    [H, W, P, 4] normalized + matching variances. All loop bounds are
+    static attrs, so the whole grid is one broadcasted computation."""
+    feat = first(ins, "Input")
+    img = first(ins, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    imh, imw = img.shape[2], img.shape[3]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    step_w = attrs.get("step_w", 0.0) or float(imw) / W
+    step_h = attrs.get("step_h", 0.0) or float(imh) / H
+    step_avg = int((step_w + step_h) * 0.5)
+
+    cx = (jnp.arange(W) + offset) * step_w       # [W]
+    cy = (jnp.arange(H) + offset) * step_h       # [H]
+    cxg = jnp.broadcast_to(cx[None, :], (H, W))
+    cyg = jnp.broadcast_to(cy[:, None], (H, W))
+    boxes = []
+    for fs, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            bw = fs * float(np.sqrt(r))
+            bh = fs / float(np.sqrt(r))
+            base_x = cxg - step_avg / 2.0 + shift / 2.0
+            base_y = cyg - step_avg / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    ccx = base_x + dj * shift
+                    ccy = base_y + di * shift
+                    boxes.append(jnp.stack([
+                        jnp.maximum((ccx - bw / 2.0) / imw, 0.0),
+                        jnp.maximum((ccy - bh / 2.0) / imh, 0.0),
+                        jnp.minimum((ccx + bw / 2.0) / imw, 1.0),
+                        jnp.minimum((ccy + bh / 2.0) / imh, 1.0),
+                    ], axis=-1))
+    out = jnp.stack(boxes, axis=2)               # [H, W, P, 4]
+    P = out.shape[2]
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32)[None, None, None, :],
+        (H, W, P, 4),
+    )
+    return {"Boxes": [out.astype(feat.dtype)], "Variances": [var]}
+
+
+@register_op("target_assign", nondiff_inputs=("MatchIndices", "NegIndices",
+                                              "X"))
+def _target_assign(ins, attrs):
+    """reference: detection/target_assign_op.h — gather per-prior targets
+    by match index: out[i, j] = x[i, match[i, j]] where matched, else
+    mismatch_value (weight 0). Padded form: X [N, P, K],
+    MatchIndices [N, M] (-1 = unmatched)."""
+    x = first(ins, "X")
+    match = first(ins, "MatchIndices").astype(jnp.int32)
+    mismatch = attrs.get("mismatch_value", 0)
+    N, M = match.shape
+    safe = jnp.clip(match, 0, x.shape[1] - 1)
+    rows = jnp.broadcast_to(jnp.arange(N)[:, None], (N, M))
+    gathered = x[rows, safe]                      # [N, M, K]
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = matched.astype(jnp.float32)
+    neg = maybe(ins, "NegIndices")
+    if neg is not None:
+        # negative priors also get weight 1 (classification background)
+        neg = neg.reshape(N, -1).astype(jnp.int32)
+        nmask = jnp.zeros((N, M), bool)
+        nrows = jnp.broadcast_to(jnp.arange(N)[:, None], neg.shape)
+        nvalid = neg >= 0
+        nmask = nmask.at[nrows, jnp.clip(neg, 0, M - 1)].max(nvalid)
+        wt = jnp.maximum(wt, nmask[..., None].astype(jnp.float32))
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register_op("rpn_target_assign", stateful=True,
+             nondiff_inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"))
+def _rpn_target_assign(ins, attrs):
+    """reference: detection/rpn_target_assign_op.cc — label anchors for RPN
+    training: positives = best-IoU anchor per gt + anchors with IoU >
+    positive_overlap; negatives = IoU < negative_overlap; random subsample
+    to rpn_batch_size_per_im at rpn_fg_fraction. Fixed-slate form: outputs
+    per-anchor labels [A] (1 fg / 0 bg / -1 ignore) and regression targets
+    [A, 4] instead of the reference's compacted index lists."""
+    from paddle_tpu.ops.common import seeded_rng_key
+    from paddle_tpu.ops.detection import _iou
+
+    anchors = first(ins, "Anchor")                # [A, 4]
+    gt = first(ins, "GtBoxes")                    # [G, 4]
+    is_crowd = maybe(ins, "IsCrowd")
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    batch = attrs.get("rpn_batch_size_per_im", 256)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    A = anchors.shape[0]
+    iou = _iou(anchors, gt)                       # [A, G]
+    # crowd gts (reference excludes them before matching) and zero-area
+    # padded slate rows must not produce matches
+    gt_valid = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+    if is_crowd is not None:
+        gt_valid = gt_valid & (is_crowd.reshape(-1) == 0)
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    best_per_anchor = iou.max(axis=1)
+    argbest = iou.argmax(axis=1)
+    labels = jnp.full((A,), -1, jnp.int32)
+    labels = jnp.where(best_per_anchor < neg_thr, 0, labels)
+    labels = jnp.where(best_per_anchor >= pos_thr, 1, labels)
+    # the best anchor for each gt is positive regardless of threshold —
+    # only for gts that actually overlap something (a zero column would
+    # otherwise promote EVERY anchor)
+    best_per_gt = iou.max(axis=0)                 # [G]
+    is_best = (
+        (iou == best_per_gt[None, :]) & (best_per_gt[None, :] > 0)
+    ).any(axis=1)
+    labels = jnp.where(is_best, 1, labels)
+    # random subsample: keep at most fg_cap positives / bg_cap negatives
+    key = seeded_rng_key(ins, attrs)
+    k1, k2 = jax.random.split(key)
+    fg_cap = int(batch * fg_frac)
+    scores_fg = jnp.where(labels == 1, jax.random.uniform(k1, (A,)), -1.0)
+    fg_rank = jnp.argsort(-scores_fg)
+    fg_keep = jnp.zeros((A,), bool).at[fg_rank[:fg_cap]].set(True) & (
+        labels == 1
+    )
+    n_fg = fg_keep.sum()
+    bg_cap = batch
+    scores_bg = jnp.where(labels == 0, jax.random.uniform(k2, (A,)), -1.0)
+    bg_rank = jnp.argsort(-scores_bg)
+    bg_pos = jnp.arange(A) < jnp.maximum(bg_cap - n_fg, 0)
+    bg_keep = jnp.zeros((A,), bool).at[bg_rank].set(bg_pos) & (labels == 0)
+    final = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+    # regression targets vs the matched gt (encode_center_size)
+    mg = gt[argbest]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = mg[:, 2] - mg[:, 0] + 1.0
+    gh = mg[:, 3] - mg[:, 1] + 1.0
+    gcx = mg[:, 0] + 0.5 * gw
+    gcy = mg[:, 1] + 0.5 * gh
+    tgt = jnp.stack([
+        (gcx - acx) / aw, (gcy - acy) / ah,
+        jnp.log(gw / aw), jnp.log(gh / ah),
+    ], axis=1)
+    return {
+        "ScoreIndex": [jnp.where(final >= 0, jnp.arange(A), -1)
+                       .astype(jnp.int32)],
+        "LocationIndex": [jnp.where(final == 1, jnp.arange(A), -1)
+                          .astype(jnp.int32)],
+        "TargetLabel": [final.reshape(A, 1)],
+        "TargetBBox": [jnp.where((final == 1)[:, None], tgt, 0.0)],
+        "BBoxInsideWeight": [
+            jnp.broadcast_to((final == 1)[:, None], (A, 4))
+            .astype(jnp.float32)
+        ],
+    }

@@ -436,3 +436,23 @@ def test_declarative_side_effect_only_if_raises():
     with dygraph.guard():
         with pytest.raises(RuntimeError, match="side-effect"):
             k(to_variable(np.ones((2,), dtype=np.float32)))
+
+
+def test_declarative_mixed_scalar_tensor_branch():
+    """Code-review r4: `y = 0.0` before the if, tensor assignment inside —
+    the scalar side is promoted to a constant for the select."""
+    from paddle_tpu.dygraph.jit import declarative
+
+    @declarative
+    def f(x):
+        s = dygraph.trace_op("mean", {"X": [x]}, {})["Out"][0]
+        y = 0.0
+        if s > 0:
+            y = x * 2.0
+        return x + y
+
+    with dygraph.guard():
+        pos = to_variable(np.full((2,), 1.0, dtype=np.float32))
+        neg = to_variable(np.full((2,), -1.0, dtype=np.float32))
+        np.testing.assert_allclose(f(pos).numpy(), [3.0, 3.0])
+        np.testing.assert_allclose(f(neg).numpy(), [-1.0, -1.0])

@@ -689,3 +689,75 @@ def test_nas_controller_handles_below_minus_one_rewards():
     c.update([2, 0], -9.0)
     assert c.best_tokens == [1, 0]
     assert c.max_reward == -5.0
+
+
+def test_density_prior_box(rng):
+    feat = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    outs = lower("density_prior_box", {"Input": [feat], "Image": [img]},
+                 {"densities": [2], "fixed_sizes": [8.0],
+                  "fixed_ratios": [1.0], "offset": 0.5})
+    boxes = np.asarray(outs["Boxes"][0])
+    assert boxes.shape == (4, 4, 4, 4)  # H, W, density^2*ratios, 4
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # box sizes ~ fixed_size/img normalized
+    w = boxes[2, 2, 0, 2] - boxes[2, 2, 0, 0]
+    assert abs(w - 8.0 / 32.0) < 1e-5
+
+
+def test_target_assign(rng):
+    x = rng.randn(2, 5, 3).astype("float32")
+    match = np.array([[0, -1, 4], [2, 2, -1]], "int32")
+    outs = lower("target_assign", {"X": [x], "MatchIndices": [match]},
+                 {"mismatch_value": 7})
+    out = np.asarray(outs["Out"][0])
+    wt = np.asarray(outs["OutWeight"][0])
+    np.testing.assert_allclose(out[0, 0], x[0, 0])
+    np.testing.assert_allclose(out[1, 1], x[1, 2])
+    assert (out[0, 1] == 7).all() and wt[0, 1, 0] == 0.0
+    assert wt[0, 0, 0] == 1.0
+
+
+def test_rpn_target_assign(rng):
+    anchors = np.array([
+        [0, 0, 10, 10], [20, 20, 30, 30], [100, 100, 110, 110],
+        [1, 1, 11, 11],
+    ], "float32")
+    gt = np.array([[0, 0, 10, 10]], "float32")
+    outs = lower("rpn_target_assign",
+                 {"Anchor": [anchors], "GtBoxes": [gt],
+                  "__rng_key__": [jax.random.PRNGKey(0)]},
+                 {"rpn_positive_overlap": 0.7,
+                  "rpn_negative_overlap": 0.3,
+                  "rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5})
+    labels = np.asarray(outs["TargetLabel"][0]).reshape(-1)
+    assert labels[0] == 1          # exact-overlap anchor is fg
+    assert labels[1] in (0, -1) and labels[2] in (0, -1)
+    tgt = np.asarray(outs["TargetBBox"][0])
+    np.testing.assert_allclose(tgt[0], 0.0, atol=1e-6)  # perfect match
+
+
+def test_rpn_target_assign_unreachable_gt_and_crowd(rng):
+    """Code-review r4: a zero-IoU gt column (padding) must not promote
+    every anchor; crowd gts are excluded from matching."""
+    anchors = np.array([
+        [0, 0, 10, 10], [20, 20, 22, 22], [100, 100, 110, 110],
+    ], "float32")
+    gt = np.array([[0, 0, 10, 10], [500, 500, 510, 510]], "float32")
+    outs = lower("rpn_target_assign",
+                 {"Anchor": [anchors], "GtBoxes": [gt],
+                  "__rng_key__": [jax.random.PRNGKey(0)]},
+                 {"rpn_positive_overlap": 0.7,
+                  "rpn_negative_overlap": 0.3})
+    labels = np.asarray(outs["TargetLabel"][0]).reshape(-1)
+    assert labels[0] == 1
+    assert labels[1] != 1 and labels[2] != 1, labels
+    # crowd exclusion: marking gt 0 as crowd leaves no fg
+    outs2 = lower("rpn_target_assign",
+                  {"Anchor": [anchors], "GtBoxes": [gt[:1]],
+                   "IsCrowd": [np.array([1], "int32")],
+                   "__rng_key__": [jax.random.PRNGKey(0)]},
+                  {"rpn_positive_overlap": 0.7,
+                   "rpn_negative_overlap": 0.3})
+    labels2 = np.asarray(outs2["TargetLabel"][0]).reshape(-1)
+    assert (labels2 != 1).all(), labels2
