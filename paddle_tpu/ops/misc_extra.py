@@ -727,3 +727,91 @@ def _dequantize_abs_max(ins, attrs):
     x = first(ins, "X").astype(jnp.float32)
     scale = first(ins, "Scale").astype(jnp.float32).reshape(())
     return {"Out": [x * scale / attrs.get("max_range", 127.0)]}
+
+
+# ---------------------------------------------------------------------------
+# CTR / PS routing utilities
+# ---------------------------------------------------------------------------
+
+
+@register_op("filter_by_instag", nondiff_inputs=("Ins_tag", "Filter_tag"))
+def _filter_by_instag(ins, attrs):
+    """reference: paddle/fluid/operators/filter_by_instag_op.h — keep rows
+    whose tag list intersects the filter tags. Fixed-slate form: Ins
+    [B, D] with per-row tags Ins_tag [B, T] (-1 padded); kept rows stay in
+    place, dropped rows are zeroed (out_val_if_empty when nothing
+    matches), LossWeight [B, 1] is the keep mask, IndexMap maps kept rows
+    to themselves (the reference compacts; the static-shape contract
+    masks)."""
+    x = first(ins, "Ins")
+    tags = first(ins, "Ins_tag").astype(jnp.int64)
+    filt = first(ins, "Filter_tag").reshape(-1).astype(jnp.int64)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    keep = (tags[:, :, None] == filt[None, None, :]).any(axis=(1, 2))
+    none_kept = ~keep.any()
+    fill = attrs.get("out_val_if_empty", 0)
+    # kept rows pass through; dropped rows are zero. When NOTHING matches,
+    # the reference emits a dummy out_val_if_empty output with loss weight
+    # 0 (train on nothing) — here the whole slate becomes the fill value
+    # with all-zero weights.
+    out = jnp.where(
+        none_kept,
+        jnp.full_like(x, jnp.asarray(fill, x.dtype)),
+        jnp.where(keep[:, None], x, jnp.zeros((), x.dtype)),
+    )
+    lw = jnp.where(
+        none_kept,
+        jnp.zeros((x.shape[0], 1), jnp.float32),
+        keep[:, None].astype(jnp.float32),
+    )
+    B = x.shape[0]
+    idx = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int64)[:, None], (B, 2))
+    return {"Out": [out], "LossWeight": [lw], "IndexMap": [idx]}
+
+
+@register_op("merge_ids", nondiff_inputs=("Ids", "Rows", "X"))
+def _merge_ids(ins, attrs):
+    """reference: paddle/fluid/operators/distributed_ops/merge_ids_op.h —
+    reassemble rows pulled from sharded PS tables back into the original
+    id order: for each queried id, take its embedding from the shard that
+    owns it (row r of table r % nshards)."""
+    ids_list = ins["Ids"]
+    rows_list = ins["Rows"]
+    x_list = ins["X"]
+    outs = []
+    for ids in ids_list:
+        idv = ids.reshape(-1).astype(jnp.int32)
+        D = x_list[0].shape[-1]
+        out = jnp.zeros((idv.shape[0], D), x_list[0].dtype)
+        for rows, x in zip(rows_list, x_list):
+            rowv = rows.reshape(-1).astype(jnp.int32)
+            if rowv.shape[0] == 0:
+                continue  # a shard that owns none of the queried ids
+            # position of each queried id within this shard's row list
+            eq = idv[:, None] == rowv[None, :]            # [Q, R]
+            has = eq.any(axis=1)
+            pos = jnp.argmax(eq, axis=1)
+            out = jnp.where(has[:, None], x[pos], out)
+        outs.append(out)
+    return {"Out": outs}
+
+
+@register_op("split_ids", nondiff_inputs=("Ids",))
+def _split_ids(ins, attrs):
+    """reference: paddle/fluid/operators/distributed_ops/split_ids_op.h —
+    route ids to nshards PS tables by id % nshards. Fixed-slate form: each
+    shard output keeps the full width with non-member slots = -1 (the
+    reference compacts per shard; LoD-free contract masks instead)."""
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int64)
+    n = attrs.get("nshards", 0)
+    if not n:
+        raise EnforceError(
+            "split_ids needs an explicit 'nshards' attr (the reference "
+            "derives it from the Out arity, which a lowering cannot see)"
+        )
+    outs = []
+    for s in range(n):
+        m = (ids % n) == s
+        outs.append(jnp.where(m, ids, jnp.int64(-1))[:, None])
+    return {"Out": outs}

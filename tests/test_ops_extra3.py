@@ -761,3 +761,72 @@ def test_rpn_target_assign_unreachable_gt_and_crowd(rng):
                    "rpn_negative_overlap": 0.3})
     labels2 = np.asarray(outs2["TargetLabel"][0]).reshape(-1)
     assert (labels2 != 1).all(), labels2
+
+
+def test_filter_by_instag(rng):
+    x = rng.randn(4, 3).astype("float32")
+    tags = np.array([[1, -1], [2, 3], [7, -1], [3, 9]], "int64")
+    filt = np.array([3], "int64")
+    outs = lower("filter_by_instag",
+                 {"Ins": [x], "Ins_tag": [tags], "Filter_tag": [filt]})
+    out = np.asarray(outs["Out"][0])
+    lw = np.asarray(outs["LossWeight"][0]).reshape(-1)
+    np.testing.assert_allclose(out[1], x[1])
+    np.testing.assert_allclose(out[3], x[3])
+    np.testing.assert_allclose(out[0], 0.0)
+    np.testing.assert_array_equal(lw, [0, 1, 0, 1])
+
+
+def test_split_merge_ids_roundtrip(rng):
+    V, D, n = 20, 4, 2
+    table = rng.randn(V, D).astype("float32")
+    ids = np.array([3, 8, 5, 14], "int64")
+    sp = lower("split_ids", {"Ids": [ids]}, {"nshards": n})["Out"]
+    rows_list, x_list = [], []
+    for s in range(n):
+        shard_ids = np.asarray(sp[s]).reshape(-1)
+        rows = shard_ids[shard_ids >= 0]
+        rows_list.append(rows)
+        x_list.append(table[rows])
+    outs = lower("merge_ids",
+                 {"Ids": [ids], "Rows": rows_list, "X": x_list})
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), table[ids],
+                               rtol=1e-6)
+
+
+def test_filter_by_instag_fill_and_empty_semantics(rng):
+    """Code-review r4: dropped rows are ZERO; the fill value + zero loss
+    weights apply only when nothing matches."""
+    x = rng.randn(3, 2).astype("float32")
+    tags = np.array([[1], [3], [2]], "int64")
+    outs = lower("filter_by_instag",
+                 {"Ins": [x], "Ins_tag": [tags],
+                  "Filter_tag": [np.array([3], "int64")]},
+                 {"out_val_if_empty": 7})
+    out = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(out[0], 0.0)   # dropped -> 0, NOT 7
+    np.testing.assert_allclose(out[1], x[1])
+    # nothing matches: fill value everywhere, weights all zero
+    outs2 = lower("filter_by_instag",
+                  {"Ins": [x], "Ins_tag": [tags],
+                   "Filter_tag": [np.array([99], "int64")]},
+                  {"out_val_if_empty": 7})
+    np.testing.assert_allclose(np.asarray(outs2["Out"][0]), 7.0)
+    np.testing.assert_allclose(np.asarray(outs2["LossWeight"][0]), 0.0)
+
+
+def test_merge_ids_empty_shard_and_split_requires_nshards(rng):
+    import pytest as _pytest
+
+    from paddle_tpu.utils.enforce import EnforceError
+
+    table = rng.randn(10, 3).astype("float32")
+    ids = np.array([2, 4, 6], "int64")  # all even -> odd shard empty
+    outs = lower("merge_ids",
+                 {"Ids": [ids],
+                  "Rows": [ids, np.zeros((0,), "int64")],
+                  "X": [table[ids], np.zeros((0, 3), "float32")]})
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), table[ids],
+                               rtol=1e-6)
+    with _pytest.raises(EnforceError, match="nshards"):
+        lower("split_ids", {"Ids": [ids]}, {})
