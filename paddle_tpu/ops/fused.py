@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
 from paddle_tpu.ops.common import first, maybe
+from paddle_tpu.utils.enforce import EnforceError
 
 
 @register_op("fusion_lstm", nondiff_inputs=("Length",))
@@ -23,8 +24,6 @@ def _fusion_lstm(ins, attrs):
     folded in. X [B, S, M], WeightX [M, 4D], WeightH [D, 4D], Bias [1, 4D]
     (peepholes unsupported -> loud error). Gate order i, f, c, o
     (reference computeCtHt order ct = f*c + i*tanh(c_in))."""
-    from paddle_tpu.utils.enforce import EnforceError
-
     if attrs.get("use_peepholes", False):
         raise EnforceError("fusion_lstm: peephole connections unsupported")
     x = first(ins, "X")
@@ -208,3 +207,121 @@ def _pool_all(ins, attrs):
                             1.0)
             pools.append(s / (jnp.sqrt(n) if ptype == "SQRT" else n))
     return pools
+
+
+@register_op("attention_lstm", nondiff_inputs=("Length",))
+def _attention_lstm(ins, attrs):
+    """reference: paddle/fluid/operators/attention_lstm_op.cc — per step:
+    score[j] = relu(atted_x[j] + <c_prev, w_c>) (optionally scaled +
+    re-biased + relu'd), softmax over the sequence, context = sum_j a_j
+    x_j, then one LSTM step on the context. Padded form: X [B, S, M] +
+    Length; AttentionWeight [(M+D), 1]; LSTMWeight [(D+M), 4D] (rows
+    [0:D] hidden, [D:] input; gate order forget|input|output|tilde)."""
+    x = first(ins, "X")
+    aw = first(ins, "AttentionWeight")            # [(M+D), 1]
+    ab = maybe(ins, "AttentionBias")
+    ascalar = maybe(ins, "AttentionScalar")
+    asb = maybe(ins, "AttentionScalarBias")
+    lw = first(ins, "LSTMWeight")                 # [(D+M), 4D]
+    lb = first(ins, "LSTMBias")                   # [1, 4D]
+    c0 = first(ins, "C0")                         # [B, D]
+    h0 = maybe(ins, "H0")
+    lengths = maybe(ins, "Length")
+    B, S, M = x.shape
+    D = c0.shape[1]
+    w_x = aw[:M, 0]                               # [M]
+    w_c = aw[M:, 0]                               # [D]
+    atted = jnp.einsum("bsm,m->bs", x, w_x)
+    if ab is not None:
+        atted = atted + ab.reshape(())
+    wh = lw[:D]                                   # [D, 4D]
+    wx = lw[D:]                                   # [M, 4D]
+    h_prev = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
+    valid = (
+        jnp.arange(S)[None, :] < lengths.reshape(-1, 1)
+        if lengths is not None else jnp.ones((B, S), bool)
+    )
+
+    def step(carry, t):
+        h, c = carry
+        score = jax.nn.relu(atted + (c @ w_c)[:, None])     # [B, S]
+        if ascalar is not None:
+            score = score * ascalar.reshape(())
+            if asb is not None:
+                score = jax.nn.relu(score + asb.reshape(()))
+        score = jnp.where(valid, score, -1e30)
+        a = jax.nn.softmax(score, axis=1)
+        ctxv = jnp.einsum("bs,bsm->bm", a, x)               # [B, M]
+        gates = ctxv @ wx + h @ wh + lb.reshape(1, -1)
+        f = jax.nn.sigmoid(gates[:, :D])
+        i = jax.nn.sigmoid(gates[:, D:2 * D])
+        o = jax.nn.sigmoid(gates[:, 2 * D:3 * D])
+        g = jnp.tanh(gates[:, 3 * D:])
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        alive = (t < lengths.reshape(-1, 1)) if lengths is not None else \
+            jnp.ones((B, 1), bool)
+        h_new = jnp.where(alive, h_new, h)
+        c_new = jnp.where(alive, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_prev, c0), jnp.arange(S))
+    return {
+        "Hidden": [jnp.swapaxes(hs, 0, 1)],
+        "Cell": [jnp.swapaxes(cs, 0, 1)],
+    }
+
+
+@register_op("tree_conv", nondiff_inputs=("EdgeSet",))
+def _tree_conv(ins, attrs):
+    """reference: paddle/fluid/operators/tree_conv_op.h + math/tree2col.h —
+    TBCNN continuous-binary-tree convolution. Patch of node n = n plus its
+    direct children (the max_depth=2 window; deeper windows raise — the
+    dominant TBCNN config). Mixing weights per patch member v:
+    eta_t = (d - depth)/d, eta_l = (1-eta_t) * (idx-1)/(pclen-1) (0.5 when
+    an only child), eta_r = (1-eta_t)(1-...). NodesVector [B, N, F],
+    EdgeSet [B, E, 2] (parent, child; negative = padding),
+    Filter [F, 3, O, K] -> Out [B, N, O*K]."""
+    nodes = first(ins, "NodesVector")
+    edges = first(ins, "EdgeSet").astype(jnp.int32)
+    w = first(ins, "Filter")                      # [F, 3, O, K]
+    max_depth = attrs.get("max_depth", 2)
+    if max_depth != 2:
+        raise EnforceError(
+            f"tree_conv: only max_depth=2 (node + direct children) is "
+            f"implemented; got {max_depth}"
+        )
+    B, N, F = nodes.shape
+    E = edges.shape[1]
+    O, K = w.shape[2], w.shape[3]
+    wt, wl, wr = w[:, 0], w[:, 1], w[:, 2]        # [F, O, K]
+    d = float(max_depth)
+
+    def per_tree(x, es):
+        parent = es[:, 0]
+        child = es[:, 1]
+        ev = (parent >= 0) & (child >= 0)
+        # sibling stats per edge: count + 1-based order among same parent
+        same = (parent[:, None] == parent[None, :]) & ev[:, None] & ev[None, :]
+        pclen = same.sum(axis=1)
+        order = jnp.tril(same).sum(axis=1)        # rank by edge position
+        eta_t = (d - 1.0) / d
+        frac = jnp.where(pclen == 1, 0.5,
+                         (order - 1.0) / jnp.maximum(pclen - 1.0, 1.0))
+        eta_l = (1.0 - eta_t) * frac
+        eta_r = (1.0 - eta_t) * (1.0 - frac)
+        # root term: depth 0 -> eta_t = 1
+        out = jnp.einsum("nf,fok->nok", x, wt)
+        # child contributions scattered to their parent
+        xc = x[jnp.clip(child, 0, N - 1)]          # [E, F]
+        contrib = (
+            eta_t * jnp.einsum("ef,fok->eok", xc, wt).reshape(E, -1)
+            + eta_l[:, None] * jnp.einsum("ef,fok->eok", xc, wl).reshape(E, -1)
+            + eta_r[:, None] * jnp.einsum("ef,fok->eok", xc, wr).reshape(E, -1)
+        )                                          # [E, O*K]
+        contrib = jnp.where(ev[:, None], contrib, 0.0)
+        out = out.reshape(N, -1).at[jnp.clip(parent, 0, N - 1)].add(contrib)
+        return out
+
+    out = jax.vmap(per_tree)(nodes, edges)   # [B, N, O*K]
+    return {"Out": [out]}

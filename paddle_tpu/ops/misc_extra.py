@@ -748,7 +748,12 @@ def _filter_by_instag(ins, attrs):
     filt = first(ins, "Filter_tag").reshape(-1).astype(jnp.int64)
     if tags.ndim == 1:
         tags = tags[:, None]
-    keep = (tags[:, :, None] == filt[None, None, :]).any(axis=(1, 2))
+    # exclude the -1 padding sentinel on BOTH sides: a padded filter slot
+    # must not match every padded row
+    keep = (
+        (tags[:, :, None] == filt[None, None, :])
+        & (tags[:, :, None] >= 0)
+    ).any(axis=(1, 2))
     none_kept = ~keep.any()
     fill = attrs.get("out_val_if_empty", 0)
     # kept rows pass through; dropped rows are zero. When NOTHING matches,

@@ -154,3 +154,66 @@ def test_fusion_ops(rng):
     assert hid.shape == (B, S, D) and np.isfinite(hid).all()
     # masked tail keeps the last live hidden
     np.testing.assert_allclose(hid[0, 2], hid[0, 3])
+
+
+def test_attention_lstm_and_tree_conv(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    def lower(op, ins, attrs=None):
+        ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+        return get_op_def(op).lower(ins, attrs or {})
+
+    # attention_lstm: shapes + a one-position sequence reduces to plain LSTM
+    B, S, M, D = 2, 4, 3, 5
+    x = rng.randn(B, S, M).astype("float32")
+    aw = rng.randn(M + D, 1).astype("float32")
+    lw = rng.randn(D + M, 4 * D).astype("float32")
+    lb = rng.randn(1, 4 * D).astype("float32")
+    c0 = rng.randn(B, D).astype("float32")
+    outs = lower("attention_lstm",
+                 {"X": [x], "AttentionWeight": [aw], "LSTMWeight": [lw],
+                  "LSTMBias": [lb], "C0": [c0]})
+    assert np.asarray(outs["Hidden"][0]).shape == (B, S, D)
+    # S=1: softmax over one position -> context == x[:, 0]
+    outs1 = lower("attention_lstm",
+                  {"X": [x[:, :1]], "AttentionWeight": [aw],
+                   "LSTMWeight": [lw], "LSTMBias": [lb], "C0": [c0]})
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    gates = x[:, 0] @ lw[D:] + lb
+    f, i = sig(gates[:, :D]), sig(gates[:, D:2*D])
+    o, g = sig(gates[:, 2*D:3*D]), np.tanh(gates[:, 3*D:])
+    c1 = f * c0 + i * g
+    np.testing.assert_allclose(
+        np.asarray(outs1["Cell"][0])[:, 0], c1, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs1["Hidden"][0])[:, 0], o * np.tanh(c1), rtol=1e-4
+    )
+
+    # tree_conv: a root with two children; root output = Wt x_root +
+    # children mixed by eta
+    F_, O, K = 3, 2, 2
+    nodesv = rng.randn(1, 3, F_).astype("float32")
+    edges = np.array([[[0, 1], [0, 2], [-1, -1]]], "int32")
+    w = rng.randn(F_, 3, O, K).astype("float32")
+    out = np.asarray(lower(
+        "tree_conv", {"NodesVector": [nodesv], "EdgeSet": [edges],
+                      "Filter": [w]}, {"max_depth": 2})["Out"][0])
+    assert out.shape == (1, 3, O * K)
+    # leaves have no children: out = Wt x
+    np.testing.assert_allclose(
+        out[0, 1], (nodesv[0, 1] @ w[:, 0].reshape(F_, -1)), rtol=1e-4
+    )
+    # root: Wt x0 + sum over children of eta-mixed contributions
+    eta_t = 0.5
+    c1c = nodesv[0, 1] @ (
+        eta_t * w[:, 0] + 0.0 * w[:, 1] + 0.5 * w[:, 2]
+    ).reshape(F_, -1)
+    c2c = nodesv[0, 2] @ (
+        eta_t * w[:, 0] + 0.5 * w[:, 1] + 0.0 * w[:, 2]
+    ).reshape(F_, -1)
+    expect_root = nodesv[0, 0] @ w[:, 0].reshape(F_, -1) + c1c + c2c
+    np.testing.assert_allclose(out[0, 0], expect_root, rtol=1e-3)

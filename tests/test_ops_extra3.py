@@ -830,3 +830,14 @@ def test_merge_ids_empty_shard_and_split_requires_nshards(rng):
                                rtol=1e-6)
     with _pytest.raises(EnforceError, match="nshards"):
         lower("split_ids", {"Ids": [ids]}, {})
+
+
+def test_filter_by_instag_padding_sentinel(rng):
+    """-1 padded filter slots must not match -1 padded tag slots."""
+    x = rng.randn(2, 2).astype("float32")
+    tags = np.array([[5, -1], [3, -1]], "int64")
+    outs = lower("filter_by_instag",
+                 {"Ins": [x], "Ins_tag": [tags],
+                  "Filter_tag": [np.array([3, -1], "int64")]})
+    lw = np.asarray(outs["LossWeight"][0]).reshape(-1)
+    np.testing.assert_array_equal(lw, [0, 1])
