@@ -1,0 +1,175 @@
+"""Seq2seq (GRU encoder-decoder with attention) + beam-search inference —
+the reference model zoo's machine-translation workload (PaddleNLP
+seq2seq/rnn_search, built on fluid layers + beam_search ops).
+
+Training uses teacher forcing over padded+lengths batches; inference runs
+the fixed-beam beam_search op step-by-step from the host (the reference's
+while_loop + LoDTensorArray plumbing is a design refusal here — see
+layers/control_flow.py) and backtracks with beam_search_decode.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.param_attr import ParamAttr
+
+
+def _gru_layer(x, hidden_size, name, h0=None):
+    """Unidirectional fusion_gru over [B, S, M] (optional initial state)."""
+    helper = LayerHelper(name)
+    M = x.shape[-1]
+    init = fluid.initializer.XavierInitializer()
+    wx = helper.create_parameter(
+        ParamAttr(name=f"{name}_wx", initializer=init),
+        shape=[M, 3 * hidden_size], dtype="float32",
+    )
+    wh = helper.create_parameter(
+        ParamAttr(name=f"{name}_wh", initializer=init),
+        shape=[hidden_size, 3 * hidden_size], dtype="float32",
+    )
+    out = helper.create_variable_for_type_inference("float32")
+    ins = {"X": [x.name], "WeightX": [wx.name], "WeightH": [wh.name]}
+    if h0 is not None:
+        ins["H0"] = [h0.name]
+    helper.append_op("fusion_gru", ins, {"Hidden": [out.name]}, {})
+    return out
+
+
+def build_seq2seq_train(src_vocab, tgt_vocab, hidden=64, emb=32,
+                        src_len=12, tgt_len=10, lr=1e-3):
+    """Teacher-forced training program. Returns (main, startup, feeds,
+    loss)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.data("src", [-1, src_len], dtype="int64")
+        tgt_in = fluid.data("tgt_in", [-1, tgt_len], dtype="int64")
+        tgt_out = fluid.data("tgt_out", [-1, tgt_len], dtype="int64")
+        src_emb = fluid.layers.embedding(
+            src, size=[src_vocab, emb],
+            param_attr=ParamAttr(name="src_emb"),
+        )
+        enc = _gru_layer(src_emb, hidden, "enc_gru")      # [B, S, H]
+        tgt_emb = fluid.layers.embedding(
+            tgt_in, size=[tgt_vocab, emb],
+            param_attr=ParamAttr(name="tgt_emb"),
+        )
+        dec = _gru_layer(tgt_emb, hidden, "dec_gru")      # [B, T, H]
+        # Luong-style attention: scores = dec @ enc^T, context = softmax@enc
+        scores = fluid.layers.matmul(dec, enc, transpose_y=True)
+        probs = fluid.layers.softmax(scores)
+        ctx = fluid.layers.matmul(probs, enc)             # [B, T, H]
+        feat = fluid.layers.concat([dec, ctx], axis=-1)
+        logits = fluid.layers.fc(
+            feat, size=tgt_vocab, num_flatten_dims=2,
+            param_attr=ParamAttr(name="s2s_out_w"),
+            bias_attr=ParamAttr(name="s2s_out_b"),
+        )
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, fluid.layers.reshape(tgt_out, [0, tgt_len, 1])
+            )
+        )
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, [src, tgt_in, tgt_out], loss
+
+
+def build_decode_step(src_vocab, tgt_vocab, hidden=64, emb=32, src_len=12,
+                      beam=4, end_id=1):
+    """One inference step as a program: (enc_states, prev_token, prev_h,
+    pre_ids, pre_scores) -> (next beam selections, new hidden).
+
+    The host loop feeds selections back in (models/seq2seq.py
+    beam_search_infer)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = fluid.data("enc", [-1, src_len, hidden])     # [B*W, S, H]
+        tok = fluid.data("tok", [-1, 1], dtype="int64")    # [B*W, 1]
+        h_prev = fluid.data("h_prev", [-1, hidden])
+        pre_ids = fluid.data("pre_ids", [-1, beam], dtype="int64")
+        pre_scores = fluid.data("pre_scores", [-1, beam])
+        temb = fluid.layers.embedding(
+            tok, size=[tgt_vocab, emb],
+            param_attr=ParamAttr(name="tgt_emb"),
+        )
+        temb = fluid.layers.reshape(temb, [0, 1, emb])
+        dec1 = _gru_layer(temb, hidden, "dec_gru", h0=h_prev)
+        dec = fluid.layers.reshape(dec1, [0, 1, hidden])
+        scores_att = fluid.layers.matmul(dec, enc, transpose_y=True)
+        probs_att = fluid.layers.softmax(scores_att)
+        ctx = fluid.layers.matmul(probs_att, enc)
+        feat = fluid.layers.concat([dec, ctx], axis=-1)
+        logits = fluid.layers.fc(
+            feat, size=tgt_vocab, num_flatten_dims=2,
+            param_attr=ParamAttr(name="s2s_out_w"),
+            bias_attr=ParamAttr(name="s2s_out_b"),
+        )
+        logp = fluid.layers.log_softmax(logits)            # [B*W, 1, V]
+        # top-K expansions per live beam
+        topk_scores, topk_ids = fluid.layers.topk(
+            fluid.layers.reshape(logp, [0, tgt_vocab]), k=beam
+        )
+        # fixed-beam step over [B, W, K]
+        ids3 = fluid.layers.reshape(topk_ids, [-1, beam, beam])
+        sc3 = fluid.layers.reshape(topk_scores, [-1, beam, beam])
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, ids3, sc3, beam_size=beam, end_id=end_id,
+            is_accumulated=False,
+        )
+        new_h = fluid.layers.reshape(dec, [0, hidden])
+    return main, startup, {
+        "sel_ids": sel_ids, "sel_scores": sel_scores, "parent": parent,
+        "new_h": new_h,
+    }
+
+
+def beam_search_infer(exe, enc_main, enc_fetch, step_prog, step_outs,
+                     src_batch, tgt_len, beam=4, hidden=64, start_id=0,
+                     end_id=1):
+    """Host-driven beam search: encode once, then step the decode program,
+    gathering hidden states by parent pointers between steps; decode with
+    beam_search_decode at the end. Returns [B, beam, T] sentences."""
+    B, S = src_batch.shape
+    enc_out = exe.run(enc_main, feed={"src": src_batch},
+                      fetch_list=[enc_fetch])[0]
+    enc_np = np.asarray(enc_out)                           # [B, S, H]
+    enc_tiled = np.repeat(enc_np, beam, axis=0)            # [B*W, S, H]
+    tok = np.full((B * beam, 1), start_id, "int64")
+    h = np.zeros((B * beam, hidden), "float32")
+    pre_ids = np.full((B, beam), start_id, "int64")
+    pre_scores = np.zeros((B, beam), "float32")
+    pre_scores[:, 1:] = -1e9  # only beam 0 live at step 0 (avoid dup paths)
+    hist_ids, hist_parents = [], []
+    for _ in range(tgt_len):
+        outs = exe.run(step_prog, feed={
+            "enc": enc_tiled, "tok": tok, "h_prev": h,
+            "pre_ids": pre_ids, "pre_scores": pre_scores,
+        }, fetch_list=[step_outs["sel_ids"], step_outs["sel_scores"],
+                       step_outs["parent"], step_outs["new_h"]])
+        sel_ids = np.asarray(outs[0]).astype("int64")      # [B, W]
+        pre_scores = np.asarray(outs[1])
+        parent = np.asarray(outs[2]).astype("int64")
+        new_h = np.asarray(outs[3]).reshape(B, beam, hidden)
+        # each selected lane continues from its parent's hidden state
+        h = np.take_along_axis(new_h, parent[:, :, None], axis=1
+                               ).reshape(B * beam, hidden)
+        tok = sel_ids.reshape(B * beam, 1)
+        pre_ids = sel_ids
+        hist_ids.append(sel_ids)
+        hist_parents.append(parent)
+    # backtrack on the static side
+    from paddle_tpu.core.ir import Program, program_guard
+
+    dmain, dstart = Program(), Program()
+    with program_guard(dmain, dstart):
+        ids_v = fluid.data("ids_v", [len(hist_ids), B, beam], dtype="int64")
+        par_v = fluid.data("par_v", [len(hist_ids), B, beam], dtype="int32")
+        sc_v = fluid.data("sc_v", [B, beam])
+        sent, sc = fluid.layers.beam_search_decode(ids_v, par_v, sc_v)
+    exe.run(dstart)
+    out = exe.run(dmain, feed={
+        "ids_v": np.stack(hist_ids),
+        "par_v": np.stack(hist_parents).astype("int32"),
+        "sc_v": pre_scores,
+    }, fetch_list=[sent, sc])
+    return np.asarray(out[0]), np.asarray(out[1])
