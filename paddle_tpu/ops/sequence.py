@@ -527,3 +527,36 @@ def _beam_search_decode(ins, attrs):
     # toks [T, B, W] in reverse time order -> [B, W, T] forward
     sent = jnp.flip(jnp.transpose(toks, (1, 2, 0)), axis=2)
     return {"SentenceIds": [sent], "SentenceScores": [scores]}
+
+
+@register_op("sequence_topk_avg_pooling", nondiff_inputs=("ROW", "COLUMN",
+                                                          "Length"))
+def _sequence_topk_avg_pooling(ins, attrs):
+    """reference: sequence_ops/sequence_topk_avg_pooling_op.cc — for each
+    (row, channel), average the top-k values along the last axis, one
+    output column per k in `topks`. Padded form: X [B, C, N, M] (the
+    match-matrix output), optional Length [B] masking columns."""
+    x = first(ins, "X")
+    topks = [int(k) for k in attrs.get("topks", [1])]
+    lengths = maybe(ins, "Length")
+    B, C, N, M = x.shape
+    kmax = min(max(topks), M)
+    xv = x
+    if lengths is not None:
+        mask = jnp.arange(M)[None, None, None, :] < lengths.reshape(
+            -1, 1, 1, 1
+        )
+        xv = jnp.where(mask, x, _NEG)
+    top = jax.lax.top_k(xv, kmax)[0]              # [B, C, N, kmax]
+    top = jnp.where(top <= _NEG / 2, 0.0, top)
+    outs = []
+    for k in topks:
+        # the reference ALWAYS divides by k, even when fewer than k values
+        # exist (shorter rows contribute a smaller average, not a rescaled
+        # one) — consistent with the masked-Length path above
+        kk = min(k, M)
+        outs.append(top[..., :kk].sum(axis=-1) / float(k))  # [B, C, N]
+    out = jnp.stack(outs, axis=-1)                # [B, C, N, K]
+    # reference layout: [B, N, C*K]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(B, N, -1)
+    return {"Out": [out], "pos": [jnp.zeros((B, 1), jnp.int32)]}

@@ -735,3 +735,71 @@ def _rpn_target_assign(ins, attrs):
             .astype(jnp.float32)
         ],
     }
+
+
+@register_op("roi_perspective_transform",
+             nondiff_inputs=("ROIs", "RoisNum", "BatchId"))
+def _roi_perspective_transform(ins, attrs):
+    """reference: detection/roi_perspective_transform_op.cc — warp each
+    quadrilateral RoI (8 coords: x0..y3 clockwise from top-left) into an
+    axis-aligned [H, W] patch via the reference's homography estimate.
+    Out-of-image samples are 0 and columns beyond the per-RoI normalized
+    width are masked; the reference's additional per-pixel in_quad test
+    only differs for DEGENERATE (concave/self-intersecting) quads, which
+    are not checked here. The per-RoI normalized width/height adaptation
+    is kept (matrix built exactly as get_transform_matrix)."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs")                     # [R, 8]
+    H = attrs.get("transformed_height", 8)
+    W = attrs.get("transformed_width", 8)
+    scale = attrs.get("spatial_scale", 1.0)
+    R = rois.shape[0]
+    bi = _roi_batch_ids(ins, R)
+    rx = rois[:, 0::2] * scale                    # [R, 4]
+    ry = rois[:, 1::2] * scale
+    x0, x1, x2, x3 = rx[:, 0], rx[:, 1], rx[:, 2], rx[:, 3]
+    y0, y1, y2, y3 = ry[:, 0], ry[:, 1], ry[:, 2], ry[:, 3]
+    len1 = jnp.hypot(x0 - x1, y0 - y1)
+    len2 = jnp.hypot(x1 - x2, y1 - y2)
+    len3 = jnp.hypot(x2 - x3, y2 - y3)
+    len4 = jnp.hypot(x3 - x0, y3 - y0)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    nh = max(2, H)
+    nw = jnp.clip(
+        jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-5)) + 1, 2, W
+    )
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m3 = (y1 - y0 + m6 * (nw - 1) * y1) / (nw - 1)
+    m4 = (y3 - y0 + m7 * (nh - 1) * y3) / (nh - 1)
+    m0 = (x1 - x0 + m6 * (nw - 1) * x1) / (nw - 1)
+    m1 = (x3 - x0 + m7 * (nh - 1) * x3) / (nh - 1)
+    ow = jnp.arange(W, dtype=jnp.float32)
+    oh = jnp.arange(H, dtype=jnp.float32)
+    owg = jnp.broadcast_to(ow[None, None, :], (R, H, W))
+    ohg = jnp.broadcast_to(oh[None, :, None], (R, H, W))
+    u = m0[:, None, None] * owg + m1[:, None, None] * ohg + x0[:, None, None]
+    v = m3[:, None, None] * owg + m4[:, None, None] * ohg + y0[:, None, None]
+    wdiv = m6[:, None, None] * owg + m7[:, None, None] * ohg + 1.0
+    in_w = u / wdiv
+    in_h = v / wdiv
+    sampled = _bilinear_gather(
+        x, bi, in_h.reshape(R, -1), in_w.reshape(R, -1)
+    )  # [R, H*W, C] — zero outside the image
+    C = x.shape[1]
+    out = jnp.transpose(
+        sampled.reshape(R, H, W, C), (0, 3, 1, 2)
+    )
+    # mask positions beyond this roi's normalized width (nw varies per roi)
+    wmask = ow[None, None, :] < nw[:, None, None]
+    out = out * wmask[:, None, :, :].astype(out.dtype)
+    return {"Out": [out.astype(x.dtype)],
+            "Out2InIdx": [jnp.zeros((R, 1), jnp.int32)],
+            "Out2InWeights": [jnp.zeros((R, 1), jnp.float32)],
+            "TransformMatrix": [jnp.stack(
+                [m0, m1, x0, m3, m4, y0, m6, m7, jnp.ones_like(m0)], axis=1
+            )]}

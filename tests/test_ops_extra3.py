@@ -841,3 +841,41 @@ def test_filter_by_instag_padding_sentinel(rng):
                   "Filter_tag": [np.array([3, -1], "int64")]})
     lw = np.asarray(outs["LossWeight"][0]).reshape(-1)
     np.testing.assert_array_equal(lw, [0, 1])
+
+
+def test_roi_perspective_transform_axis_aligned(rng):
+    """An axis-aligned rectangular quad reduces to plain cropping."""
+    x = np.arange(100, dtype="float32").reshape(1, 1, 10, 10)
+    # rectangle corners clockwise from top-left: (1,1),(4,1),(4,4),(1,4)
+    rois = np.array([[1, 1, 4, 1, 4, 4, 1, 4]], "float32")
+    outs = lower("roi_perspective_transform", {"X": [x], "ROIs": [rois]},
+                 {"transformed_height": 4, "transformed_width": 4,
+                  "spatial_scale": 1.0})
+    out = np.asarray(outs["Out"][0])
+    assert out.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(out[0, 0], x[0, 0, 1:5, 1:5], rtol=1e-4)
+
+
+def test_sequence_topk_avg_pooling(rng):
+    x = rng.randn(2, 3, 4, 6).astype("float32")
+    outs = lower("sequence_topk_avg_pooling", {"X": [x]},
+                 {"topks": [1, 3]})
+    out = np.asarray(outs["Out"][0])
+    assert out.shape == (2, 4, 6)  # [B, N, C*K]
+    srt = -np.sort(-x, axis=-1)
+    expect1 = srt[..., 0]                      # top-1 avg
+    expect3 = srt[..., :3].mean(-1)
+    got = out.reshape(2, 4, 3, 2)
+    np.testing.assert_allclose(got[..., 0], expect1.transpose(0, 2, 1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[..., 1], expect3.transpose(0, 2, 1),
+                               rtol=1e-5)
+
+
+def test_sequence_topk_avg_divides_by_full_k(rng):
+    x = rng.randn(1, 1, 2, 2).astype("float32")
+    outs = lower("sequence_topk_avg_pooling", {"X": [x]}, {"topks": [3]})
+    out = np.asarray(outs["Out"][0])
+    expect = (-np.sort(-x, axis=-1)).sum(-1) / 3.0  # sum of 2 / k=3
+    np.testing.assert_allclose(out.reshape(1, 2), expect.reshape(1, 2),
+                               rtol=1e-5)
