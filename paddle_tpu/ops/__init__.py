@@ -24,5 +24,6 @@ from paddle_tpu.ops import moe  # noqa: F401
 from paddle_tpu.ops import misc_extra  # noqa: F401
 from paddle_tpu.ops import vision_extra  # noqa: F401
 from paddle_tpu.ops import fused  # noqa: F401
+from paddle_tpu.ops import yolo_loss  # noqa: F401
 from paddle_tpu.ops import extras  # noqa: F401
 from paddle_tpu.ops import crf  # noqa: F401

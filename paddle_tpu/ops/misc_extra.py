@@ -820,3 +820,121 @@ def _split_ids(ins, attrs):
         m = (ids % n) == s
         outs.append(jnp.where(m, ids, jnp.int64(-1))[:, None])
     return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# final parity tranche
+# ---------------------------------------------------------------------------
+
+
+@register_op("unsqueeze")
+def _unsqueeze_v1(ins, attrs):
+    """reference: paddle/fluid/operators/unsqueeze_op.cc (v1 = v2 minus
+    the XShape bookkeeping output; delegates)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    return {"Out": get_op_def("unsqueeze2").lower(ins, attrs)["Out"]}
+
+
+@register_op("uniform_random_batch_size_like", stateful=True,
+             nondiff_inputs=("Input",))
+def _uniform_random_bsl(ins, attrs):
+    """reference: paddle/fluid/operators/uniform_random_batch_size_like_op.cc."""
+    from paddle_tpu.ops.common import np_dtype, seeded_rng_key
+
+    ref = first(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)
+    ]
+    key = seeded_rng_key(ins, attrs)
+    out = jax.random.uniform(
+        key, tuple(shape), jnp.float32,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0),
+    )
+    return {"Out": [out.astype(jnp.dtype(np_dtype(attrs)))]}
+
+
+@register_op("unique", nondiff_inputs=("X",))
+def _unique(ins, attrs):
+    """reference: paddle/fluid/operators/unique_op.h — static-shape form:
+    Out keeps X's length with unique values FRONT-compacted (first
+    occurrence order is NOT preserved — values are sorted, the tail
+    repeats the last unique; jnp.unique's size= contract); Index maps each
+    input element to its unique slot. The reference's dynamic-size output
+    cannot exist under XLA; consumers read Count/Index."""
+    x = first(ins, "X").reshape(-1)
+    uniq, idx = jnp.unique(
+        x, return_inverse=True, size=x.shape[0], fill_value=x[-1]
+    )
+    return {"Out": [uniq], "Index": [idx.astype(jnp.int32)]}
+
+
+@register_op("unique_with_counts", nondiff_inputs=("X",))
+def _unique_with_counts(ins, attrs):
+    """reference: paddle/fluid/operators/unique_with_counts_op.h — unique +
+    per-value occurrence counts (same static-shape contract as unique)."""
+    x = first(ins, "X").reshape(-1)
+    uniq, idx, counts = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=x.shape[0],
+        fill_value=x[-1],
+    )
+    return {
+        "Out": [uniq],
+        "Index": [idx.astype(jnp.int32)],
+        "Count": [counts.astype(jnp.int32)],
+    }
+
+
+@register_op("lookup_table_dequant", nondiff_inputs=("Ids", "W"))
+def _lookup_table_dequant(ins, attrs):
+    """reference: paddle/fluid/operators/lookup_table_dequant_op.h — int8
+    embedding rows stored as [min, max, q0..qD]:
+    out = q * (max - min) / 2^8 + min per row (dequant<T> there)."""
+    w = first(ins, "W")
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    rows = w[ids].astype(jnp.float32)
+    mn = rows[:, 0:1]
+    mx = rows[:, 1:2]
+    return {"Out": [rows[:, 2:] * (mx - mn) / 256.0 + mn]}
+
+
+@register_op("dgc_clip_by_norm")
+def _dgc_clip_by_norm(ins, attrs):
+    """reference: paddle/fluid/operators/dgc_clip_by_norm_op.h —
+    clip_by_norm gated on current_step >= rampup_begin_step."""
+    x = first(ins, "X").astype(jnp.float32)
+    step = first(ins, "current_step").reshape(())
+    begin = attrs.get("rampup_begin_step", 0.0)
+    max_norm = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    clipped = x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-10))
+    return {"Out": [jnp.where(step < begin, x, clipped)]}
+
+
+@register_op("get_tensor_from_selected_rows", nondiff_inputs=())
+def _get_tensor_from_selected_rows(ins, attrs):
+    """reference: paddle/fluid/operators/get_tensor_from_selected_rows_op.cc
+    — identity here: the dense path has no SelectedRows runtime type
+    (sgd_sparse/sparse_weight_update carry the rows+ids design instead)."""
+    return {"Out": [first(ins, "X")]}
+
+
+@register_op("merge_selected_rows", nondiff_inputs=())
+def _merge_selected_rows(ins, attrs):
+    """reference: paddle/fluid/operators/merge_selected_rows_op.cc —
+    duplicate-row accumulation. Dense-path identity (duplicates are
+    already segment-summed inside gather vjps; see sgd_sparse)."""
+    return {"Out": [first(ins, "X")]}
+
+
+@register_op("sync_batch_norm", nondiff_inputs=("Mean", "Variance"))
+def _sync_batch_norm(ins, attrs):
+    """reference: paddle/fluid/operators/sync_batch_norm_op.cu — cross-
+    device batch statistics. Under GSPMD the batch_norm reductions over a
+    'data'-sharded batch ALREADY span every device (the partitioner
+    inserts the cross-replica psums the reference hand-wrote with NCCL),
+    so sync_batch_norm lowers to batch_norm unchanged."""
+    from paddle_tpu.core.registry import get_op_def
+
+    return get_op_def("batch_norm").lower(ins, attrs)

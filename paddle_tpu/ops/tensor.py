@@ -141,7 +141,9 @@ def _squeeze2(ins, attrs):
 def _unsqueeze2(ins, attrs):
     x = first(ins, "X")
     out = x
-    for a in sorted(attrs.get("axes", [])):
+    # reference inserts axes in DECLARATION order, each against the rank
+    # grown so far (unsqueeze_op.cc GetOutputShape) — do not sort
+    for a in attrs.get("axes", []):
         out = jnp.expand_dims(out, a)
     return {"Out": [out], "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
 

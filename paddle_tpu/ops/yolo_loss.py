@@ -1,0 +1,203 @@
+"""YOLOv3 training loss (reference: paddle/fluid/operators/detection/
+yolov3_loss_op.h) — completes the YOLO family next to ops/detection.py's
+yolo_box.
+
+Per scale: X [N, S*(5+K), H, W] raw predictions; GTBox [N, B, 4]
+normalized (cx, cy, w, h); GTLabel [N, B] (zero-area boxes = padding).
+Targets are built with a lax.scan over the (static) B ground-truth slots —
+later boxes overwrite earlier ones on cell/anchor collision, matching the
+reference's sequential loop. Anchors are chosen by best WH-IoU over ALL
+anchors; only assignments landing in this scale's anchor_mask train.
+Objectness negatives ignore predictions whose decoded box overlaps any gt
+above ignore_thresh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first, maybe
+
+
+def _sce(x, t):
+    """Sigmoid cross entropy (stable)."""
+    return jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ins, attrs):
+    x = first(ins, "X").astype(jnp.float32)
+    gtbox = first(ins, "GTBox").astype(jnp.float32)   # [N, B, 4]
+    gtlabel = first(ins, "GTLabel").astype(jnp.int32)  # [N, B]
+    gtscore = maybe(ins, "GTScore")
+    anchors = [float(a) for a in attrs["anchors"]]
+    mask = [int(m) for m in attrs["anchor_mask"]]
+    K = attrs["class_num"]
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    smooth = attrs.get("use_label_smooth", True)
+    N, C, H, W = x.shape
+    S = len(mask)
+    A = len(anchors) // 2
+    an_w = jnp.asarray(anchors[0::2], jnp.float32)
+    an_h = jnp.asarray(anchors[1::2], jnp.float32)
+    input_size = downsample * H
+    p = x.reshape(N, S, 5 + K, H, W)
+    tx, ty = p[:, :, 0], p[:, :, 1]
+    tw, th = p[:, :, 2], p[:, :, 3]
+    tobj = p[:, :, 4]
+    tcls = p[:, :, 5:]                                 # [N, S, K, H, W]
+    gs = (
+        gtscore.astype(jnp.float32)
+        if gtscore is not None
+        else jnp.ones(gtlabel.shape, jnp.float32)
+    )
+    B = gtbox.shape[1]
+    valid = (gtbox[:, :, 2] > 0) & (gtbox[:, :, 3] > 0)  # [N, B]
+
+    # best anchor per gt by WH IoU over ALL anchors
+    gw = gtbox[:, :, 2] * input_size                   # pixels
+    gh = gtbox[:, :, 3] * input_size
+    inter = jnp.minimum(gw[:, :, None], an_w) * jnp.minimum(
+        gh[:, :, None], an_h
+    )
+    union = gw[:, :, None] * gh[:, :, None] + an_w * an_h - inter
+    wh_iou = inter / jnp.maximum(union, 1e-10)         # [N, B, A]
+    best_a = jnp.argmax(wh_iou, axis=2)                # [N, B]
+    mask_arr = jnp.asarray(mask, jnp.int32)
+    in_scale = (best_a[:, :, None] == mask_arr[None, None, :])
+    scale_slot = jnp.argmax(in_scale, axis=2)          # [N, B] index into S
+    assigned = in_scale.any(axis=2) & valid            # [N, B]
+
+    gi = jnp.clip((gtbox[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtbox[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+    t_x = gtbox[:, :, 0] * W - gi                      # in (0,1)
+    t_y = gtbox[:, :, 1] * H - gj
+    t_w = jnp.log(jnp.maximum(gw, 1e-8) / jnp.maximum(an_w[best_a], 1e-8))
+    t_h = jnp.log(jnp.maximum(gh, 1e-8) / jnp.maximum(an_h[best_a], 1e-8))
+    # reference scales box loss by (2 - w*h) * score (mixup weight)
+    box_scale = (2.0 - gtbox[:, :, 2] * gtbox[:, :, 3]) * gs
+
+    # scatter targets box-by-box (later gt wins collisions, like the
+    # reference's loop)
+    def build(n_idx):
+        def body(carry, b):
+            t_map, obj_map, cls_map, sc_map = carry
+            s = scale_slot[n_idx, b]
+            i = gi[n_idx, b]
+            j = gj[n_idx, b]
+            on = assigned[n_idx, b]
+
+            def put(m, v):
+                return jnp.where(on, m.at[:, s, j, i].set(v), m)
+
+            t_map = jnp.where(
+                on,
+                t_map.at[:, s, j, i].set(jnp.stack([
+                    t_x[n_idx, b], t_y[n_idx, b],
+                    t_w[n_idx, b], t_h[n_idx, b],
+                    box_scale[n_idx, b],
+                ])),
+                t_map,
+            )
+            obj_map = jnp.where(
+                on, obj_map.at[s, j, i].set(gs[n_idx, b]), obj_map
+            )
+            cls_map = jnp.where(
+                on,
+                cls_map.at[:, s, j, i].set(
+                    jax.nn.one_hot(gtlabel[n_idx, b], K)
+                ),
+                cls_map,
+            )
+            sc_map = jnp.where(on, sc_map.at[s, j, i].set(1.0), sc_map)
+            return (t_map, obj_map, cls_map, sc_map), None
+
+        t0 = jnp.zeros((5, S, H, W), jnp.float32)
+        o0 = jnp.zeros((S, H, W), jnp.float32)
+        c0 = jnp.zeros((K, S, H, W), jnp.float32)
+        s0 = jnp.zeros((S, H, W), jnp.float32)
+        (t_map, obj_map, cls_map, pos_map), _ = jax.lax.scan(
+            body, (t0, o0, c0, s0), jnp.arange(B)
+        )
+        return t_map, obj_map, cls_map, pos_map
+
+    t_map, obj_map, cls_map, pos_map = jax.vmap(build)(jnp.arange(N))
+    # t_map [N, 5, S, H, W]; pos_map [N, S, H, W] 1 where a gt landed
+
+    # objectness ignore mask: decoded pred box IoU vs ANY gt > thresh
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    an_w_s = an_w[mask_arr].reshape(1, S, 1, 1)
+    an_h_s = an_h[mask_arr].reshape(1, S, 1, 1)
+    px = (jax.nn.sigmoid(tx) + grid_x) / W             # [N, S, H, W]
+    py = (jax.nn.sigmoid(ty) + grid_y) / H
+    pw = jnp.exp(jnp.minimum(tw, 10.0)) * an_w_s / input_size
+    ph = jnp.exp(jnp.minimum(th, 10.0)) * an_h_s / input_size
+
+    def box_iou(px, py, pw, ph, g):
+        # g [B, 4] centers; preds [...]
+        px1, px2 = px - pw / 2, px + pw / 2
+        py1, py2 = py - ph / 2, py + ph / 2
+        gx1 = (g[:, 0] - g[:, 2] / 2)
+        gx2 = (g[:, 0] + g[:, 2] / 2)
+        gy1 = (g[:, 1] - g[:, 3] / 2)
+        gy2 = (g[:, 1] + g[:, 3] / 2)
+        iw = jnp.maximum(
+            jnp.minimum(px2[..., None], gx2) - jnp.maximum(px1[..., None], gx1),
+            0.0,
+        )
+        ih = jnp.maximum(
+            jnp.minimum(py2[..., None], gy2) - jnp.maximum(py1[..., None], gy1),
+            0.0,
+        )
+        inter = iw * ih
+        union = (pw * ph)[..., None] + (g[:, 2] * g[:, 3]) - inter
+        return inter / jnp.maximum(union, 1e-10)       # [..., B]
+
+    ious = jax.vmap(
+        lambda a, b, c, d, g, v: jnp.where(v, box_iou(a, b, c, d, g), 0.0)
+    )(px, py, pw, ph, gtbox, valid)                    # [N, S, H, W, B]
+    ignore = (ious.max(axis=-1) > ignore_thresh) & (pos_map == 0)
+
+    # losses. obj_map carries the mixup score at positive cells (the
+    # reference's objness value); it weights the positive objectness and
+    # class terms.
+    tgt_x, tgt_y = t_map[:, 0], t_map[:, 1]
+    tgt_w, tgt_h = t_map[:, 2], t_map[:, 3]
+    bscale = t_map[:, 4]
+    pos = pos_map
+    loss_xy = (
+        (_sce(tx, tgt_x) + _sce(ty, tgt_y)) * bscale * pos
+    ).sum(axis=(1, 2, 3))
+    loss_wh = (
+        (jnp.abs(tw - tgt_w) + jnp.abs(th - tgt_h)) * bscale * pos
+    ).sum(axis=(1, 2, 3))
+    # positive term: SCE vs 1.0 weighted by the score (reference :196)
+    loss_obj = (
+        _sce(tobj, jnp.ones_like(tobj)) * obj_map * pos
+        + _sce(tobj, jnp.zeros_like(tobj)) * (1.0 - pos) * (1.0 - ignore)
+    ).sum(axis=(1, 2, 3))
+    # cls_map [N, K, S, H, W] -> align with tcls [N, S, K, H, W]
+    cls_tgt = jnp.transpose(cls_map, (0, 2, 1, 3, 4))
+    if smooth:
+        # reference smooth_weight = min(1/K, 1/40): pos = 1-sw, neg = sw
+        sw = min(1.0 / K, 1.0 / 40.0)
+        cls_tgt = cls_tgt * (1.0 - 2.0 * sw) + sw
+    loss_cls = (
+        _sce(tcls, cls_tgt) * (obj_map * pos)[:, :, None]
+    ).sum(axis=(1, 2, 3, 4))
+    loss = loss_xy + loss_wh + loss_obj + loss_cls
+    # reference ObjectnessMask: score at positives, 0 negatives, -1 ignored
+    objness = jnp.where(
+        pos > 0, obj_map,
+        jnp.where(ignore, -1.0, 0.0),
+    )
+    # reference GTMatchMask: matched anchor-mask SLOT (0..S-1), -1 else
+    match_mask = jnp.where(assigned, scale_slot, -1).astype(jnp.int32)
+    return {
+        "Loss": [loss],
+        "ObjectnessMask": [objness],
+        "GTMatchMask": [match_mask],
+    }

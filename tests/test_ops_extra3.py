@@ -879,3 +879,88 @@ def test_sequence_topk_avg_divides_by_full_k(rng):
     expect = (-np.sort(-x, axis=-1)).sum(-1) / 3.0  # sum of 2 / k=3
     np.testing.assert_allclose(out.reshape(1, 2), expect.reshape(1, 2),
                                rtol=1e-5)
+
+
+def test_final_parity_tranche(rng):
+    # unsqueeze v1
+    x = rng.randn(3, 4).astype("float32")
+    assert lower("unsqueeze", {"X": [x]}, {"axes": [1]})["Out"][0].shape \
+        == (3, 1, 4)
+    # uniform_random_batch_size_like
+    out = lower("uniform_random_batch_size_like",
+                {"Input": [np.zeros((5, 2), "float32")],
+                 "__rng_key__": [jax.random.PRNGKey(0)]},
+                {"shape": [-1, 3], "min": 0.0, "max": 1.0})["Out"][0]
+    assert out.shape == (5, 3) and (np.asarray(out) >= 0).all()
+    # unique / unique_with_counts
+    ids = np.array([5, 3, 5, 7, 3, 3], "int64")
+    u = lower("unique_with_counts", {"X": [ids]})
+    uniq = np.asarray(u["Out"][0])
+    idx = np.asarray(u["Index"][0])
+    cnt = np.asarray(u["Count"][0])
+    np.testing.assert_array_equal(uniq[idx], ids)  # inverse mapping
+    assert cnt[np.where(uniq == 3)[0][0]] == 3
+    # lookup_table_dequant: out = q*(max-min)/256 + min (reference)
+    w = np.zeros((2, 4), "float32")
+    w[0] = [1.0, 2.0, 0, 128]      # min 1, max 2
+    got = np.asarray(lower("lookup_table_dequant",
+                           {"W": [w], "Ids": [np.array([0], "int64")]}
+                           )["Out"][0])
+    np.testing.assert_allclose(got, [[1.0, 1.0 + 128.0 / 256.0]], rtol=1e-6)
+    # unsqueeze applies axes in declaration order (reference semantics)
+    x2 = rng.randn(3, 4).astype("float32")
+    assert lower("unsqueeze", {"X": [x2]}, {"axes": [1, 0]})["Out"][0].shape \
+        == (1, 3, 1, 4)
+    # dgc_clip_by_norm: pre-rampup passthrough, post-rampup clipped
+    g = np.full((4,), 3.0, "float32")
+    pre = lower("dgc_clip_by_norm",
+                {"X": [g], "current_step": [np.zeros(1, "float32")]},
+                {"rampup_begin_step": 10.0, "max_norm": 1.0})["Out"][0]
+    np.testing.assert_allclose(np.asarray(pre), g)
+    post = lower("dgc_clip_by_norm",
+                 {"X": [g], "current_step": [np.full(1, 20.0, "float32")]},
+                 {"rampup_begin_step": 10.0, "max_norm": 1.0})["Out"][0]
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(post)), 1.0,
+                               rtol=1e-5)
+
+
+def test_yolov3_loss(rng):
+    N, S, K, H = 2, 3, 4, 8
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    mask = [0, 1, 2]
+    C = S * (5 + K)
+    x = (rng.randn(N, C, H, H) * 0.1).astype("float32")
+    gtbox = np.zeros((N, 5, 4), "float32")
+    gtbox[0, 0] = [0.5, 0.5, 0.06, 0.07]   # matches small anchors
+    gtbox[1, 0] = [0.25, 0.75, 0.1, 0.12]
+    gtlabel = np.zeros((N, 5), "int64")
+    gtlabel[0, 0] = 2
+    gtlabel[1, 0] = 1
+    outs = lower("yolov3_loss",
+                 {"X": [x], "GTBox": [gtbox], "GTLabel": [gtlabel]},
+                 {"anchors": anchors, "anchor_mask": mask, "class_num": K,
+                  "ignore_thresh": 0.7, "downsample_ratio": 32})
+    loss = np.asarray(outs["Loss"][0])
+    assert loss.shape == (N,) and np.isfinite(loss).all() and (loss > 0).all()
+    match = np.asarray(outs["GTMatchMask"][0])
+    assert match[0, 0] >= 0 and match[1, 0] >= 0  # matched slot index
+    assert (match[:, 1:] == -1).all()  # padding boxes unassigned
+    om = np.asarray(outs["ObjectnessMask"][0])
+    assert ((om == 1.0) | (om == 0.0) | (om == -1.0)).all()
+    assert (om == 1.0).sum() == 2  # one positive cell per image
+
+    # gradient flows to predictions
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_def
+
+    def f(xv):
+        return get_op_def("yolov3_loss").lower(
+            {"X": [xv], "GTBox": [jnp.asarray(gtbox)],
+             "GTLabel": [jnp.asarray(gtlabel)]},
+            {"anchors": anchors, "anchor_mask": mask, "class_num": K,
+             "ignore_thresh": 0.7, "downsample_ratio": 32},
+        )["Loss"][0].sum()
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
