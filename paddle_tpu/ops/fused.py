@@ -28,16 +28,23 @@ def _fusion_lstm(ins, attrs):
         raise EnforceError("fusion_lstm: peephole connections unsupported")
     x = first(ins, "X")
     wx = first(ins, "WeightX")
+    gx = jnp.einsum("bsm,mg->bsg", x, wx)
+    return _lstm_recurrence(gx, ins)
+
+
+def _lstm_recurrence(gx, ins):
+    """Shared LSTM scan over PRE-PROJECTED gates gx [B, S, 4D] (used by
+    fusion_lstm and fused_embedding_fc_lstm, whose embedding rows already
+    ARE the projected input)."""
     wh = first(ins, "WeightH")
     b = maybe(ins, "Bias")
     lengths = maybe(ins, "Length")
-    B, S, M = x.shape
+    B, S = gx.shape[0], gx.shape[1]
     D = wh.shape[0]
     h0 = maybe(ins, "H0")
     c0 = maybe(ins, "C0")
-    h = h0 if h0 is not None else jnp.zeros((B, D), x.dtype)
-    c = c0 if c0 is not None else jnp.zeros((B, D), x.dtype)
-    gx = jnp.einsum("bsm,mg->bsg", x, wx)
+    h = h0 if h0 is not None else jnp.zeros((B, D), gx.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, D), gx.dtype)
     if b is not None:
         gx = gx + b.reshape(1, 1, -1)
 
@@ -324,4 +331,98 @@ def _tree_conv(ins, attrs):
         return out
 
     out = jax.vmap(per_tree)(nodes, edges)   # [B, N, O*K]
+    return {"Out": [out]}
+
+
+@register_op("multihead_matmul", nondiff_inputs=("BiasQK",))
+def _multihead_matmul(ins, attrs):
+    """reference: fused/multihead_matmul_op.cc (inference fusion) — Input
+    [B, S, 3*H*D] packed q|k|v projections (+ Bias [3*H*D]), BiasQK
+    [B, H, S, S] additive attention bias. Without BiasQK the attention
+    runs on the Pallas flash kernel; the full [B, H, S, S] bias form (no
+    flash support for that shape) uses the XLA-fused jnp path."""
+    x = first(ins, "Input")
+    bias = maybe(ins, "Bias")
+    bias_qk = maybe(ins, "BiasQK")
+    H = attrs.get("head_number", 1)
+    B, S, C3 = x.shape
+    D = C3 // 3 // H
+    if bias is not None:
+        x = x + bias.reshape(1, 1, -1)
+    qkv = x.reshape(B, S, 3, H, D)
+    q = jnp.transpose(qkv[:, :, 0], (0, 2, 1, 3))      # [B, H, S, D]
+    k = jnp.transpose(qkv[:, :, 1], (0, 2, 1, 3))
+    v = jnp.transpose(qkv[:, :, 2], (0, 2, 1, 3))
+    scale = attrs.get("alpha", 1.0)
+    if bias_qk is None:
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, sm_scale=scale)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        s = s + bias_qk
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return {"Out": [jnp.transpose(out, (0, 2, 1, 3)).reshape(B, S, H * D)]}
+
+
+@register_op("fused_embedding_eltwise_layernorm", nondiff_inputs=("Ids",))
+def _fused_embedding_eltwise_layernorm(ins, attrs):
+    """reference: fused/fused_embedding_eltwise_layernorm_op.cc — sum of N
+    embedding lookups + layer_norm (the BERT input encoder fusion)."""
+    ids_list = ins["Ids"]
+    emb_list = ins["Embs"]
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    total = None
+    for ids, w in zip(ids_list, emb_list):
+        idv = ids
+        if idv.ndim == 3 and idv.shape[-1] == 1:
+            idv = idv[..., 0]
+        e = jnp.take(w, idv, axis=0)
+        total = e if total is None else total + e
+    mu = total.mean(axis=-1, keepdims=True)
+    var = jnp.var(total, axis=-1, keepdims=True)
+    out = (total - mu) / jnp.sqrt(var + eps) * scale + bias
+    return {"Out": [out]}
+
+
+@register_op("fused_embedding_fc_lstm", nondiff_inputs=("Ids", "Length"))
+def _fused_embedding_fc_lstm(ins, attrs):
+    """reference: fused/fused_embedding_fc_lstm_op.cc — embedding lookup +
+    fused LSTM: the embedding rows already ARE the projected gates, so the
+    lookup feeds the shared recurrence directly (no x-projection)."""
+    emb = first(ins, "Embeddings")                 # [V, 4D]
+    ids = first(ins, "Ids")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    gx = jnp.take(emb, ids, axis=0)                # [B, S, 4D]
+    return _lstm_recurrence(gx, ins)
+
+
+@register_op("fusion_seqexpand_concat_fc", nondiff_inputs=("Length",))
+def _fusion_seqexpand_concat_fc(ins, attrs):
+    """reference: fused/fusion_seqexpand_concat_fc_op.cc — X[0] is a
+    sequence [B, S, M0], the rest are per-row vectors [B, Mi] broadcast
+    over S; concat on features, then fc + activation."""
+    xs = ins["X"]
+    w = first(ins, "FCWeight")
+    b = maybe(ins, "FCBias")
+    seq = xs[0]
+    B, S = seq.shape[0], seq.shape[1]
+    parts = [seq]
+    for t in xs[1:]:
+        parts.append(jnp.broadcast_to(
+            t[:, None, :], (B, S) + tuple(t.shape[1:])
+        ))
+    cat = jnp.concatenate(parts, axis=-1)
+    out = jnp.einsum("bsm,mo->bso", cat, w)
+    if b is not None:
+        out = out + b.reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
     return {"Out": [out]}

@@ -863,26 +863,32 @@ def _unique(ins, attrs):
     repeats the last unique; jnp.unique's size= contract); Index maps each
     input element to its unique slot. The reference's dynamic-size output
     cannot exist under XLA; consumers read Count/Index."""
+    from paddle_tpu.ops.common import np_dtype
+
     x = first(ins, "X").reshape(-1)
+    it = jnp.dtype(np_dtype(attrs, default="int32"))
     uniq, idx = jnp.unique(
         x, return_inverse=True, size=x.shape[0], fill_value=x[-1]
     )
-    return {"Out": [uniq], "Index": [idx.astype(jnp.int32)]}
+    return {"Out": [uniq], "Index": [idx.astype(it)]}
 
 
 @register_op("unique_with_counts", nondiff_inputs=("X",))
 def _unique_with_counts(ins, attrs):
     """reference: paddle/fluid/operators/unique_with_counts_op.h — unique +
     per-value occurrence counts (same static-shape contract as unique)."""
+    from paddle_tpu.ops.common import np_dtype
+
     x = first(ins, "X").reshape(-1)
+    it = jnp.dtype(np_dtype(attrs, default="int32"))
     uniq, idx, counts = jnp.unique(
         x, return_inverse=True, return_counts=True, size=x.shape[0],
         fill_value=x[-1],
     )
     return {
         "Out": [uniq],
-        "Index": [idx.astype(jnp.int32)],
-        "Count": [counts.astype(jnp.int32)],
+        "Index": [idx.astype(it)],
+        "Count": [counts.astype(it)],
     }
 
 

@@ -803,3 +803,131 @@ def _roi_perspective_transform(ins, attrs):
             "TransformMatrix": [jnp.stack(
                 [m0, m1, x0, m3, m4, y0, m6, m7, jnp.ones_like(m0)], axis=1
             )]}
+
+
+@register_op("generate_proposal_labels", stateful=True,
+             nondiff_inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                             "ImInfo"))
+def _generate_proposal_labels(ins, attrs):
+    """reference: detection/generate_proposal_labels_op.cc — label RPN
+    proposals for the second stage: fg = max-IoU >= fg_thresh, bg =
+    bg_thresh_lo <= max-IoU < bg_thresh_hi; random-subsample to
+    batch_size_per_im at fg_fraction; regression targets vs the matched
+    gt. Fixed-slate form: all R proposals stay in place, sampled-out rows
+    get label -1 and zero weights (the reference compacts to the sampled
+    subset)."""
+    from paddle_tpu.ops.common import seeded_rng_key
+    from paddle_tpu.ops.detection import _iou
+
+    rois = first(ins, "RpnRois")                  # [R, 4]
+    gt_cls = first(ins, "GtClasses").reshape(-1).astype(jnp.int32)
+    gt = first(ins, "GtBoxes")                    # [G, 4]
+    is_crowd = maybe(ins, "IsCrowd")
+    batch = attrs.get("batch_size_per_im", 256)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thresh = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    R = rois.shape[0]
+    gt_valid = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+    if is_crowd is not None:
+        gt_valid = gt_valid & (is_crowd.reshape(-1) == 0)
+    iou = jnp.where(gt_valid[None, :], _iou(rois, gt), 0.0)  # [R, G]
+    best = iou.max(axis=1)
+    arg = iou.argmax(axis=1)
+    is_fg = best >= fg_thresh
+    is_bg = (best >= bg_lo) & (best < bg_hi)
+    key = seeded_rng_key(ins, attrs)
+    k1, k2 = jax.random.split(key)
+    fg_cap = int(batch * fg_frac)
+    r1 = jnp.where(is_fg, jax.random.uniform(k1, (R,)), -1.0)
+    fg_keep = jnp.zeros((R,), bool).at[jnp.argsort(-r1)[:fg_cap]].set(
+        True
+    ) & is_fg
+    n_fg = fg_keep.sum()
+    r2 = jnp.where(is_bg, jax.random.uniform(k2, (R,)), -1.0)
+    bg_take = jnp.arange(R) < jnp.maximum(batch - n_fg, 0)
+    bg_keep = jnp.zeros((R,), bool).at[jnp.argsort(-r2)].set(bg_take) & is_bg
+    labels = jnp.where(fg_keep, gt_cls[arg], jnp.where(bg_keep, 0, -1))
+    mg = gt[arg]
+    rw = rois[:, 2] - rois[:, 0] + 1.0
+    rh = rois[:, 3] - rois[:, 1] + 1.0
+    rcx = rois[:, 0] + 0.5 * rw
+    rcy = rois[:, 1] + 0.5 * rh
+    gw = mg[:, 2] - mg[:, 0] + 1.0
+    gh = mg[:, 3] - mg[:, 1] + 1.0
+    gcx = mg[:, 0] + 0.5 * gw
+    gcy = mg[:, 1] + 0.5 * gh
+    tgt = jnp.stack([
+        (gcx - rcx) / rw, (gcy - rcy) / rh,
+        jnp.log(gw / rw), jnp.log(gh / rh),
+    ], axis=1)
+    w_in = fg_keep[:, None].astype(jnp.float32)
+    return {
+        "Rois": [rois],
+        "LabelsInt32": [labels.reshape(R, 1)],
+        "BboxTargets": [jnp.where(fg_keep[:, None], tgt, 0.0)],
+        "BboxInsideWeights": [jnp.broadcast_to(w_in, (R, 4))],
+        "BboxOutsideWeights": [jnp.broadcast_to(
+            (fg_keep | bg_keep)[:, None].astype(jnp.float32), (R, 4)
+        )],
+        "RoisNum": [(fg_keep | bg_keep).sum().astype(jnp.int32).reshape(1)],
+    }
+
+
+@register_op("retinanet_target_assign", stateful=True,
+             nondiff_inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                             "ImInfo"))
+def _retinanet_target_assign(ins, attrs):
+    """reference: detection/retinanet_target_assign_op.cc — one-stage
+    anchor labeling: fg = max-IoU >= positive_overlap (class label from
+    the matched gt), bg = max-IoU < negative_overlap, in-between ignored;
+    NO subsampling (focal loss handles imbalance). Fixed-slate per-anchor
+    outputs like rpn_target_assign."""
+    from paddle_tpu.ops.detection import _iou
+
+    anchors = first(ins, "Anchor")
+    gt = first(ins, "GtBoxes")
+    gt_labels = first(ins, "GtLabels").reshape(-1).astype(jnp.int32)
+    is_crowd = maybe(ins, "IsCrowd")
+    pos_thr = attrs.get("positive_overlap", 0.5)
+    neg_thr = attrs.get("negative_overlap", 0.4)
+    A = anchors.shape[0]
+    gt_valid = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+    if is_crowd is not None:
+        gt_valid = gt_valid & (is_crowd.reshape(-1) == 0)
+    iou = jnp.where(gt_valid[None, :], _iou(anchors, gt), 0.0)
+    best = iou.max(axis=1)
+    arg = iou.argmax(axis=1)
+    # 0 = background, -1 = ignored, >0 = 1-based class of the matched gt
+    labels = jnp.where(
+        best >= pos_thr, gt_labels[arg],
+        jnp.where(best < neg_thr, 0, -1),
+    )
+    fg = labels > 0
+    mg = gt[arg]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = mg[:, 2] - mg[:, 0] + 1.0
+    gh = mg[:, 3] - mg[:, 1] + 1.0
+    gcx = mg[:, 0] + 0.5 * gw
+    gcy = mg[:, 1] + 0.5 * gh
+    tgt = jnp.stack([
+        (gcx - acx) / aw, (gcy - acy) / ah,
+        jnp.log(gw / aw), jnp.log(gh / ah),
+    ], axis=1)
+    return {
+        "LocationIndex": [jnp.where(fg, jnp.arange(A), -1)
+                          .astype(jnp.int32)],
+        "ScoreIndex": [jnp.where(labels >= 0, jnp.arange(A), -1)
+                       .astype(jnp.int32)],
+        "TargetLabel": [labels.reshape(A, 1)],
+        "TargetBBox": [jnp.where(fg[:, None], tgt, 0.0)],
+        "BBoxInsideWeight": [jnp.broadcast_to(
+            fg[:, None].astype(jnp.float32), (A, 4)
+        )],
+        "ForegroundNumber": [jnp.maximum(fg.sum(), 1)
+                             .astype(jnp.int32).reshape(1)],
+    }

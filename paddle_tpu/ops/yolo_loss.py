@@ -89,9 +89,6 @@ def _yolov3_loss(ins, attrs):
             j = gj[n_idx, b]
             on = assigned[n_idx, b]
 
-            def put(m, v):
-                return jnp.where(on, m.at[:, s, j, i].set(v), m)
-
             t_map = jnp.where(
                 on,
                 t_map.at[:, s, j, i].set(jnp.stack([

@@ -964,3 +964,82 @@ def test_yolov3_loss(rng):
 
     g = np.asarray(jax.grad(f)(jnp.asarray(x)))
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_multihead_matmul_and_bert_input_fusion(rng):
+    B, S, H, D = 2, 5, 2, 4
+    x = rng.randn(B, S, 3 * H * D).astype("float32")
+    out = lower("multihead_matmul", {"Input": [x]},
+                {"head_number": H, "alpha": 1.0 / np.sqrt(D)})["Out"][0]
+    assert out.shape == (B, S, H * D)
+    # parity vs manual attention
+    qkv = x.reshape(B, S, 3, H, D)
+    q, k, v = (np.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3))
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3
+                                                      ).reshape(B, S, H * D)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    ids1 = rng.randint(0, 10, (B, S)).astype("int64")
+    ids2 = rng.randint(0, 4, (B, S)).astype("int64")
+    w1 = rng.randn(10, 6).astype("float32")
+    w2 = rng.randn(4, 6).astype("float32")
+    sc = rng.rand(6).astype("float32")
+    bi = rng.randn(6).astype("float32")
+    out2 = lower("fused_embedding_eltwise_layernorm",
+                 {"Ids": [ids1, ids2], "Embs": [w1, w2],
+                  "Scale": [sc], "Bias": [bi]})["Out"][0]
+    tot = w1[ids1] + w2[ids2]
+    mu = tot.mean(-1, keepdims=True)
+    ref2 = (tot - mu) / np.sqrt(tot.var(-1, keepdims=True) + 1e-5) * sc + bi
+    np.testing.assert_allclose(np.asarray(out2), ref2, rtol=1e-4, atol=1e-5)
+
+
+def test_stage2_and_retinanet_targets(rng):
+    rois = np.array([[0, 0, 10, 10], [0, 0, 9, 9], [50, 50, 60, 60],
+                     [100, 100, 110, 110]], "float32")
+    gt = np.array([[0, 0, 10, 10]], "float32")
+    outs = lower("generate_proposal_labels",
+                 {"RpnRois": [rois], "GtClasses": [np.array([3], "int32")],
+                  "GtBoxes": [gt],
+                  "__rng_key__": [jax.random.PRNGKey(0)]},
+                 {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                  "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                  "bg_thresh_lo": 0.0})
+    lab = np.asarray(outs["LabelsInt32"][0]).reshape(-1)
+    assert lab[0] == 3 and lab[1] == 3      # fg get the gt class
+    assert (lab[2:] == 0).all() or (lab[2:] == -1).any()
+    tgt = np.asarray(outs["BboxTargets"][0])
+    np.testing.assert_allclose(tgt[0], 0.0, atol=1e-6)  # exact match
+
+    routs = lower("retinanet_target_assign",
+                  {"Anchor": [rois], "GtBoxes": [gt],
+                   "GtLabels": [np.array([5], "int32")]},
+                  {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    rlab = np.asarray(routs["TargetLabel"][0]).reshape(-1)
+    assert rlab[0] == 5 and rlab[1] == 5
+    assert rlab[3] == 0
+    assert int(np.asarray(routs["ForegroundNumber"][0])[0]) == 2
+
+
+def test_fused_embedding_fc_lstm_and_seqexpand_fc(rng):
+    V, B, S, D = 12, 2, 4, 3
+    emb = rng.randn(V, 4 * D).astype("float32")
+    ids = rng.randint(0, V, (B, S)).astype("int64")
+    wh = rng.randn(D, 4 * D).astype("float32")
+    outs = lower("fused_embedding_fc_lstm",
+                 {"Ids": [ids], "Embeddings": [emb], "WeightH": [wh]})
+    assert np.asarray(outs["Hidden"][0]).shape == (B, S, D)
+
+    seq = rng.randn(B, S, 3).astype("float32")
+    vec = rng.randn(B, 2).astype("float32")
+    w = rng.randn(5, 4).astype("float32")
+    out = lower("fusion_seqexpand_concat_fc",
+                {"X": [seq, vec], "FCWeight": [w]},
+                {"fc_activation": "relu"})["Out"][0]
+    cat = np.concatenate(
+        [seq, np.broadcast_to(vec[:, None], (B, S, 2))], axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(out), np.maximum(cat @ w, 0), rtol=1e-4, atol=1e-5)
