@@ -1,2 +1,2 @@
 from paddle_tpu.models import mnist, resnet, bert, ctr, transformer
-from paddle_tpu.models import mobilenet, seq2seq
+from paddle_tpu.models import mobilenet, seq2seq, yolov3

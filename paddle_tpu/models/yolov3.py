@@ -1,0 +1,108 @@
+"""YOLOv3 detector — the reference model zoo's one-stage detection
+workload (PaddleCV yolov3.py), scaled to a compact darknet-style backbone.
+
+Training wires conv features into the yolov3_loss op per scale; inference
+decodes the same heads with yolo_box + multiclass_nms (ops/detection.py).
+"""
+
+import paddle_tpu as fluid
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.param_attr import ParamAttr
+
+ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+
+
+def _conv_bn(x, filters, ksize, stride=1, name=None):
+    """Explicitly named params so train/infer programs share weights."""
+    conv = fluid.layers.conv2d(
+        x, num_filters=filters, filter_size=ksize, stride=stride,
+        padding=(ksize - 1) // 2, bias_attr=False,
+        param_attr=ParamAttr(name=f"{name}_w" if name else None),
+    )
+    return fluid.layers.batch_norm(
+        conv, act="relu",
+        param_attr=ParamAttr(name=f"{name}_bn_s" if name else None),
+        bias_attr=ParamAttr(name=f"{name}_bn_b" if name else None),
+        moving_mean_name=f"{name}_bn_mean" if name else None,
+        moving_variance_name=f"{name}_bn_var" if name else None,
+    )
+
+
+def _backbone(img, base=16):
+    """Compact darknet-ish stack: 3 downsamples -> stride 8 features."""
+    h = _conv_bn(img, base, 3, name="bb0")
+    h = _conv_bn(h, base * 2, 3, stride=2, name="bb1")
+    h = _conv_bn(h, base * 2, 3, name="bb2")
+    h = _conv_bn(h, base * 4, 3, stride=2, name="bb3")
+    h = _conv_bn(h, base * 4, 3, name="bb4")
+    h = _conv_bn(h, base * 8, 3, stride=2, name="bb5")
+    return h
+
+
+def build_yolov3_train(class_num=10, image_size=64, max_boxes=10, lr=1e-3,
+                       anchor_mask=(0, 1, 2), base=16):
+    """One-scale YOLOv3 training program (the multi-scale form repeats the
+    head per pyramid level). Returns (main, startup, feeds, loss)."""
+    S = len(anchor_mask)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [-1, 3, image_size, image_size])
+        gtbox = fluid.data("gt_box", [-1, max_boxes, 4])
+        gtlabel = fluid.data("gt_label", [-1, max_boxes], dtype="int64")
+        feat = _backbone(img, base)
+        head = fluid.layers.conv2d(
+            feat, num_filters=S * (5 + class_num), filter_size=1,
+            param_attr=ParamAttr(name="yolo_head_w"),
+            bias_attr=ParamAttr(name="yolo_head_b"),
+        )
+        helper = LayerHelper("yolo_loss")
+        loss_v = helper.create_variable_for_type_inference("float32")
+        om = helper.create_variable_for_type_inference("float32")
+        gm = helper.create_variable_for_type_inference("int32")
+        helper.append_op(
+            "yolov3_loss",
+            {"X": [head.name], "GTBox": [gtbox.name],
+             "GTLabel": [gtlabel.name]},
+            {"Loss": [loss_v.name], "ObjectnessMask": [om.name],
+             "GTMatchMask": [gm.name]},
+            {"anchors": list(ANCHORS), "anchor_mask": list(anchor_mask),
+             "class_num": class_num, "ignore_thresh": 0.7,
+             "downsample_ratio": 8},
+        )
+        loss = fluid.layers.mean(loss_v)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, [img, gtbox, gtlabel], loss
+
+
+def build_yolov3_infer(class_num=10, image_size=64, anchor_mask=(0, 1, 2),
+                      base=16, conf_thresh=0.01, nms_topk=100,
+                      keep_topk=50, nms_thresh=0.45):
+    """Inference program: head -> yolo_box decode -> multiclass NMS slate.
+    Shares weights with the training program by name."""
+    S = len(anchor_mask)
+    masked = []
+    for m in anchor_mask:
+        masked += ANCHORS[2 * m:2 * m + 2]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [-1, 3, image_size, image_size])
+        im_size = fluid.data("im_size", [-1, 2], dtype="int32")
+        feat = _backbone(img, base)
+        head = fluid.layers.conv2d(
+            feat, num_filters=S * (5 + class_num), filter_size=1,
+            param_attr=ParamAttr(name="yolo_head_w"),
+            bias_attr=ParamAttr(name="yolo_head_b"),
+        )
+        boxes, scores = fluid.layers.yolo_box(
+            head, im_size, anchors=masked, class_num=class_num,
+            conf_thresh=conf_thresh, downsample_ratio=8,
+        )
+        out, num_det = fluid.layers.multiclass_nms(
+            bboxes=boxes,
+            scores=fluid.layers.transpose(scores, [0, 2, 1]),
+            score_threshold=conf_thresh, nms_top_k=nms_topk,
+            keep_top_k=keep_topk, nms_threshold=nms_thresh,
+            background_label=-1,
+        )
+        test_prog = main.clone(for_test=True)
+    return test_prog, startup, [img, im_size], (out, num_det)

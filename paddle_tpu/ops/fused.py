@@ -397,6 +397,10 @@ def _fused_embedding_fc_lstm(ins, attrs):
     ids = first(ins, "Ids")
     if ids.ndim == 3 and ids.shape[-1] == 1:
         ids = ids[..., 0]
+    if attrs.get("use_peepholes", False):
+        raise EnforceError(
+            "fused_embedding_fc_lstm: peephole connections unsupported"
+        )
     gx = jnp.take(emb, ids, axis=0)                # [B, S, 4D]
     return _lstm_recurrence(gx, ins)
 

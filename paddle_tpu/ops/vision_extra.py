@@ -709,20 +709,8 @@ def _rpn_target_assign(ins, attrs):
     bg_pos = jnp.arange(A) < jnp.maximum(bg_cap - n_fg, 0)
     bg_keep = jnp.zeros((A,), bool).at[bg_rank].set(bg_pos) & (labels == 0)
     final = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
-    # regression targets vs the matched gt (encode_center_size)
-    mg = gt[argbest]
-    aw = anchors[:, 2] - anchors[:, 0] + 1.0
-    ah = anchors[:, 3] - anchors[:, 1] + 1.0
-    acx = anchors[:, 0] + 0.5 * aw
-    acy = anchors[:, 1] + 0.5 * ah
-    gw = mg[:, 2] - mg[:, 0] + 1.0
-    gh = mg[:, 3] - mg[:, 1] + 1.0
-    gcx = mg[:, 0] + 0.5 * gw
-    gcy = mg[:, 1] + 0.5 * gh
-    tgt = jnp.stack([
-        (gcx - acx) / aw, (gcy - acy) / ah,
-        jnp.log(gw / aw), jnp.log(gh / ah),
-    ], axis=1)
+    # regression targets vs the matched gt
+    tgt = _encode_center_size(anchors, gt[argbest])
     return {
         "ScoreIndex": [jnp.where(final >= 0, jnp.arange(A), -1)
                        .astype(jnp.int32)],
@@ -805,6 +793,23 @@ def _roi_perspective_transform(ins, attrs):
             )]}
 
 
+def _encode_center_size(boxes, matched_gt):
+    """Center-size regression targets (reference BoxCoder encode, legacy
+    +1 pixel convention) — shared by the three target-assign ops."""
+    bw = boxes[:, 2] - boxes[:, 0] + 1.0
+    bh = boxes[:, 3] - boxes[:, 1] + 1.0
+    bcx = boxes[:, 0] + 0.5 * bw
+    bcy = boxes[:, 1] + 0.5 * bh
+    gw = matched_gt[:, 2] - matched_gt[:, 0] + 1.0
+    gh = matched_gt[:, 3] - matched_gt[:, 1] + 1.0
+    gcx = matched_gt[:, 0] + 0.5 * gw
+    gcy = matched_gt[:, 1] + 0.5 * gh
+    return jnp.stack([
+        (gcx - bcx) / bw, (gcy - bcy) / bh,
+        jnp.log(gw / bw), jnp.log(gh / bh),
+    ], axis=1)
+
+
 @register_op("generate_proposal_labels", stateful=True,
              nondiff_inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
                              "ImInfo"))
@@ -828,10 +833,16 @@ def _generate_proposal_labels(ins, attrs):
     fg_thresh = attrs.get("fg_thresh", 0.5)
     bg_hi = attrs.get("bg_thresh_hi", 0.5)
     bg_lo = attrs.get("bg_thresh_lo", 0.0)
-    R = rois.shape[0]
     gt_valid = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
     if is_crowd is not None:
         gt_valid = gt_valid & (is_crowd.reshape(-1) == 0)
+    # the reference appends the gt boxes to the candidate set so every gt
+    # has at least one IoU-1.0 foreground candidate even when the RPN is
+    # still random; padded gt rows are zeroed out of contention
+    rois = jnp.concatenate(
+        [rois, jnp.where(gt_valid[:, None], gt, 0.0)], axis=0
+    )
+    R = rois.shape[0]
     iou = jnp.where(gt_valid[None, :], _iou(rois, gt), 0.0)  # [R, G]
     best = iou.max(axis=1)
     arg = iou.argmax(axis=1)
@@ -849,28 +860,36 @@ def _generate_proposal_labels(ins, attrs):
     bg_take = jnp.arange(R) < jnp.maximum(batch - n_fg, 0)
     bg_keep = jnp.zeros((R,), bool).at[jnp.argsort(-r2)].set(bg_take) & is_bg
     labels = jnp.where(fg_keep, gt_cls[arg], jnp.where(bg_keep, 0, -1))
-    mg = gt[arg]
-    rw = rois[:, 2] - rois[:, 0] + 1.0
-    rh = rois[:, 3] - rois[:, 1] + 1.0
-    rcx = rois[:, 0] + 0.5 * rw
-    rcy = rois[:, 1] + 0.5 * rh
-    gw = mg[:, 2] - mg[:, 0] + 1.0
-    gh = mg[:, 3] - mg[:, 1] + 1.0
-    gcx = mg[:, 0] + 0.5 * gw
-    gcy = mg[:, 1] + 0.5 * gh
-    tgt = jnp.stack([
-        (gcx - rcx) / rw, (gcy - rcy) / rh,
-        jnp.log(gw / rw), jnp.log(gh / rh),
-    ], axis=1)
-    w_in = fg_keep[:, None].astype(jnp.float32)
+    tgt = _encode_center_size(rois, gt[arg])
+    tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+    # reference expands targets per class: [R, 4*class_nums] with the
+    # 4-vector written in the matched class's slot
+    class_nums = attrs.get("class_nums", 1)
+    if class_nums > 1:
+        slot = jax.nn.one_hot(
+            jnp.clip(labels, 0, class_nums - 1), class_nums,
+            dtype=tgt.dtype,
+        ) * fg_keep[:, None]                       # [R, C]
+        tgt_exp = (slot[:, :, None] * tgt[:, None, :]).reshape(R, -1)
+        w_in = jnp.repeat(slot, 4, axis=1)
+        w_out = jnp.broadcast_to(
+            (fg_keep | bg_keep)[:, None].astype(jnp.float32),
+            (R, 4 * class_nums),
+        )
+    else:
+        tgt_exp = tgt
+        w_in = jnp.broadcast_to(
+            fg_keep[:, None].astype(jnp.float32), (R, 4)
+        )
+        w_out = jnp.broadcast_to(
+            (fg_keep | bg_keep)[:, None].astype(jnp.float32), (R, 4)
+        )
     return {
         "Rois": [rois],
         "LabelsInt32": [labels.reshape(R, 1)],
-        "BboxTargets": [jnp.where(fg_keep[:, None], tgt, 0.0)],
-        "BboxInsideWeights": [jnp.broadcast_to(w_in, (R, 4))],
-        "BboxOutsideWeights": [jnp.broadcast_to(
-            (fg_keep | bg_keep)[:, None].astype(jnp.float32), (R, 4)
-        )],
+        "BboxTargets": [tgt_exp],
+        "BboxInsideWeights": [w_in],
+        "BboxOutsideWeights": [w_out],
         "RoisNum": [(fg_keep | bg_keep).sum().astype(jnp.int32).reshape(1)],
     }
 
@@ -904,20 +923,15 @@ def _retinanet_target_assign(ins, attrs):
         best >= pos_thr, gt_labels[arg],
         jnp.where(best < neg_thr, 0, -1),
     )
+    # best anchor per gt is ALWAYS positive (guarded against zero-IoU
+    # columns — padded or unreachable gts), as in rpn_target_assign
+    best_per_gt = iou.max(axis=0)
+    is_best = (
+        (iou == best_per_gt[None, :]) & (best_per_gt[None, :] > 0)
+    ).any(axis=1)
+    labels = jnp.where(is_best, gt_labels[arg], labels)
     fg = labels > 0
-    mg = gt[arg]
-    aw = anchors[:, 2] - anchors[:, 0] + 1.0
-    ah = anchors[:, 3] - anchors[:, 1] + 1.0
-    acx = anchors[:, 0] + 0.5 * aw
-    acy = anchors[:, 1] + 0.5 * ah
-    gw = mg[:, 2] - mg[:, 0] + 1.0
-    gh = mg[:, 3] - mg[:, 1] + 1.0
-    gcx = mg[:, 0] + 0.5 * gw
-    gcy = mg[:, 1] + 0.5 * gh
-    tgt = jnp.stack([
-        (gcx - acx) / aw, (gcy - acy) / ah,
-        jnp.log(gw / aw), jnp.log(gh / ah),
-    ], axis=1)
+    tgt = _encode_center_size(anchors, gt[arg])
     return {
         "LocationIndex": [jnp.where(fg, jnp.arange(A), -1)
                           .astype(jnp.int32)],

@@ -1005,14 +1005,30 @@ def test_stage2_and_retinanet_targets(rng):
                  {"RpnRois": [rois], "GtClasses": [np.array([3], "int32")],
                   "GtBoxes": [gt],
                   "__rng_key__": [jax.random.PRNGKey(0)]},
-                 {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                 {"batch_size_per_im": 8, "fg_fraction": 0.5,
                   "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
                   "bg_thresh_lo": 0.0})
     lab = np.asarray(outs["LabelsInt32"][0]).reshape(-1)
-    assert lab[0] == 3 and lab[1] == 3      # fg get the gt class
-    assert (lab[2:] == 0).all() or (lab[2:] == -1).any()
+    # rois gained the appended gt row (index 4)
+    assert lab.shape[0] == 5
+    assert lab[0] == 3 and lab[1] == 3 and lab[4] == 3
+    assert np.isin(lab[2:4], [0, -1]).all(), lab
     tgt = np.asarray(outs["BboxTargets"][0])
     np.testing.assert_allclose(tgt[0], 0.0, atol=1e-6)  # exact match
+    # class_nums expansion: targets land in the matched class slot
+    outs_c = lower("generate_proposal_labels",
+                   {"RpnRois": [rois[:2]],
+                    "GtClasses": [np.array([1], "int32")],
+                    "GtBoxes": [gt],
+                    "__rng_key__": [jax.random.PRNGKey(0)]},
+                   {"batch_size_per_im": 8, "fg_fraction": 1.0,
+                    "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                    "bg_thresh_lo": 0.0, "class_nums": 3})
+    te = np.asarray(outs_c["BboxTargets"][0])
+    wi = np.asarray(outs_c["BboxInsideWeights"][0])
+    assert te.shape[1] == 12 and wi.shape[1] == 12
+    assert (wi[0, 4:8] == 1.0).all()        # class-1 slot active
+    assert (wi[0, :4] == 0.0).all() and (wi[0, 8:] == 0.0).all()
 
     routs = lower("retinanet_target_assign",
                   {"Anchor": [rois], "GtBoxes": [gt],
@@ -1043,3 +1059,16 @@ def test_fused_embedding_fc_lstm_and_seqexpand_fc(rng):
         [seq, np.broadcast_to(vec[:, None], (B, S, 2))], axis=-1)
     np.testing.assert_allclose(
         np.asarray(out), np.maximum(cat @ w, 0), rtol=1e-4, atol=1e-5)
+
+
+def test_retinanet_best_anchor_promotion(rng):
+    """A gt below positive_overlap still claims its best anchor."""
+    anchors = np.array([[0, 0, 20, 20], [100, 100, 120, 120]], "float32")
+    gt = np.array([[0, 0, 10, 8]], "float32")  # IoU with anchor0 ~ 0.2
+    outs = lower("retinanet_target_assign",
+                 {"Anchor": [anchors], "GtBoxes": [gt],
+                  "GtLabels": [np.array([4], "int32")]},
+                 {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    lab = np.asarray(outs["TargetLabel"][0]).reshape(-1)
+    assert lab[0] == 4, lab  # promoted despite IoU < pos_thr
+    assert lab[1] == 0
