@@ -944,3 +944,59 @@ def _sync_batch_norm(ins, attrs):
     from paddle_tpu.core.registry import get_op_def
 
     return get_op_def("batch_norm").lower(ins, attrs)
+
+
+@register_op("var_conv_2d", nondiff_inputs=("ROW", "COLUMN"))
+def _var_conv_2d(ins, attrs):
+    """reference: paddle/fluid/operators/var_conv_2d_op.cc — conv over
+    per-sample variable-extent 2-D maps (the match-matrix text pipeline).
+    Padded form: X [B, C, H, W] with per-sample valid extents ROW [B] /
+    COLUMN [B]; a stride-s conv produces ceil(h/s) x ceil(w/s) valid cells
+    per sample ((d-1)//s + 1, the reference's top_im computation); cells
+    beyond a sample's extent are zeroed. W [OC, C*kh*kw]."""
+    x = first(ins, "X")
+    w = first(ins, "W")
+    rows = maybe(ins, "ROW")
+    cols = maybe(ins, "COLUMN")
+    kh = attrs.get("KernelH", 3)
+    kw = attrs.get("KernelW", 3)
+    sh = attrs.get("StrideH", 1)
+    sw = attrs.get("StrideW", 1)
+    B, C, H, W_ = x.shape
+    OC = w.shape[0]
+    filt = w.reshape(OC, C, kh, kw)
+    # zero the INPUT beyond each sample's extent too: the kernel's
+    # receptive field at valid boundary cells must not read padded junk
+    # (reference convolves only the h x w map), and dX then stays zero in
+    # the padded region
+    if rows is not None:
+        rv = rows.reshape(-1).astype(jnp.int32)
+        x = x * (
+            jnp.arange(H)[None, :] < rv[:, None]
+        )[:, None, :, None].astype(x.dtype)
+    if cols is not None:
+        cv = cols.reshape(-1).astype(jnp.int32)
+        x = x * (
+            jnp.arange(W_)[None, :] < cv[:, None]
+        )[:, None, None, :].astype(x.dtype)
+    # SAME-at-stride output extent: (d - 1)//s + 1
+    Ho = (H - 1) // sh + 1
+    Wo = (W_ - 1) // sw + 1
+    pad_h = max((Ho - 1) * sh + kh - H, 0)
+    pad_w = max((Wo - 1) * sw + kw - W_, 0)
+    out = jax.lax.conv_general_dilated(
+        x, filt, (sh, sw),
+        ((pad_h // 2, pad_h - pad_h // 2), (pad_w // 2, pad_w - pad_w // 2)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if rows is not None:
+        vh = (rows.reshape(-1).astype(jnp.int32) - 1) // sh + 1
+        out = out * (
+            jnp.arange(Ho)[None, :] < vh[:, None]
+        )[:, None, :, None].astype(out.dtype)
+    if cols is not None:
+        vw = (cols.reshape(-1).astype(jnp.int32) - 1) // sw + 1
+        out = out * (
+            jnp.arange(Wo)[None, :] < vw[:, None]
+        )[:, None, None, :].astype(out.dtype)
+    return {"Out": [out]}

@@ -1072,3 +1072,46 @@ def test_retinanet_best_anchor_promotion(rng):
     lab = np.asarray(outs["TargetLabel"][0]).reshape(-1)
     assert lab[0] == 4, lab  # promoted despite IoU < pos_thr
     assert lab[1] == 0
+
+
+def test_var_conv_2d(rng):
+    B, C, H, W = 2, 2, 6, 8
+    x = rng.randn(B, C, H, W).astype("float32")
+    OC, kh, kw = 3, 3, 3
+    w = rng.randn(OC, C * kh * kw).astype("float32")
+    rows = np.array([6, 3], "int64")
+    cols = np.array([8, 4], "int64")
+    out = np.asarray(lower(
+        "var_conv_2d",
+        {"X": [x], "W": [w], "ROW": [rows], "COLUMN": [cols]},
+        {"KernelH": kh, "KernelW": kw, "StrideH": 1, "StrideW": 1,
+         "InputChannel": C, "OutputChannel": OC},
+    )["Out"][0])
+    assert out.shape == (B, OC, H, W)
+    # sample 1's cells beyond (3, 4) are zeroed
+    assert np.abs(out[1, :, 3:, :]).sum() == 0
+    assert np.abs(out[1, :, :, 4:]).sum() == 0
+    assert np.abs(out[1, :, :3, :4]).sum() > 0
+    assert np.abs(out[0]).sum() > 0
+    # input junk beyond the extent must not leak into valid border cells:
+    # result is identical when the padded region is overwritten
+    x2 = x.copy()
+    x2[1, :, 3:, :] = 99.0
+    x2[1, :, :, 4:] = -77.0
+    out2 = np.asarray(lower(
+        "var_conv_2d",
+        {"X": [x2], "W": [w], "ROW": [rows], "COLUMN": [cols]},
+        {"KernelH": kh, "KernelW": kw, "StrideH": 1, "StrideW": 1},
+    )["Out"][0])
+    np.testing.assert_allclose(out2, out, rtol=1e-6)
+    # stride-2 path: ceil-div extents and mask
+    outs2 = np.asarray(lower(
+        "var_conv_2d",
+        {"X": [x], "W": [w], "ROW": [rows], "COLUMN": [cols]},
+        {"KernelH": kh, "KernelW": kw, "StrideH": 2, "StrideW": 2},
+    )["Out"][0])
+    assert outs2.shape == (B, OC, 3, 4)     # ceil(6/2), ceil(8/2)
+    # sample 1 extent (3,4) -> valid (2,2)
+    assert np.abs(outs2[1, :, 2:, :]).sum() == 0
+    assert np.abs(outs2[1, :, :, 2:]).sum() == 0
+    assert np.abs(outs2[1, :, :2, :2]).sum() > 0
