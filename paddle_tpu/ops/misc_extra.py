@@ -1000,3 +1000,26 @@ def _var_conv_2d(ins, attrs):
             jnp.arange(Wo)[None, :] < vw[:, None]
         )[:, None, None, :].astype(out.dtype)
     return {"Out": [out]}
+
+
+@register_op("distributed_lookup_table", nondiff_inputs=("Ids",))
+def _distributed_lookup_table(ins, attrs):
+    """reference: paddle/fluid/operators/distributed_ops/
+    distributed_lookup_table_op.cc — embedding lookup against a
+    parameter-server table. Single-process semantic: a dense gather from
+    the local table (exactly what the reference computes, with the table
+    fetched remotely); the actual remote path is the PS stack
+    (layers.sparse_embedding + fleet/parameter_server.py), which pulls
+    only the batch's unique rows per step."""
+    w = first(ins, "W")
+    outs = []
+    for ids in ins["Ids"]:
+        idv = ids
+        if idv.ndim >= 2 and idv.shape[-1] == 1:
+            idv = idv[..., 0]
+        out = jnp.take(w, idv.astype(jnp.int32), axis=0)
+        pad = attrs.get("padding_idx", -1)
+        if pad is not None and pad >= 0:
+            out = jnp.where((idv == pad)[..., None], 0.0, out)
+        outs.append(out)
+    return {"Outputs": outs}

@@ -1115,3 +1115,12 @@ def test_var_conv_2d(rng):
     assert np.abs(outs2[1, :, 2:, :]).sum() == 0
     assert np.abs(outs2[1, :, :, 2:]).sum() == 0
     assert np.abs(outs2[1, :, :2, :2]).sum() > 0
+
+
+def test_distributed_lookup_table_alias(rng):
+    w = rng.randn(10, 4).astype("float32")
+    ids = rng.randint(0, 10, (3, 1)).astype("int64")
+    outs = lower("distributed_lookup_table", {"W": [w], "Ids": [ids]})
+    np.testing.assert_allclose(
+        np.asarray(outs["Outputs"][0]), w[ids[:, 0]], rtol=1e-6
+    )
