@@ -205,3 +205,52 @@ def test_fold_constants_pass(rng):
             exe.run(main, feed={"x": xb}, fetch_list=[out])[0]
         )
     np.testing.assert_allclose(got, xb @ np.full((2, 2), 6.0), rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_predictor_convnet_batchnorm(tmp_path, rng):
+    """Conv/batch_norm model family through the full inference stack:
+    train MobileNet-ish blocks, save_inference_model, reload via the
+    predictor — BN must run in test mode with the trained running stats,
+    matching the for_test clone bit-for-bit."""
+    import os
+
+    from paddle_tpu import inference
+    from paddle_tpu.models import mobilenet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [-1, 3, 16, 16])
+        lab = fluid.data("lab", [-1, 1], dtype="int64")
+        h = mobilenet._conv_bn(img, 8, 3, stride=2, name="p0")
+        h = mobilenet._depthwise_separable(h, 16, 2, name="p1")
+        h = fluid.layers.adaptive_pool2d(h, 1, pool_type="avg")
+        prob = fluid.layers.fc(fluid.layers.flatten(h), size=4,
+                               act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(prob, lab))
+        fluid.optimizer.MomentumOptimizer(0.01, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            exe.run(main, feed={
+                "img": rng.randn(4, 3, 16, 16).astype("float32"),
+                "lab": rng.randint(0, 4, (4, 1)).astype("int64"),
+            }, fetch_list=[loss])
+        model_dir = os.path.join(str(tmp_path), "convmodel")
+        fluid.io.save_inference_model(model_dir, ["img"], [prob], exe,
+                                      main_program=main)
+        infer = main.clone(for_test=True)
+        xq = rng.randn(2, 3, 16, 16).astype("float32")
+        ref = np.asarray(exe.run(
+            infer, feed={"img": xq, "lab": np.zeros((2, 1), "int64")},
+            fetch_list=[prob])[0])
+    config = inference.Config(str(model_dir))
+    config.disable_tpu()
+    predictor = inference.create_predictor(config)
+    out = predictor.run([xq])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # determinism across calls (BN frozen stats, no dropout)
+    out2 = predictor.run([xq])[0]
+    np.testing.assert_allclose(out, out2, rtol=0, atol=0)
