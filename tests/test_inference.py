@@ -212,9 +212,8 @@ def test_predictor_convnet_batchnorm(tmp_path, rng):
     """Conv/batch_norm model family through the full inference stack:
     train MobileNet-ish blocks, save_inference_model, reload via the
     predictor — BN must run in test mode with the trained running stats,
-    matching the for_test clone bit-for-bit."""
-    import os
-
+    matching the for_test clone within tolerance (and bit-for-bit
+    deterministic across predictor calls)."""
     from paddle_tpu import inference
     from paddle_tpu.models import mobilenet
 
