@@ -22,6 +22,19 @@ WHITE_LIST = {
     "conv2d",
     "depthwise_conv2d",
     "conv2d_transpose",
+    # fused attention: the Pallas kernel dots run in the input dtype with
+    # f32 accumulation, so feeding bf16 q/k/v is what puts them on the MXU
+    # at full rate (softmax math inside stays f32 regardless)
+    "scaled_dot_product_attention",
+    "multihead_matmul",
+}
+
+# input slots of white-list ops that never feed an MXU dot: casting them
+# buys no rate and only quantizes the value (attention biases are added to
+# f32 scores inside the kernel)
+WHITE_LIST_SKIP_SLOTS = {
+    "scaled_dot_product_attention": {"Bias"},
+    "multihead_matmul": {"Bias", "BiasQK"},
 }
 BLACK_LIST = {
     "softmax",
@@ -101,7 +114,12 @@ def rewrite_program_amp(program=None, amp_lists=None, dest_dtype=None):
         if target is None:
             i += 1
             continue
+        skip_slots = (
+            WHITE_LIST_SKIP_SLOTS.get(op.type, ()) if target != "float32" else ()
+        )
         for slot, names in list(op.inputs.items()):
+            if slot in skip_slots:
+                continue
             new_names = []
             for n in names:
                 v = block._find_var_recursive(n)

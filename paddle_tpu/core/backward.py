@@ -42,7 +42,14 @@ def _is_diff(x):
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
 
 
-def make_generic_grad_lowering(base):
+def make_generic_grad_lowering(base, use_pallas=False):
+    """Differentiate `base`'s lowering via jax.vjp. `use_pallas` selects which
+    forward path the vjp traces: the grad op must differentiate the SAME
+    lowering the forward ran, or a Pallas custom_vjp (e.g. flash attention's
+    blocked backward) silently degrades to re-tracing the unfused reference
+    path — recomputing the forward AND materializing the buffers the kernel
+    exists to avoid (caught by tests/test_hlo.py)."""
+
     def lower(ins, attrs):
         fwd_in_slots = [s for s in attrs["__fwd_inputs__"] if s in ins]
         fwd_out_slots = attrs["__fwd_outputs__"]
@@ -74,7 +81,7 @@ def make_generic_grad_lowering(base):
                 full[s] = members
             if "__rng_key__" in ins:
                 full["__rng_key__"] = ins["__rng_key__"]
-            outs = base.lower(full, clean_attrs)
+            outs = base.lowering(use_pallas)(full, clean_attrs)
             result = {}
             for s in fwd_out_slots:
                 if s in outs:
@@ -125,9 +132,20 @@ def resolve_op_def(op_type):
         base_type = op_type[: -len("_grad")]
         if OpRegistry.has(base_type):
             base = OpRegistry.get(base_type)
-            lower = base.grad if base.grad is not None else make_generic_grad_lowering(base)
+            if base.grad is not None:
+                lower, pallas_lower = base.grad, None
+            else:
+                lower = make_generic_grad_lowering(base, use_pallas=False)
+                # keep fwd/bwd path selection consistent under the executor's
+                # use_pallas toggle: the pallas-variant grad differentiates the
+                # pallas forward (whose custom_vjp supplies the blocked bwd)
+                pallas_lower = (
+                    make_generic_grad_lowering(base, use_pallas=True)
+                    if base.pallas is not None
+                    else None
+                )
             gdef = OpDef(
-                op_type, lower, stateful=base.stateful,
+                op_type, lower, pallas=pallas_lower, stateful=base.stateful,
                 needs_block=base.needs_block,
             )
             _GRAD_DEF_CACHE[op_type] = gdef

@@ -40,17 +40,20 @@ _NEG = -1e30
 def _attention_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                       sm_scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (BQ, D)
+    # MXU discipline: dots run in the INPUT dtype (bf16 under AMP — full MXU
+    # rate) with f32 accumulation via preferred_element_type; all softmax
+    # math (max/exp/normalizer) stays f32
+    q = q_ref[0]  # (BQ, D)
     nk = seq_len // block_k
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (BQ, BK)
+        ) * sm_scale  # (BQ, BK) f32
         if bias_ref is not None:
             s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
@@ -66,7 +69,7 @@ def _attention_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -196,23 +199,23 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
     dk/dv (+ per-head dbias) for this KV block. Scores are recomputed from
     the saved LSE, so nothing O(S^2) ever reaches HBM."""
     j = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
-    kT_scaled = k * sm_scale
+    # dots in input dtype, f32 accumulation (see _attention_kernel)
+    k = k_ref[0]  # (BK, D)
+    v = v_ref[0]
     cols = j * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
 
     def body(i, carry):
         dk, dv, dbias = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        g = g_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(
-            q, kT_scaled, (((1,), (1,)), ((), ())),
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (BQ, BK)
+        ) * sm_scale  # (BQ, BK) f32
         if bias_ref is not None:
             s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
@@ -226,7 +229,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
             (lse <= _NEG / 2)[:, None], 0.0, jnp.exp(s - lse[:, None])
         )  # (BQ, BK)
         dv_new = dv + jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -235,7 +238,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta[:, None])
         dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
         dbias_new = dbias + ds.sum(axis=0)
@@ -261,8 +264,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                    dq_ref, *, sm_scale, causal, block_q, block_k, seq_len):
     """One (batch*head, Q block) program: stream KV blocks, accumulate dq."""
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    # dots in input dtype, f32 accumulation (see _attention_kernel)
+    q = q_ref[0]
+    g = g_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     rows = i * block_q + jax.lax.broadcasted_iota(
@@ -270,8 +274,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
     )
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -292,7 +296,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
 
@@ -423,7 +427,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         dq3.reshape(B, H, S, D),
         dk3.reshape(B, H, S, D),
         dv3.reshape(B, H, S, D),
-        dbias,
+        # cotangent dtype must match the bias primal (custom_vjp contract)
+        dbias.astype(bias.dtype) if dbias is not None else None,
     )
 
 
@@ -436,6 +441,10 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     [B, S] additive key-position bias (padding mask). Differentiable."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    # kernel dots run in the operand dtype (bf16 stays on the MXU fast
+    # path); mixed q/k/v dtypes are promoted once here so the dots agree
+    dt = jnp.result_type(q.dtype, k.dtype, v.dtype)
+    q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     # pallas interpret mode inside a shard_map region trips an MLIR

@@ -70,16 +70,44 @@ def check_spec(shape, spec, mesh):
     return spec
 
 
+def _slot_parent(name, name_set):
+    """Longest member of `name_set` that `name` extends as ``parent_<suffix>``
+    — resolves optimizer accumulators (named f"{param}_{slot}_{idx}",
+    optimizer.py:77) to their parameter even when the parameter name itself
+    ends in ``_0`` (default fc naming)."""
+    best = None
+    for p in name_set:
+        if p != name and name.startswith(p + "_"):
+            if best is None or len(p) > len(best):
+                best = p
+    return best
+
+
 def derive_shardings(names, shapes, mesh, rules=None, overrides=None):
     """names -> NamedSharding using overrides (exact name -> spec) first,
-    then pattern rules, validated against the mesh."""
+    then pattern rules, validated against the mesh.
+
+    Optimizer slots inherit their parameter's spec: a sharded weight whose
+    Adam moments stayed replicated makes GSPMD gather the FULL weight every
+    step to reconcile the update (caught by tests/test_hlo.py
+    test_tp_mesh_no_weight_sized_collectives) — so when a name matches no
+    explicit rule, its longest-prefix parent's spec applies. Scalar slots
+    (beta_pow) fall back to replicated via check_spec's rank guard."""
     rules = rules if rules is not None else MEGATRON_RULES
     overrides = overrides or {}
+    name_set = set(names)
     out = {}
     for name, shape in zip(names, shapes):
         spec = overrides.get(name)
         if spec is None:
             spec = match_spec(name, rules)
+        if spec == P() and name not in overrides:
+            parent = _slot_parent(name, name_set)
+            if parent is not None:
+                pspec = overrides.get(parent)
+                if pspec is None:
+                    pspec = match_spec(parent, rules)
+                spec = pspec
         spec = check_spec(tuple(shape), spec, mesh)
         out[name] = NamedSharding(mesh, spec)
     return out

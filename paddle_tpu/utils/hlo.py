@@ -1,0 +1,163 @@
+"""Lower framework programs to HLO text for chip-independent perf assertions.
+
+The reference proves kernel choices with a micro-bench runner
+(reference: paddle/fluid/operators/benchmark/op_tester.cc:1); on TPU the
+compiler is the schedule, so the equivalent evidence is the compiled
+computation itself: lower the REAL train step to StableHLO / optimized HLO
+and assert structural properties — no O(S^2) HBM buffers on the flash path,
+bf16 on every MXU dot under AMP, the expected collectives under dp/tp
+meshes. tests/test_hlo.py runs these as regression gates; this module is the
+shared lowering plumbing.
+
+StableHLO (pre-XLA-optimization) is the right layer for dtype and shape
+discipline: it reflects what the framework emitted. Optimized HLO reflects
+backend choices — on the CPU test rig XLA rewrites bf16 dots to f32
+(hardware has no bf16 units), so dtype assertions there would be
+meaningless; buffer-shape and collective assertions remain valid.
+"""
+
+import re
+
+import numpy as np
+
+import jax
+
+
+def _sds_of(value):
+    arr = np.asarray(value) if not hasattr(value, "shape") else value
+    return jax.ShapeDtypeStruct(tuple(arr.shape), np.asarray(value).dtype if not hasattr(value, "dtype") else value.dtype)
+
+
+def lower_program_step(program, feed, fetch_list, scope=None, donate=True):
+    """Lower the Executor's whole-block step for `program` WITHOUT running it.
+
+    `feed` maps name -> array (shape/dtype only). The scope must hold
+    initialized persistables (run the startup program first). Returns the
+    jax ``Lowered``: ``.as_text()`` is StableHLO, ``.compile().as_text()``
+    the backend-optimized HLO.
+    """
+    from paddle_tpu.core.executor import _interpret_block, plan_step
+    from paddle_tpu.core.scope import global_scope
+    from paddle_tpu.passes import apply_deferred_sparse_rewrite
+
+    scope = scope or global_scope()
+    apply_deferred_sparse_rewrite(program)
+    block = program.global_block()
+    feed_names = sorted(feed)
+    fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+    donated, readonly, written, ops = plan_step(
+        block, feed_names, fetch_names, scope, donate
+    )
+
+    def step(feed_vals, donated_vals, readonly_vals, rng_key):
+        env = dict(zip(feed_names, feed_vals))
+        env.update(zip(donated, donated_vals))
+        env.update(zip(readonly, readonly_vals))
+        _interpret_block(block, env, rng_key, ops=ops)
+        return [env[n] for n in fetch_names], [env.get(n) for n in written]
+
+    feed_sds = tuple(_sds_of(feed[n]) for n in feed_names)
+    donated_sds = tuple(_sds_of(scope.find_var(n)) for n in donated)
+    readonly_sds = tuple(_sds_of(scope.find_var(n)) for n in readonly)
+    key = jax.random.PRNGKey(0)
+    return jax.jit(step, donate_argnums=((1,) if donated else ())).lower(
+        feed_sds, donated_sds, readonly_sds, key
+    )
+
+
+def lower_parallel_step(exe, compiled_program, feed, fetch_list, scope):
+    """Lower a CompiledProgram (mesh) step. Runs ONE real step first so the
+    CompiledProgram builds its cache entry (shardings, donation plan) through
+    the production path, then re-lowers that exact jitted step with abstract
+    args. Returns (Lowered, mesh)."""
+    from paddle_tpu.parallel.env import mesh_context
+
+    exe.run(compiled_program, feed=feed, fetch_list=fetch_list, scope=scope)
+    entries = list(compiled_program._cache.values())
+    assert len(entries) == 1, "expected exactly one cache entry"
+    compiled, donated, readonly, written = entries[0][:4]
+    feed_names = sorted(feed)
+    feed_sds = tuple(_sds_of(feed[n]) for n in feed_names)
+    donated_sds = tuple(_sds_of(scope.find_var(n)) for n in donated)
+    readonly_sds = tuple(_sds_of(scope.find_var(n)) for n in readonly)
+    key = jax.random.PRNGKey(0)
+    with mesh_context(compiled_program._mesh):
+        lowered = compiled.lower(feed_sds, donated_sds, readonly_sds, key)
+    return lowered, compiled_program._mesh
+
+
+# ---------------------------------------------------------------------------
+# text analysis
+# ---------------------------------------------------------------------------
+
+_STABLEHLO_TENSOR = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x([a-z0-9]+)>")
+_OPT_HLO_SHAPE = re.compile(r"\b([a-z0-9]+)\[([0-9,]*)\]")
+
+
+def stablehlo_tensors(text):
+    """All ranked tensor types in StableHLO text as (dims tuple, dtype)."""
+    out = []
+    for m in _STABLEHLO_TENSOR.finditer(text):
+        dims = tuple(int(d) for d in m.group(1).split("x"))
+        out.append((dims, m.group(2)))
+    return out
+
+
+def opt_hlo_shapes(text):
+    """All shaped values in optimized HLO text as (dims tuple, dtype)."""
+    out = []
+    for m in _OPT_HLO_SHAPE.finditer(text):
+        if not m.group(2):
+            continue
+        dims = tuple(int(d) for d in m.group(2).split(","))
+        out.append((dims, m.group(1)))
+    return out
+
+
+def tensors_with_trailing(tensors, trailing):
+    """Tensors whose shape ends with the given dims (e.g. (S, S))."""
+    t = tuple(trailing)
+    return [x for x in tensors if x[0][-len(t):] == t]
+
+
+def tensors_containing_dims(tensors, dims):
+    """Tensors whose shape contains ALL the given dim sizes (any order)."""
+    need = list(dims)
+    out = []
+    for shape, dt in tensors:
+        pool = list(shape)
+        ok = True
+        for d in need:
+            if d in pool:
+                pool.remove(d)
+            else:
+                ok = False
+                break
+        if ok:
+            out.append((shape, dt))
+    return out
+
+
+def stablehlo_dots(text):
+    """(lhs, rhs, out) tensor types for every dot_general in StableHLO."""
+    dots = []
+    pat = re.compile(
+        r"stablehlo\.dot_general.*?:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)"
+        r"\s*->\s*tensor<([^>]+)>"
+    )
+    for m in pat.finditer(text):
+        dots.append((m.group(1), m.group(2), m.group(3)))
+    return dots
+
+
+def count_collectives(opt_text):
+    """Collective-op counts in optimized HLO (post-SPMD-partitioning)."""
+    return {
+        "all-reduce": len(re.findall(r"\ball-reduce(?:-start)?\(", opt_text)),
+        "all-gather": len(re.findall(r"\ball-gather(?:-start)?\(", opt_text)),
+        "reduce-scatter": len(re.findall(r"\breduce-scatter\(", opt_text)),
+        "all-to-all": len(re.findall(r"\ball-to-all\(", opt_text)),
+        "collective-permute": len(
+            re.findall(r"\bcollective-permute(?:-start)?\(", opt_text)
+        ),
+    }
