@@ -77,7 +77,9 @@ def live_ops(block, fetch_names):
             (v := block._find_var_recursive(n)) is not None and v.persistable
             for n in writes
         )
-        stateful_side_effect = op.type in ("print", "py_func")
+        stateful_side_effect = op.type in (
+            "print", "py_func", "distributed_push_sparse",
+        )
         if writes_persistable or stateful_side_effect or (writes & needed):
             keep[i] = True
             needed.update(reads)
@@ -374,7 +376,26 @@ class Executor:
         step = 0
         last = None
         last_handled = _time.monotonic()
-        for feed in dataset._iter_batches():
+        # lookahead iteration ONLY for programs with in-graph remote tables
+        # (distributed_embedding): the NEXT batch's ids are announced before
+        # the current step runs, so the PS pull overlaps device compute —
+        # the dataset-mode analog of the reference's prefetch thread
+        # (reference: paddle/fluid/operators/distributed/parameter_prefetch.cc).
+        # Other programs keep strict one-batch-at-a-time iteration: eagerly
+        # demanding batch N+1 from a streaming producer would stall batch N.
+        lookahead = bool(
+            getattr(getattr(program, "program", program), "_remote_tables", None)
+        )
+        it = iter(dataset._iter_batches())
+        feed = next(it, None)
+        nxt = None
+        while feed is not None:
+            if lookahead:
+                nxt = next(it, None)
+                if nxt is not None:
+                    from paddle_tpu.distributed import lookup as _rl
+
+                    _rl.prefetch_for_program(program, nxt)
             out = self.run(
                 program, feed=feed, fetch_list=fetch_list, scope=scope
             )
@@ -400,6 +421,7 @@ class Executor:
                 ]
                 print(f"step {step}: " + ", ".join(msgs))
             step += 1
+            feed = nxt if lookahead else next(it, None)
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

@@ -69,13 +69,29 @@ class ParameterServerOptimizer(DistributedOptimizer):
                  no_grad_set=None):
         program = loss.block.program
         tables = getattr(program, "_sparse_tables", {})
+        remote = getattr(program, "_remote_tables", {})
         rows_names = [t["rows"] for t in tables.values()]
+        # remote in-graph tables: seed their lookup OUTPUT so Out@GRAD gets
+        # finalized even though the lookup op has no differentiable inputs
+        out_names = [t["out"] for t in remote.values()]
         opt = self._optimizer
         opt.helper = LayerHelper(opt.__class__.__name__)
         opt._create_global_learning_rate()
         params_grads = append_backward(
-            loss, parameter_list, no_grad_set, extra_seeds=rows_names
+            loss, parameter_list, no_grad_set,
+            extra_seeds=rows_names + out_names,
         )
+        block = loss.block
+        for tname, t in remote.items():
+            # in-step push of the merged row grads (op_role=backward so the
+            # microbatched executor runs it per-microbatch with that
+            # microbatch's ids)
+            block.append_op(
+                "distributed_push_sparse",
+                {"Ids": [t["ids"]], "Grad": [t["out"] + "@GRAD"]},
+                {},
+                {"table_name": tname, "dim": t["dim"], "op_role": 1},
+            )
         optimize_ops = opt.apply_gradients(params_grads)
         fleet._origin_program = program
         fleet._main_program = program
@@ -175,6 +191,15 @@ class PSWorker:
         self._scatter_params(merged, scope)
         self._geo_snapshot = merged
 
+    def prefetch(self, program, next_feed):
+        """Announce the NEXT batch's ids so the in-graph remote lookups
+        (distributed_embedding) overlap their server pull with the current
+        step's compute — the reference's prefetch thread
+        (reference: distributed/parameter_prefetch.cc:1)."""
+        from paddle_tpu.distributed import lookup as _rl
+
+        _rl.prefetch_for_program(program, next_feed)
+
     def run(self, program, feed, fetch_list=None, scope=None):
         fetch_list = list(fetch_list or [])
         feed = dict(feed)
@@ -258,14 +283,25 @@ class _PSFleet(Fleet):
         enforce(eps, "no server endpoints (set PADDLE_PSERVERS_IP_PORT_LIST)")
         self._client = PSClient(eps)
         tables = getattr(program, "_sparse_tables", {})
+        remote = getattr(program, "_remote_tables", {})
         if self.worker_index() <= 0:
-            for t in tables.values():
+            for t in list(tables.values()) + list(remote.values()):
                 self._client.create_table(
                     t["table_id"],
                     dim=t["dim"],
                     init_range=t["init_range"],
                     optimizer=_OPT_CODES.get(t["optimizer"], 0),
                 )
+        if remote:
+            from paddle_tpu.distributed import lookup as _rl
+
+            strategy = self._strategy or PSDistributedStrategy()
+            ctx = _rl.RemoteLookupContext(
+                self._client, sparse_lr=strategy.sparse_lr
+            )
+            for tname, t in remote.items():
+                ctx.register(tname, t["table_id"], t["dim"])
+            _rl.activate(ctx)
         if self.worker_num() > 1:
             self._client.barrier(self.worker_num())
 
@@ -282,8 +318,11 @@ class _PSFleet(Fleet):
         return self._worker_obj
 
     def stop_worker(self):
+        from paddle_tpu.distributed import lookup as _rl
+
         if self._worker_obj is not None:
             self._worker_obj.stop()
+        _rl.deactivate()
         if self._client is not None:
             self._client.close()
 

@@ -22,6 +22,7 @@ __all__ = [
     "group_norm",
     "embedding",
     "sparse_embedding",
+    "distributed_embedding",
     "scaled_dot_product_attention",
     "moe_ffn",
     "dropout",
@@ -633,6 +634,75 @@ def sparse_embedding(
         "rows": rows.name,
         "idx": idx.name,
         "dim": embedding_dim,
+        "init_range": init_range,
+        "optimizer": optimizer,
+    }
+    return out
+
+
+def distributed_embedding(
+    input,
+    size,
+    table_name=None,
+    table_id=None,
+    init_range=0.01,
+    optimizer="sgd",
+    dtype="float32",
+):
+    """Embedding whose table lives ONLY on parameter servers, pulled inside
+    the compiled step (reference: distributed_lookup_table +
+    paddle/fluid/operators/distributed/parameter_prefetch.cc:1). No local
+    parameter is created; `size` is [vocab, dim] where vocab is advisory
+    (servers grow rows on demand — billion-feature tables never
+    materialize). The backward pushes merged row grads to the servers
+    (ParameterServerOptimizer wires the push op); fleet.init_worker()
+    creates the server tables and activates the lookup context. Use
+    `RemoteLookupContext.prefetch` / PSWorker.prefetch for double-buffered
+    pulls.
+
+    Id range: in-graph ids ride the XLA int path (int32 under the default
+    x64-disabled config), so ids must be < 2^31 — pre-hash larger spaces
+    (`id % (2**31 - 1)`, the reference's hash-op recipe) or use
+    `sparse_embedding`, whose host-side pull keeps the full uint64 space."""
+    from paddle_tpu.core.ir import default_main_program
+    from paddle_tpu.utils.enforce import enforce
+
+    enforce(
+        dtype == "float32",
+        f"distributed_embedding dtype must be float32 (got {dtype}): the "
+        "PS wire format and the in-step pull callback are f32",
+    )
+    helper = LayerHelper("distributed_embedding", name=table_name)
+    tname = table_name or unique_name.generate("dist_emb")
+    dim = int(size[1])
+    program = default_main_program()
+    tables = getattr(program, "_remote_tables", None)
+    if tables is None:
+        tables = program._remote_tables = {}
+    if table_id is None:
+        used = {t["table_id"] for t in tables.values()}
+        used |= {
+            t["table_id"]
+            for t in getattr(program, "_sparse_tables", {}).values()
+        }
+        table_id = max(used, default=100) + 1
+    out = helper.create_variable_for_type_inference(dtype)
+    ids_shape = [d for d in (input.shape or [-1])]
+    if len(ids_shape) >= 2 and ids_shape[-1] == 1:
+        ids_shape = ids_shape[:-1]
+    out.shape = ids_shape + [dim]
+    out.stop_gradient = False
+    helper.append_op(
+        "distributed_lookup_table",
+        {"Ids": [input.name]},
+        {"Outputs": [out.name]},
+        {"table_name": tname, "dim": dim},
+    )
+    tables[tname] = {
+        "table_id": table_id,
+        "ids": input.name,
+        "out": out.name,
+        "dim": dim,
         "init_range": init_range,
         "optimizer": optimizer,
     }

@@ -27,8 +27,11 @@ def build_ctr_train(
     vocab_size=None,
 ):
     """Returns (main, startup, feeds, fetches). ps_mode=True uses
-    PS sparse_embedding (ids unbounded); ps_mode=False uses an on-device
+    PS sparse_embedding (host pre-pull, ids unbounded); ps_mode="remote"
+    uses distributed_embedding (in-graph io_callback pull/push, the
+    reference's parameter_prefetch flow); ps_mode=False uses an on-device
     dense table of `vocab_size` rows (parity baseline for tests)."""
+    remote = ps_mode == "remote"
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         slots = [
@@ -39,7 +42,14 @@ def build_ctr_train(
 
         wide_parts, deep_parts = [], []
         for i, s in enumerate(slots):
-            if ps_mode:
+            if remote:
+                wide_e = fluid.layers.distributed_embedding(
+                    s, [0, 1], table_name=f"wide_{i}", init_range=0.0
+                )
+                deep_e = fluid.layers.distributed_embedding(
+                    s, [0, deep_dim], table_name=f"deep_{i}", init_range=0.0
+                )
+            elif ps_mode:
                 wide_e = fluid.layers.sparse_embedding(
                     s, 1, name=f"wide_{i}", init_range=0.0
                 )

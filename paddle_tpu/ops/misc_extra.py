@@ -1006,20 +1006,88 @@ def _var_conv_2d(ins, attrs):
 def _distributed_lookup_table(ins, attrs):
     """reference: paddle/fluid/operators/distributed_ops/
     distributed_lookup_table_op.cc — embedding lookup against a
-    parameter-server table. Single-process semantic: a dense gather from
-    the local table (exactly what the reference computes, with the table
-    fetched remotely); the actual remote path is the PS stack
-    (layers.sparse_embedding + fleet/parameter_server.py), which pulls
-    only the batch's unique rows per step."""
-    w = first(ins, "W")
+    parameter-server table. Two forms:
+
+    * W present (single-process semantic): dense gather from the local
+      table — what the reference computes once the rows are fetched.
+    * no W (the PS fleet form, layers.distributed_embedding): the table
+      exists ONLY on the servers; the lookup is a `jax.experimental.
+      io_callback` pulling the batch's unique rows inside the compiled
+      step (reference: distributed/parameter_prefetch.cc:1), prefetch-
+      aware via distributed/lookup.py. With no active worker context the
+      lowering RAISES — a ported PS program must not silently train on a
+      local table."""
+    if ins.get("W"):
+        w = first(ins, "W")
+        outs = []
+        for ids in ins["Ids"]:
+            idv = ids
+            if idv.ndim >= 2 and idv.shape[-1] == 1:
+                idv = idv[..., 0]
+            out = jnp.take(w, idv.astype(jnp.int32), axis=0)
+            pad = attrs.get("padding_idx", -1)
+            if pad is not None and pad >= 0:
+                out = jnp.where((idv == pad)[..., None], 0.0, out)
+            outs.append(out)
+        return {"Outputs": outs}
+    import functools
+
+    from jax.experimental import io_callback
+
+    from paddle_tpu.distributed import lookup as _rl
+
+    name = attrs.get("table_name")
+    ctx = _rl.active_context()
+    if ctx is None or not ctx.has(name):
+        raise EnforceError(
+            f"distributed_lookup_table('{name}') is a remote PS table but "
+            "no remote-lookup context is active. Run this program through "
+            "the PS fleet (fleet.init_worker() registers the table and "
+            "activates the context); refusing to compute a local-dense "
+            "answer instead."
+        )
+    dim = int(attrs["dim"])
     outs = []
     for ids in ins["Ids"]:
         idv = ids
         if idv.ndim >= 2 and idv.shape[-1] == 1:
             idv = idv[..., 0]
-        out = jnp.take(w, idv.astype(jnp.int32), axis=0)
-        pad = attrs.get("padding_idx", -1)
-        if pad is not None and pad >= 0:
-            out = jnp.where((idv == pad)[..., None], 0.0, out)
-        outs.append(out)
+        outs.append(
+            # ordered: pulls and pushes share one total order per device,
+            # so step N+1's pull always observes step N's push — the
+            # freshness invariant the prefetch fence validates against
+            io_callback(
+                functools.partial(_rl.pull_host, name),
+                jax.ShapeDtypeStruct(tuple(idv.shape) + (dim,), jnp.float32),
+                idv,
+                ordered=True,
+            )
+        )
     return {"Outputs": outs}
+
+
+@register_op("distributed_push_sparse", nondiff_inputs=("Ids",))
+def _distributed_push_sparse(ins, attrs):
+    """Backward half of the remote lookup: push the batch's merged row
+    grads to the servers from INSIDE the step (ordered io_callback — the
+    server update is a side effect that must survive DCE and stay sequenced
+    before the next step's pull). reference: the send/prefetch pair in
+    distributed_ops/prefetch_op.cc:1 + communicator send path."""
+    import functools
+
+    from jax.experimental import io_callback
+
+    from paddle_tpu.distributed import lookup as _rl
+
+    name = attrs.get("table_name")
+    ids, grad = first(ins, "Ids"), first(ins, "Grad")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    io_callback(
+        functools.partial(_rl.push_host, name),
+        (),
+        ids,
+        grad,
+        ordered=True,
+    )
+    return {}

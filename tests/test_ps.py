@@ -315,3 +315,142 @@ def test_geo_sgd_delta_sync(rng):
     finally:
         fleet.stop_worker()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-graph remote lookup (distributed_embedding -> io_callback pull/push)
+# ---------------------------------------------------------------------------
+
+
+def _remote_ctr_batches(vocab=50, n=6):
+    r = np.random.RandomState(42)
+    feeds = []
+    for _ in range(n):
+        feed = {}
+        for i in range(2):
+            feed[f"slot_{i}"] = r.randint(
+                0, vocab, size=(16, 2)).astype("int64")
+        feed["click"] = (r.rand(16, 1) > 0.5).astype("float32")
+        feeds.append(feed)
+    return feeds
+
+
+def test_remote_lookup_in_graph_parity_and_prefetch():
+    """The table exists ONLY on the servers; the pull happens INSIDE the
+    compiled step (io_callback, reference: distributed/
+    parameter_prefetch.cc:1) and the backward pushes merged row grads.
+    Loss curve must match a local dense-embedding run with identical
+    (zero) init and the same SGD lr; announced next-batch ids must be
+    served from the prefetch buffer, not a blocking pull."""
+    from paddle_tpu.distributed import lookup as rl
+    from paddle_tpu.fleet import parameter_server as psfleet
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+    from paddle_tpu.models import ctr
+
+    vocab, lr = 50, 0.3
+
+    # local baseline: dense tables, zero-init, one SGD rule for everything
+    main_l, startup_l, _, fetches_l = ctr.build_ctr_train(
+        num_slots=2, ids_per_slot=2, deep_dim=4, hidden=(8,),
+        optimizer=fluid.optimizer.SGD(learning_rate=lr),
+        ps_mode=False, vocab_size=vocab,
+    )
+    # one executor PER ARM: the rng counter advances per run() call, so a
+    # shared executor would give the two startup programs different keys
+    # and thus different fc inits (step-0 loss is ln 2 regardless — zero
+    # embeddings zero the logits — so that difference only shows later)
+    exe = fluid.Executor(fluid.CPUPlace())
+    ref_losses = []
+    dense_init = {}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup_l)
+        scope = fluid.global_scope()
+        for v in main_l.all_parameters():
+            if v.name.startswith("deep_") and v.name.endswith("_w"):
+                scope.set(v.name, np.zeros(v.shape, dtype=np.float32))
+            elif not v.name.startswith("wide_"):
+                # snapshot dense (fc) inits IN CREATION ORDER: the two arms'
+                # startup programs differ in op count (different rng
+                # streams) and in name-counter state (different var names),
+                # so parity seeds the remote arm positionally with THESE
+                dense_init[v.name] = np.asarray(scope.find_var(v.name)).copy()
+        for feed in _remote_ctr_batches(vocab):
+            out = exe.run(main_l, feed=feed, fetch_list=[fetches_l[0]])
+            ref_losses.append(float(out[0][0]))
+
+    fleet = psfleet.fleet
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    main_r, startup_r, _, fetches_r = ctr.build_ctr_train(
+        num_slots=2, ids_per_slot=2, deep_dim=4, hidden=(8,),
+        optimizer=fluid.optimizer.SGD(learning_rate=lr),
+        sparse_lr=lr, ps_mode="remote",
+    )
+    assert main_r._remote_tables and not getattr(
+        main_r, "_sparse_tables", {}
+    ), "remote mode must register only in-graph tables"
+    push_ops = [
+        op for op in main_r.global_block().ops
+        if op.type == "distributed_push_sparse"
+    ]
+    assert len(push_ops) == len(main_r._remote_tables)
+    srv = fleet.init_server(port=0)
+    remote_losses = []
+    try:
+        fleet.init_worker(main_r)
+        ctx = rl.active_context()
+        assert ctx is not None
+        batches = _remote_ctr_batches(vocab)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup_r)
+            scope = fluid.global_scope()
+            remote_dense = [
+                v for v in main_r.all_parameters()
+                if not v.name.startswith(("wide_", "deep_"))
+            ]
+            assert len(remote_dense) == len(dense_init)
+            for v, val in zip(remote_dense, dense_init.values()):
+                assert tuple(v.shape) == val.shape, (v.name, v.shape)
+                scope.set(v.name, val)
+            # plain exe.run: NO host-side feed rewrite — pulls and pushes
+            # ride the step's io_callbacks
+            for step, feed in enumerate(batches):
+                if step + 1 < len(batches):
+                    # double-buffer: announce next batch's ids now; the
+                    # pull is fenced behind this step's pushes so the
+                    # prefetched rows are not one-update stale
+                    rl.prefetch_for_program(main_r, batches[step + 1])
+                out = exe.run(main_r, feed=feed, fetch_list=[fetches_r[0]])
+                remote_losses.append(float(out[0][0]))
+        # rows live server-side only
+        stats = fleet._client.table_stats()
+        assert sum(stats.values()) > 0
+        assert ctx.stats["pushes"] > 0
+        # steps 2..N pulled every table from the prefetch buffer
+        n_tables = len(main_r._remote_tables)
+        assert ctx.stats["prefetch_hits"] >= (len(batches) - 1) * n_tables
+        # step 1 had no announcement: sync pulls only there
+        assert ctx.stats["pulls"] <= n_tables
+    finally:
+        fleet.stop_worker()
+        srv.stop()
+    np.testing.assert_allclose(ref_losses, remote_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_remote_lookup_without_context_raises():
+    """A ported PS program must fail loudly outside the fleet, not silently
+    train on a local dense table (VERDICT r4 weak item 3)."""
+    from paddle_tpu.models import ctr
+    from paddle_tpu.utils.enforce import EnforceError
+
+    main, startup, _, fetches = ctr.build_ctr_train(
+        num_slots=2, ids_per_slot=2, deep_dim=4, hidden=(8,),
+        optimizer=fluid.optimizer.SGD(learning_rate=0.1),
+        ps_mode="remote",
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = _remote_ctr_batches()[0]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(EnforceError, match="remote|context"):
+            exe.run(main, feed=feed, fetch_list=[fetches[0]])
