@@ -1,21 +1,36 @@
-"""Dygraph-to-static AST transform for data-dependent `if`.
+"""Dygraph-to-static AST transforms for data-dependent `if` and loops.
 
 reference: python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py
-(IfElseTransformer) — the reference rewrites Python `if` on tensors into
-layers.cond sub-blocks. TPU-native form: the rewritten `if` evaluates BOTH
-branches and selects per returned tensor with the `where` op — the
-lax.select lowering XLA would pick for cheap branches anyway, and it needs
-no sub-block machinery under trace capture. Eager calls keep plain Python
-branching (values exist, __bool__ works).
+(IfElseTransformer) and loop_transformer.py (LoopTransformer) — the
+reference rewrites Python `if` on tensors into layers.cond sub-blocks and
+`while`/`for` into layers.While. TPU-native forms:
+
+* `if`: the rewritten statement evaluates BOTH branches and selects per
+  returned tensor with the `where` op — the lax.select lowering XLA would
+  pick for cheap branches anyway, and it needs no sub-block machinery under
+  trace capture. Eager calls keep plain Python branching.
+* `while` / `for i in range(...)`: carried variables (names assigned in the
+  body) become explicit cond/body function parameters; at run time a
+  concrete condition keeps the plain Python loop (eager mode, or constant
+  trip counts under capture — unrolled exactly as before), while a symbolic
+  condition under capture builds a `while` op sub-block (lowered to
+  lax.while_loop, ops/control_flow.py) with the carried names written back
+  each iteration.
 
 Contract (documented limits, loud failures otherwise):
-- only `if`/`elif`/`else` on tensor predicates are transformed; `for`/
-  `while` over tensors still raise the capture-guard error (use
-  layers.while_loop);
-- both branches run under trace: side-effecting branches (py_func, prints,
-  state write-backs) are NOT eligible;
-- branch variables must be assignable by simple names; `return`/`break`/
-  `continue` inside a transformed `if` are rejected at transform time.
+- both `if` branches run under trace: side-effecting branches (py_func,
+  prints, state write-backs) are NOT eligible;
+- variables must be assignable by simple names; `return` inside a
+  transformed `if`, and `break`/`continue`/`return` inside a transformed
+  loop body, are rejected at transform time (those loops stay plain
+  Python: static trip counts still work, data-dependent ones hit the loud
+  capture guard);
+- loop-carried variables must hold tensor values (or numbers promotable to
+  tensors) and be assigned BEFORE the loop;
+- `for x in <tensor>` iteration is not converted (use layers.while_loop or
+  index with a range loop);
+- after a ZERO-trip converted `for`, the loop variable holds `start`
+  (CPython leaves it unbound/stale) — carried state needs an init value.
 """
 
 import ast
@@ -25,6 +40,8 @@ import textwrap
 __all__ = ["convert_ifelse", "ast_transform"]
 
 _HELPER = "__paddle_tpu_select_if__"
+_WHILE_HELPER = "__paddle_tpu_while__"
+_CMP_HELPER = "__paddle_tpu_loop_cmp__"
 
 
 def _assigned_names(stmts):
@@ -49,6 +66,29 @@ def _assigned_names(stmts):
         def visit_For(self, node):
             self._collect(node.target)
             self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):
+            # walrus binds at function scope — a converted body must carry it
+            self._collect(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._collect(item.optional_vars)
+            self.generic_visit(node)
+
+        visit_AsyncWith = visit_With
+
+        def visit_Import(self, node):
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = (a.asname or a.name).split(".")[0]
+                if name not in names:
+                    names.append(name)
+
+        visit_ImportFrom = visit_Import
 
         def visit_FunctionDef(self, node):
             pass  # nested scope
@@ -109,9 +149,200 @@ def _has_flow_escape(stmts):
     return v.found
 
 
+def _has_loop_escape(stmts):
+    """Constructs that cannot live inside a converted loop body: `return`,
+    `break`/`continue` belonging to the loop being converted (depth 0),
+    and global/nonlocal declarations (the body becomes a nested def)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+        loop_depth = 0
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def visit_Global(self, node):
+            self.found = True
+
+        visit_Nonlocal = visit_Global
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = _loop
+        visit_While = _loop
+        visit_AsyncFor = _loop
+
+        def visit_FunctionDef(self, node):
+            pass  # own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _contains_named_expr(node):
+    return any(isinstance(n, ast.NamedExpr) for n in ast.walk(node))
+
+
 class _IfTransformer(ast.NodeTransformer):
     def __init__(self):
         self.count = 0
+
+    # -- loops (the reference's LoopTransformer,
+    #    dygraph_to_static/loop_transformer.py) -------------------------
+    def _thunks(self, params):
+        return ast.Tuple(
+            elts=[
+                ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=x, ctx=ast.Load()),
+                )
+                for x in params
+            ],
+            ctx=ast.Load(),
+        )
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if (
+            node.orelse
+            or _has_loop_escape(node.body)
+            or _contains_named_expr(node.test)
+        ):
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            # a body assigning nothing can only terminate via side
+            # effects — not expressible as carried state; leave plain
+            return node
+        n = self.count
+        self.count += 1
+        cname, bname = f"__pt_wcond_{n}", f"__pt_wbody_{n}"
+
+        def fn_args():
+            return ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=x) for x in names],
+                kwonlyargs=[], kw_defaults=[], defaults=[],
+            )
+
+        ret = ast.Return(
+            value=ast.Tuple(
+                elts=[ast.Name(id=x, ctx=ast.Load()) for x in names],
+                ctx=ast.Load(),
+            )
+        )
+        cdef = ast.FunctionDef(
+            name=cname, args=fn_args(),
+            body=[ast.Return(value=node.test)], decorator_list=[],
+        )
+        bdef = ast.FunctionDef(
+            name=bname, args=fn_args(),
+            body=list(node.body) + [ret], decorator_list=[],
+        )
+        call = ast.Call(
+            func=ast.Name(id=_WHILE_HELPER, ctx=ast.Load()),
+            args=[
+                ast.Name(id=cname, ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()),
+                self._thunks(names),
+                ast.Tuple(
+                    elts=[ast.Constant(value=x) for x in names],
+                    ctx=ast.Load(),
+                ),
+            ],
+            keywords=[],
+        )
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=x, ctx=ast.Store()) for x in names],
+                ctx=ast.Store(),
+            )],
+            value=call,
+        )
+        return [cdef, bdef, assign]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+            and not it.keywords
+            and 1 <= len(it.args) <= 3
+        ):
+            return node  # non-range iteration stays plain Python
+        if _has_loop_escape(node.body):
+            return node
+        n = self.count
+        self.count += 1
+        start = it.args[0] if len(it.args) >= 2 else ast.Constant(value=0)
+        stop = it.args[1] if len(it.args) >= 2 else it.args[0]
+        step = it.args[2] if len(it.args) == 3 else ast.Constant(value=1)
+        s_start, s_stop, s_step, s_i = (
+            f"__pt_start_{n}", f"__pt_stop_{n}", f"__pt_step_{n}",
+            f"__pt_i_{n}",
+        )
+        tgt = node.target.id
+
+        def nm(x, ctx=None):
+            return ast.Name(id=x, ctx=ctx or ast.Load())
+
+        # a PRIVATE counter advances the loop; the user's loop variable is
+        # assigned FROM it each iteration, so a body that reassigns the
+        # loop variable (for i in ...: i = 99) still iterates like CPython
+        # and the post-loop value of the loop variable is the last body
+        # value, not one-step-past. (Zero-trip loops leave the loop var at
+        # `start` — the documented divergence from CPython's unbound/stale
+        # name, needed because a carried var must have an initial value.)
+        pre = [
+            ast.Assign(targets=[nm(s_start, ast.Store())], value=start),
+            ast.Assign(targets=[nm(s_stop, ast.Store())], value=stop),
+            ast.Assign(targets=[nm(s_step, ast.Store())], value=step),
+            ast.Assign(targets=[nm(s_i, ast.Store())], value=nm(s_start)),
+            ast.Assign(targets=[nm(tgt, ast.Store())], value=nm(s_start)),
+        ]
+        body = (
+            [ast.Assign(targets=[nm(tgt, ast.Store())], value=nm(s_i))]
+            + list(node.body)
+            + [
+                ast.Assign(
+                    targets=[nm(s_i, ast.Store())],
+                    value=ast.BinOp(
+                        left=nm(s_i), op=ast.Add(), right=nm(s_step)
+                    ),
+                )
+            ]
+        )
+        w = ast.While(
+            test=ast.Call(
+                func=nm(_CMP_HELPER),
+                args=[nm(s_i), nm(s_stop), nm(s_step)],
+                keywords=[],
+            ),
+            body=body,
+            orelse=[],
+        )
+        converted = self.visit_While(w)
+        return pre + (converted if isinstance(converted, list) else [converted])
 
     def visit_If(self, node):
         self.generic_visit(node)  # innermost-first
@@ -263,6 +494,104 @@ def _select_if(pred, true_fn, false_fn, thunks=()):
     return tuple(outs)
 
 
+def _loop_cmp(i, stop, step):
+    """for-range loop condition: `i < stop` for positive step, `i > stop`
+    for a NEGATIVE CONSTANT step. A symbolic (tensor) step is compared as
+    positive — documented limit, matching the reference's loop transform."""
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    neg = not isinstance(step, VarBase) and step < 0
+    return (i > stop) if neg else (i < stop)
+
+
+def _run_while(cond_fn, body_fn, thunks, names):
+    """Runtime dispatch for a converted loop: concrete condition -> plain
+    Python while (eager mode; constant trip counts under capture unroll
+    exactly as an untransformed trace would); symbolic condition under
+    capture -> a `while` op whose sub-block runs the traced body and
+    writes each carried name back (lowered to lax.while_loop)."""
+    import numpy as _np
+
+    from paddle_tpu.dygraph import base
+    from paddle_tpu.dygraph.base import to_variable
+    from paddle_tpu.dygraph.varbase import VarBase
+
+    vals = []
+    for th in thunks:
+        try:
+            vals.append(th())
+        except (NameError, UnboundLocalError):
+            vals.append(_Undefined())
+    c = cond_fn(*vals)
+    if not isinstance(c, VarBase) or c.value is not None:
+        while bool(c):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, tuple) else [out]
+            c = cond_fn(*vals)
+        return tuple(vals)
+
+    cap = base._capture
+    if cap is None:
+        raise RuntimeError(
+            "converted loop: symbolic condition outside capture mode"
+        )
+    from paddle_tpu.layers.control_flow import While
+
+    prog = cap.main_program
+    svs = []
+    for nm, v in zip(names, vals):
+        if isinstance(v, _Undefined):
+            raise RuntimeError(
+                f"converted loop: variable '{nm}' is loop state but has no "
+                "value before the loop; initialize it first"
+            )
+        if isinstance(v, VarBase):
+            vb = v
+        else:
+            arr = _np.asarray(v)
+            if arr.ndim == 0:
+                # fluid's scalar convention is shape [1]; a 0-d init would
+                # mismatch the [1] the body's arithmetic produces
+                arr = arr.reshape(1)
+            vb = to_variable(arr)
+        sv = vb.static_var
+        if sv is None:
+            sv = cap.to_static_var(vb)
+        svs.append(sv)
+    cond_sv = c.static_var
+    with While(cond_sv):
+        sub = prog.current_block()
+        out = body_fn(*[VarBase.from_static(sv) for sv in svs])
+        out = out if isinstance(out, tuple) else (out,)
+        for nm, sv, nv in zip(names, svs, out):
+            if not isinstance(nv, VarBase):
+                try:
+                    nv = to_variable(_np.asarray(nv))
+                except Exception:
+                    raise RuntimeError(
+                        f"converted loop: variable '{nm}' takes non-tensor "
+                        f"value {type(nv).__name__} inside the loop; only "
+                        "tensor (or numeric) loop state can be carried"
+                    ) from None
+            nsv = nv.static_var
+            if nsv is None:
+                nsv = cap.to_static_var(nv)
+            # write the new value back under the carried name: the while
+            # lowering carries exactly the pre-existing names the
+            # sub-block writes (ops/control_flow.py _run_while)
+            sub.append_op("assign", {"X": [nsv.name]}, {"Out": [sv.name]})
+        c2 = cond_fn(*[VarBase.from_static(sv) for sv in svs])
+        if not isinstance(c2, VarBase) or c2.static_var is None:
+            raise RuntimeError(
+                "converted loop: the condition must stay tensor-valued "
+                "inside the loop"
+            )
+        sub.append_op(
+            "assign", {"X": [c2.static_var.name]}, {"Out": [cond_sv.name]}
+        )
+    return tuple(VarBase.from_static(sv) for sv in svs)
+
+
 def ast_transform(fn):
     """Rewrite `fn`'s data-dependent `if` statements. Returns the
     transformed function, or None when the source cannot be transformed
@@ -292,6 +621,8 @@ def ast_transform(fn):
     if glb is None:
         return None
     glb[_HELPER] = _select_if
+    glb[_WHILE_HELPER] = _run_while
+    glb[_CMP_HELPER] = _loop_cmp
     # re-bind the function's closure-free form; closures over outer locals
     # cannot be rebuilt from source -> bail to the fallback
     if getattr(fn, "__closure__", None):

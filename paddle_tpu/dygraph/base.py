@@ -353,7 +353,9 @@ def _capture_op(op_type, ins, attrs, out_slots):
     from paddle_tpu.dygraph.varbase import VarBase
     from paddle_tpu.layer_helper import infer_op_shapes
 
-    block = _capture.main_program.global_block()
+    # CURRENT block, not the global one: a converted loop body traces its
+    # ops into the `while` op's sub-block (ast_transform LoopTransformer)
+    block = _capture.main_program.current_block()
     in_names = {}
     for slot, vals in ins.items():
         if vals is None:
@@ -382,17 +384,7 @@ def _capture_op(op_type, ins, attrs, out_slots):
 
     outs = {}
     for slot, names in out_names.items():
-        vbs = []
-        for name in names:
-            vb = VarBase.__new__(VarBase)
-            vb.value = None
-            vb.name = name
-            vb.stop_gradient = False
-            vb.persistable = False
-            vb.grad_value = None
-            vb.static_var = block.var(name)
-            vbs.append(vb)
-        outs[slot] = vbs
+        outs[slot] = [VarBase.from_static(block.var(n)) for n in names]
     return outs
 
 

@@ -54,13 +54,7 @@ class TracedLayer:
                     dtype=str(value.dtype),
                     is_data=True,
                 )
-                proxy = VarBase.__new__(VarBase)
-                proxy.value = None
-                proxy.name = sv.name
-                proxy.stop_gradient = True
-                proxy.persistable = False
-                proxy.grad_value = None
-                proxy.static_var = sv
+                proxy = VarBase.from_static(sv, stop_gradient=True)
                 cap.var_map[id(proxy)] = sv
                 feed_vars.append(sv)
                 proxies.append(proxy)

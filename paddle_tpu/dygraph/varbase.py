@@ -21,6 +21,21 @@ class VarBase:
         self.grad_value = None
         self.static_var = None  # set when this is a capture-mode proxy
 
+    @classmethod
+    def from_static(cls, static_var, stop_gradient=False):
+        """Capture-mode proxy bound to an existing static Variable (no
+        eager value): ops tracing through it reference `static_var` by
+        name. The jit tracer, op capture, and the loop transform all build
+        proxies this way."""
+        vb = cls.__new__(cls)
+        vb.value = None
+        vb.name = static_var.name
+        vb.stop_gradient = stop_gradient
+        vb.persistable = False
+        vb.grad_value = None
+        vb.static_var = static_var
+        return vb
+
     # -- autograd ------------------------------------------------------
     def backward(self, retain_graph=False):
         from paddle_tpu.dygraph.base import run_backward
