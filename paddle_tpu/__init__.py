@@ -43,6 +43,8 @@ from paddle_tpu.reader import DataLoader, PyReader
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu import dataset
 from paddle_tpu.dataset import DatasetFactory
+from paddle_tpu import trainer_desc
+from paddle_tpu import device_worker
 from paddle_tpu import contrib
 from paddle_tpu import metrics
 from paddle_tpu import profiler

@@ -359,20 +359,58 @@ class Executor:
         fetch_info=None,
         print_period=100,
         fetch_handler=None,
+        is_infer=False,
     ):
         """Dataset-mode training loop (reference: python/paddle/fluid/
         executor.py:1124 train_from_dataset -> C++ Executor::RunFromDataset
         with thread-per-core DeviceWorkers). TPU-native: the whole step is
         one XLA computation, so the worker-thread pool collapses into the
         native data-feed producing batches (csrc/datafeed) while the chip
-        runs the compiled step; thread/debug are accepted for parity."""
+        runs the compiled step. The per-batch driver comes from the
+        program's `_fleet_opt` via TrainerFactory (device_worker.py):
+        Hogwild = plain step, DownpourSGD = the PS pull/step/push loop;
+        its run configuration (fetch/debug/infer) travels on the
+        TrainerDesc."""
         from paddle_tpu.utils.enforce import enforce as _enforce
 
         _enforce(dataset is not None, "dataset is required")
         import time as _time
 
-        fetch_list = fetch_list or []
-        fetch_info = fetch_info or [str(f) for f in fetch_list]
+        from paddle_tpu.device_worker import TrainerFactory
+
+        prog_obj = getattr(program, "program", program)
+        if is_infer:
+            # evaluation must not update state: a program still carrying
+            # optimizer ops (or in-graph grad pushes) would train on the
+            # eval data — demand the test clone, like the reference's
+            # infer-trainer contract
+            bad = [
+                op.type
+                for op in prog_obj.global_block().ops
+                if op.attrs.get("op_role", 0) == _OP_ROLE_OPTIMIZE
+                or op.type == "distributed_push_sparse"
+            ]
+            _enforce(
+                not bad,
+                "infer_from_dataset got a TRAINING program (contains "
+                f"{sorted(set(bad))[:3]}...): pass the "
+                "clone(for_test=True) inference program instead",
+            )
+        trainer = TrainerFactory()._create_trainer(
+            getattr(prog_obj, "_fleet_opt", None)
+        )
+        trainer._set_thread(thread)
+        trainer._set_debug(debug)
+        trainer._set_infer(is_infer)
+        trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
+        trainer._set_program(prog_obj)
+        worker = trainer._device_worker
+        worker.prepare(self, prog_obj, scope)
+
+        fetch_list = trainer._fetch_vars
+        fetch_info = trainer._fetch_info or [str(f) for f in fetch_list]
+        print_period = trainer._print_period
+        debug = trainer._debug
         step = 0
         last = None
         last_handled = _time.monotonic()
@@ -396,8 +434,8 @@ class Executor:
                     from paddle_tpu.distributed import lookup as _rl
 
                     _rl.prefetch_for_program(program, nxt)
-            out = self.run(
-                program, feed=feed, fetch_list=fetch_list, scope=scope
+            out = worker.run_batch(
+                self, program, feed, fetch_list=fetch_list, scope=scope
             )
             last = out
             if fetch_list and fetch_handler is not None:
@@ -422,6 +460,7 @@ class Executor:
                 print(f"step {step}: " + ", ".join(msgs))
             step += 1
             feed = nxt if lookahead else next(it, None)
+        worker.finish()
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -430,7 +469,7 @@ class Executor:
                            fetch_handler=None):
         return self.train_from_dataset(
             program, dataset, scope, thread, debug, fetch_list, fetch_info,
-            print_period, fetch_handler,
+            print_period, fetch_handler, is_infer=True,
         )
 
     # ------------------------------------------------------------------

@@ -103,6 +103,8 @@ def _assigned_names(stmts):
             elif isinstance(t, (ast.Tuple, ast.List)):
                 for e in t.elts:
                     self._collect(e)
+            elif isinstance(t, ast.Starred):
+                self._collect(t.value)
 
     v = V()
     for s in stmts:
@@ -500,6 +502,8 @@ def _loop_cmp(i, stop, step):
     positive — documented limit, matching the reference's loop transform."""
     from paddle_tpu.dygraph.varbase import VarBase
 
+    if not isinstance(step, VarBase) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
     neg = not isinstance(step, VarBase) and step < 0
     return (i > stop) if neg else (i < stop)
 

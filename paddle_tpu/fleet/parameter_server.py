@@ -93,6 +93,13 @@ class ParameterServerOptimizer(DistributedOptimizer):
                 {"table_name": tname, "dim": t["dim"], "op_role": 1},
             )
         optimize_ops = opt.apply_gradients(params_grads)
+        # dataset-mode wiring (reference: the transpiler writing opt_info
+        # into the program for trainer_factory): train_from_dataset reads
+        # this to drive batches through the Downpour device worker
+        program._fleet_opt = {
+            "trainer": "DistMultiTrainer",
+            "device_worker": "DownpourSGD",
+        }
         fleet._origin_program = program
         fleet._main_program = program
         fleet._startup_program = startup_program or default_startup_program()
@@ -200,7 +207,10 @@ class PSWorker:
 
         _rl.prefetch_for_program(program, next_feed)
 
-    def run(self, program, feed, fetch_list=None, scope=None):
+    def run(self, program, feed, fetch_list=None, scope=None, infer=False):
+        """One batch: pull sparse rows, run the step, push row grads.
+        `infer=True` (infer_from_dataset) pulls but neither fetches grads
+        nor pushes — evaluation must not move the server tables."""
         fetch_list = list(fetch_list or [])
         feed = dict(feed)
         pulled = {}  # table name -> (uniq_ids,)
@@ -211,6 +221,10 @@ class PSWorker:
             feed[t["rows"]] = rows
             feed[t["idx"]] = inv.astype(np.int32).reshape(ids.shape)
             pulled[tname] = uniq
+        if infer:
+            return self._exe.run(
+                program, feed=feed, fetch_list=fetch_list, scope=scope
+            )
         grad_fetches = [t["rows"] + "@GRAD" for t in self._tables.values()]
         out = self._exe.run(
             program, feed=feed, fetch_list=fetch_list + grad_fetches,
@@ -325,6 +339,10 @@ class _PSFleet(Fleet):
         _rl.deactivate()
         if self._client is not None:
             self._client.close()
+        # clear worker state: a later init_worker/worker cycle (next job or
+        # test) must not resurrect this client's tables
+        self._worker_obj = None
+        self._client = None
 
     # -- persistence -------------------------------------------------------
     def save_sparse_tables(self, dirname):
