@@ -19,6 +19,7 @@ from paddle_tpu.utils.flags import flags
 WHITE_LIST = {
     "matmul",
     "mul",
+    "fc",  # the fc_fuse pass target — same MXU dot as mul
     "conv2d",
     "depthwise_conv2d",
     "conv2d_transpose",

@@ -134,8 +134,12 @@ class Config:
         if self._passes is not None:
             names = list(self._passes)
         else:
+            # pattern fusions run AFTER test-mode flip (multihead matching
+            # needs is_test dropout) and BEFORE the precision cast (the
+            # fused fc/sdpa ops are AMP-white-listed)
             names = ["strip_debug_ops", "flip_test_mode",
-                     "dead_code_elimination", "fold_constants"]
+                     "dead_code_elimination", "fold_constants",
+                     "conv_bn_fuse", "fc_fuse", "multihead_matmul_fuse"]
             if self._precision == PrecisionType.Float32:
                 pass
             else:

@@ -430,3 +430,37 @@ def _fusion_seqexpand_concat_fc(ins, attrs):
     elif act == "tanh":
         out = jnp.tanh(out)
     return {"Out": [out]}
+
+
+_FC_ACTS = {
+    "": lambda x: x,
+    "identity": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    # exact (erf) form — matches the standalone gelu op's default
+    # approximate=False (fc_fuse refuses to fold an approximate gelu)
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+@register_op("fc")
+def _fc(ins, attrs):
+    """reference: paddle/fluid/operators/fc_op.cc — the target of the
+    fc_fuse pass (mul + elementwise_add [+ act] collapsed at export,
+    reference: paddle/fluid/framework/ir/fc_fuse_pass.cc:1)."""
+    import math as _math
+
+    x, w = first(ins, "Input"), first(ins, "W")
+    b = maybe(ins, "Bias")
+    k = attrs.get("in_num_col_dims", 1)
+    x2 = x.reshape((_math.prod(x.shape[:k]), -1))
+    out = x2 @ w
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    act = attrs.get("activation_type", "") or ""
+    if act not in _FC_ACTS:
+        raise EnforceError(f"fc: unsupported activation_type {act!r}")
+    out = _FC_ACTS[act](out)
+    return {"Out": [out.reshape(tuple(x.shape[:k]) + (w.shape[1],))]}

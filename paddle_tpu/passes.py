@@ -346,3 +346,394 @@ def apply_deferred_sparse_rewrite(program):
     if num_mb > 1:
         return  # microbatched: the dense form is the correct one
     _PASS_REGISTRY["sparse_weight_update"](program, PassContext())
+
+
+# ---------------------------------------------------------------------------
+# export-time pattern fusion (reference: framework/ir/fc_fuse_pass.cc,
+# conv_bn_fuse_pass.cc, multihead_matmul_fuse_pass.cc)
+# ---------------------------------------------------------------------------
+
+
+def _build_use_maps(block, fetch_names):
+    producers, consumers = {}, {}
+    for op in block.ops:
+        for n in op.output_names():
+            producers.setdefault(n, []).append(op)
+        for n in op.input_names():
+            consumers.setdefault(n, []).append(op)
+    protected = set(fetch_names)
+    for v in block.vars.values():
+        if v.persistable:
+            protected.add(v.name)
+    return producers, consumers, protected
+
+
+def _sole_consumer(consumers, protected, name, op=None):
+    """The single op consuming `name`, or None if the var escapes (multiple
+    readers, fetched, or persistable)."""
+    if name in protected:
+        return None
+    cons = consumers.get(name, [])
+    if len(cons) != 1:
+        return None
+    if op is not None and cons[0] is not op:
+        return None
+    return cons[0]
+
+
+@register_pass("fc_fuse")
+def _fc_fuse_pass(program, ctx):
+    """mul + elementwise_add(1-D bias) [+ activation] -> one `fc` op
+    (reference: paddle/fluid/framework/ir/fc_fuse_pass.cc:1). Shrinks the
+    traced inference program; XLA sees one fused dot+bias+act region."""
+    block = program.global_block()
+    producers, consumers, protected = _build_use_maps(
+        block, ctx.fetch_names
+    )
+    drop = set()
+    rewrites = {}  # id(mul op) -> replacement Operator
+    from paddle_tpu.core.ir import Operator
+
+    # acts fusable only when their attrs match what the fc op computes
+    fusable_act = {
+        "relu": lambda a: True,
+        "tanh": lambda a: True,
+        "sigmoid": lambda a: True,
+        "gelu": lambda a: not a.get("approximate", False),
+        "relu6": lambda a: a.get("threshold", 6.0) == 6.0,
+    }
+    for op in block.ops:
+        if op.type != "mul" or id(op) in drop:
+            continue
+        if op.attrs.get("y_num_col_dims", 1) != 1:
+            continue
+        w_var = block._find_var_recursive(op.inputs["Y"][0])
+        if w_var is None or not w_var.shape or len(w_var.shape) != 2:
+            continue  # the fc lowering assumes a 2-D weight
+        k = op.attrs.get("x_num_col_dims", 1)
+        out = op.outputs["Out"][0]
+        add = _sole_consumer(consumers, protected, out)
+        if add is None or add.type != "elementwise_add":
+            continue
+        if add.inputs["X"][0] != out:  # bias must be the Y operand
+            continue
+        # bias must align on the LAST axis (mul out rank is k+1): the fc
+        # op adds it per-column
+        if add.attrs.get("axis", -1) not in (-1, k):
+            continue
+        bias_name = add.inputs["Y"][0]
+        bias_var = block._find_var_recursive(bias_name)
+        if bias_var is None or not bias_var.shape or len(bias_var.shape) != 1:
+            continue
+        add_out = add.outputs["Out"][0]
+        act_op = _sole_consumer(consumers, protected, add_out)
+        act = ""
+        final_out = add_out
+        tail = [op, add]
+        if (
+            act_op is not None
+            and act_op.type in fusable_act
+            and fusable_act[act_op.type](act_op.attrs)
+        ):
+            act = act_op.type
+            final_out = act_op.outputs["Out"][0]
+            tail.append(act_op)
+        rewrites[id(op)] = Operator(
+            block, "fc",
+            {
+                "Input": list(op.inputs["X"]),
+                "W": list(op.inputs["Y"]),
+                "Bias": [bias_name],
+            },
+            {"Out": [final_out]},
+            {
+                "in_num_col_dims": op.attrs.get("x_num_col_dims", 1),
+                "activation_type": act,
+            },
+        )
+        drop.update(id(o) for o in tail)
+    if not rewrites:
+        ctx.stats["fc_fuse"] = {"fused": 0}
+        return program
+    new_ops = []
+    for op in block.ops:
+        if id(op) in rewrites:
+            new_ops.append(rewrites[id(op)])
+        elif id(op) not in drop:
+            new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    ctx.stats["fc_fuse"] = {"fused": len(rewrites)}
+    return program
+
+
+@register_pass("conv_bn_fuse")
+def _conv_bn_fuse_pass(program, ctx):
+    """Fold inference-mode batch_norm into the preceding conv's weights
+    (reference: paddle/fluid/framework/ir/conv_bn_fuse_pass.cc:1):
+    W' = W * gamma / sqrt(var + eps) per out-channel, and the BN becomes a
+    per-channel bias add. Free accuracy-preserving speed: the BN's separate
+    scale/shift (and its stats reads) disappear from the executable.
+    Requires ctx.scope (weight values are rewritten in place)."""
+    import numpy as np
+
+    from paddle_tpu.core.ir import Operator
+
+    if ctx.scope is None:
+        ctx.stats["conv_bn_fuse"] = {"fused": 0, "skipped": "no scope"}
+        return program
+    block = program.global_block()
+    producers, consumers, protected = _build_use_maps(
+        block, ctx.fetch_names
+    )
+    drop = set()
+    replacements = {}  # id(bn op) -> new bias-add Operator
+    fused = 0
+    for op in block.ops:
+        if op.type not in ("conv2d", "depthwise_conv2d") or id(op) in drop:
+            continue
+        if op.attrs.get("data_format", "NCHW") not in ("NCHW", "AnyLayout"):
+            continue
+        conv_out = op.outputs["Output"][0]
+        nxt = _sole_consumer(consumers, protected, conv_out)
+        bias_add = None
+        bn = nxt
+        if nxt is not None and nxt.type == "elementwise_add":
+            y = block._find_var_recursive(nxt.inputs["Y"][0])
+            if y is None or not y.persistable:
+                continue
+            bias_add = nxt
+            bn = _sole_consumer(consumers, protected, nxt.outputs["Out"][0])
+        if bn is None or bn.type != "batch_norm":
+            continue
+        if not bn.attrs.get("is_test"):
+            continue
+        if bn.attrs.get("data_layout", "NCHW") != "NCHW":
+            continue
+        # BN side outputs must be dead (stats don't update in test mode,
+        # but a reader of SavedMean etc. would lose its producer). MeanOut/
+        # VarianceOut alias the bn's own Mean/Variance inputs — the bn
+        # itself reading them is not an external consumer.
+        side = [
+            n
+            for slot in ("MeanOut", "VarianceOut", "SavedMean",
+                         "SavedVariance")
+            for n in bn.outputs.get(slot, ())
+            if any(c is not bn for c in consumers.get(n, ()))
+        ]
+        if side:
+            continue
+        w_name = op.inputs["Filter"][0]
+        if len(consumers.get(w_name, [])) != 1:
+            continue  # shared filter: folding would corrupt the other use
+        names = {
+            "scale": bn.inputs["Scale"][0],
+            "shift": bn.inputs["Bias"][0],
+            "mean": bn.inputs["Mean"][0],
+            "var": bn.inputs["Variance"][0],
+        }
+        if not all(ctx.scope.has_var(n) for n in names.values()) or \
+                not ctx.scope.has_var(w_name):
+            continue
+        gamma = np.asarray(ctx.scope.find_var(names["scale"]), np.float64)
+        beta = np.asarray(ctx.scope.find_var(names["shift"]), np.float64)
+        mean = np.asarray(ctx.scope.find_var(names["mean"]), np.float64)
+        var = np.asarray(ctx.scope.find_var(names["var"]), np.float64)
+        w = np.asarray(ctx.scope.find_var(w_name))
+        eps = bn.attrs.get("epsilon", 1e-5)
+        factor = gamma / np.sqrt(var + eps)  # [Cout]
+        new_w = (w.astype(np.float64)
+                 * factor[:, None, None, None]).astype(w.dtype)
+        if bias_add is not None:
+            # only a per-channel bias (size Cout, broadcast on axis 1) can
+            # fold into the BN shift
+            if bias_add.attrs.get("axis", -1) != 1:
+                continue
+            b_name = bias_add.inputs["Y"][0]
+            b = np.asarray(ctx.scope.find_var(b_name), np.float64) \
+                if ctx.scope.has_var(b_name) else None
+            if b is None or b.size != mean.size:
+                continue
+        else:
+            b = np.zeros_like(mean)
+        new_b = (beta + (b.reshape(-1) - mean) * factor).astype(w.dtype)
+        # materialize the folded bias under a fresh persistable var
+        bn_out = bn.outputs["Y"][0]
+        fb_name = f"{w_name}__bn_folded_bias"
+        block.create_var(
+            name=fb_name, shape=[int(new_b.shape[0])],
+            dtype=str(new_b.dtype), persistable=True,
+        )
+        ctx.scope.set(fb_name, new_b)
+        ctx.scope.set(w_name, new_w)
+        replacements[id(bn)] = Operator(
+            block, "elementwise_add",
+            {"X": [conv_out], "Y": [fb_name]},
+            {"Out": [bn_out]},
+            {"axis": 1},
+        )
+        if bias_add is not None:
+            drop.add(id(bias_add))
+        fused += 1
+    if not fused:
+        ctx.stats["conv_bn_fuse"] = {"fused": 0}
+        return program
+    new_ops = []
+    for op in block.ops:
+        if id(op) in replacements:
+            new_ops.append(replacements[id(op)])
+        elif id(op) not in drop:
+            new_ops.append(op)
+    block.ops = new_ops
+    program._bump_version()
+    ctx.stats["conv_bn_fuse"] = {"fused": fused}
+    return program
+
+
+@register_pass("multihead_matmul_fuse")
+def _multihead_fuse_pass(program, ctx):
+    """Collapse the unfused attention core — matmul(qk^T, alpha)
+    [+ additive bias] -> softmax [-> test-mode dropout] -> matmul(pv) —
+    into one scaled_dot_product_attention op, which the Pallas flash
+    kernel serves (reference: paddle/fluid/framework/ir/
+    multihead_matmul_fuse_pass.cc:1; their target is the CUDA fused op,
+    ours is the flash lowering). Ported inference programs get the fused
+    kernel without model changes."""
+    from paddle_tpu.core.ir import Operator
+
+    block = program.global_block()
+    producers, consumers, protected = _build_use_maps(
+        block, ctx.fetch_names
+    )
+    drop = set()
+    rewrites = {}  # id(qk matmul) -> list of replacement Operators
+    fused = 0
+    for sm in block.ops:
+        if sm.type != "softmax" or id(sm) in drop:
+            continue
+        if sm.attrs.get("axis", -1) not in (-1, 3):
+            continue
+        sm_in = sm.inputs["X"][0]
+        prod = producers.get(sm_in, [])
+        if len(prod) != 1:
+            continue
+        add = None
+        qk = prod[0]
+        if qk.type == "elementwise_add":
+            add = qk
+            p2 = producers.get(add.inputs["X"][0], [])
+            if len(p2) != 1:
+                continue
+            qk = p2[0]
+            if _sole_consumer(consumers, protected, qk.outputs["Out"][0],
+                              add) is None:
+                continue
+        if qk.type != "matmul" or not qk.attrs.get("transpose_Y"):
+            continue
+        if qk.attrs.get("transpose_X"):
+            continue
+        if _sole_consumer(
+            consumers, protected,
+            (add or qk).outputs["Out"][0], sm,
+        ) is None:
+            continue
+        q_name = qk.inputs["X"][0]
+        k_name = qk.inputs["Y"][0]
+        qv = block._find_var_recursive(q_name)
+        if qv is None or qv.shape is None or len(qv.shape) != 4:
+            continue  # [B, H, S, D] attention only
+        # downstream: softmax -> (dropout) -> matmul(p, v)
+        pv = _sole_consumer(consumers, protected, sm.outputs["Out"][0])
+        dropout = None
+        if pv is not None and pv.type == "dropout":
+            impl = pv.attrs.get(
+                "dropout_implementation", "downgrade_in_infer"
+            )
+            identity = pv.attrs.get("is_test") and (
+                impl == "upscale_in_train"
+                or not pv.attrs.get("dropout_prob", 0.0)
+            )
+            if not identity:
+                continue
+            dropout = pv
+            pv = _sole_consumer(
+                consumers, protected, dropout.outputs["Out"][0]
+            )
+        if (
+            pv is None
+            or pv.type != "matmul"
+            or pv.attrs.get("transpose_X")
+            or pv.attrs.get("transpose_Y")
+            or pv.attrs.get("alpha", 1.0) != 1.0
+        ):
+            continue
+        probs_name = (dropout or sm).outputs["Out"][0]
+        if pv.inputs["X"][0] != probs_name:
+            continue
+        v_name = pv.inputs["Y"][0]
+        new_ops = []
+        sdpa_ins = {"Q": [q_name], "K": [k_name], "V": [v_name]}
+        if add is not None:
+            bias_name = add.inputs["Y"][0]
+            bv = block._find_var_recursive(bias_name)
+            if bv is None or bv.shape is None:
+                continue
+            bshape = list(bv.shape)
+            # ONLY the [B,1,1,S] key-bias form is sdpa's Bias semantic; a
+            # raw 2-D add would have broadcast as trailing [S_q, S_k]
+            # (relative-position bias) — different math, skip the fusion
+            if len(bshape) == 4 and bshape[1] == 1 and bshape[2] == 1:
+                # [B,1,1,S]: reuse the pre-reshape [B,S] source if there is
+                # one, else flatten here
+                bprod = producers.get(bias_name, [])
+                src = None
+                if len(bprod) == 1 and bprod[0].type in ("reshape2",
+                                                         "reshape"):
+                    cand = bprod[0].inputs["X"][0]
+                    cv = block._find_var_recursive(cand)
+                    if cv is not None and cv.shape is not None \
+                            and len(cv.shape) == 2:
+                        src = cand
+                if src is None:
+                    flat = f"{bias_name}__sdpa_flat"
+                    block.create_var(
+                        name=flat, shape=[bshape[0], bshape[3]],
+                        dtype=bv.dtype,
+                    )
+                    new_ops.append(Operator(
+                        block, "reshape",
+                        {"X": [bias_name]}, {"Out": [flat]},
+                        {"shape": [0, int(bshape[3])]
+                         if bshape[3] and bshape[3] > 0 else [0, -1]},
+                    ))
+                    src = flat
+                sdpa_ins["Bias"] = [src]
+            else:
+                continue
+        new_ops.append(Operator(
+            block, "scaled_dot_product_attention",
+            sdpa_ins,
+            {"Out": [pv.outputs["Out"][0]]},
+            {"sm_scale": qk.attrs.get("alpha", 1.0) or 1.0},
+        ))
+        # insert at the PV matmul's position — the LAST op of the matched
+        # pattern dominates every pattern input (V's producer may sit
+        # between the QK matmul and the PV matmul in program order)
+        rewrites[id(pv)] = new_ops
+        drop.update(
+            id(o) for o in (qk, add, sm, dropout) if o is not None
+        )
+        fused += 1
+    if not fused:
+        ctx.stats["multihead_matmul_fuse"] = {"fused": 0}
+        return program
+    out_ops = []
+    for op in block.ops:
+        if id(op) in rewrites:
+            out_ops.extend(rewrites[id(op)])
+        elif id(op) not in drop:
+            out_ops.append(op)
+    block.ops = out_ops
+    program._bump_version()
+    ctx.stats["multihead_matmul_fuse"] = {"fused": fused}
+    return program
