@@ -144,9 +144,13 @@ class CompiledProgram:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
-        from paddle_tpu.passes import apply_deferred_sparse_rewrite
+        from paddle_tpu.passes import (
+            apply_deferred_sparse_rewrite,
+            resolve_tensor_array_indices,
+        )
 
         apply_deferred_sparse_rewrite(self._program)
+        resolve_tensor_array_indices(self._program)
         block = self._program.global_block()
         mesh = self._mesh
         n_dev = int(np.prod(mesh.devices.shape))

@@ -79,6 +79,7 @@ def live_ops(block, fetch_names):
         )
         stateful_side_effect = op.type in (
             "print", "py_func", "distributed_push_sparse",
+            "push_box_sparse", "save", "save_combine",
         )
         if writes_persistable or stateful_side_effect or (writes & needed):
             keep[i] = True
@@ -508,9 +509,13 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _run_compiled(self, program, feed_arrays, fetch_names, scope, return_numpy):
-        from paddle_tpu.passes import apply_deferred_sparse_rewrite
+        from paddle_tpu.passes import (
+            apply_deferred_sparse_rewrite,
+            resolve_tensor_array_indices,
+        )
 
         apply_deferred_sparse_rewrite(program)
+        resolve_tensor_array_indices(program)
         block = program.global_block()
         feed_names = sorted(feed_arrays)
         feed_sig = tuple(
@@ -587,6 +592,9 @@ class Executor:
     def _run_interpreted(self, program, feed_arrays, fetch_names, scope, return_numpy):
         """Per-op debug path with NaN/Inf checking
         (reference: paddle/fluid/framework/details/nan_inf_utils_detail.cc)."""
+        from paddle_tpu.passes import resolve_tensor_array_indices
+
+        resolve_tensor_array_indices(program)
         block = program.global_block()
         env = dict(feed_arrays)
         for name in block.vars:

@@ -8,6 +8,7 @@ sub-block execution.
 
 from paddle_tpu.core.ir import default_main_program
 from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.utils import unique_name
 
 __all__ = ["While", "cond", "array_write", "array_read"]
 
@@ -105,12 +106,35 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
 
 
 def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "LoDTensorArray is subsumed by dense stacking on TPU; use layers.stack"
-    )
+    """TensorArray write (reference: python/paddle/fluid/layers/
+    control_flow.py array_write -> write_to_array op). Dense-semantics
+    form: indices must be program constants (a fill_constant that nothing
+    else writes — resolved at first run, passes.resolve_tensor_array_
+    indices); a data-dependent index raises with guidance (ops/tail.py) —
+    prefer layers.stack for new code."""
+    helper = LayerHelper("array_write")
+    out = array
+    if out is None:
+        out = helper.block.create_var(
+            name=unique_name.generate("tensor_array"), shape=None,
+            dtype=x.dtype,
+        )
+    ins = {"X": [x.name], "I": [i.name]}
+    if array is not None:
+        ins["Array"] = [array.name]
+    helper.append_op("write_to_array", ins, {"Out": [out.name]}, {})
+    return out
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "LoDTensorArray is subsumed by dense stacking on TPU; use layers.gather"
+    """TensorArray read (reference: array_read -> read_from_array op);
+    same program-constant index contract as array_write."""
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype or "float32")
+    helper.append_op(
+        "read_from_array",
+        {"X": [array.name], "I": [i.name]},
+        {"Out": [out.name]},
+        {},
     )
+    return out

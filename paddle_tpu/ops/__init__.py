@@ -27,3 +27,4 @@ from paddle_tpu.ops import fused  # noqa: F401
 from paddle_tpu.ops import yolo_loss  # noqa: F401
 from paddle_tpu.ops import extras  # noqa: F401
 from paddle_tpu.ops import crf  # noqa: F401
+from paddle_tpu.ops import tail  # noqa: F401

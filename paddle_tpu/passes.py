@@ -655,6 +655,12 @@ def _multihead_fuse_pass(program, ctx):
             )
             if not identity:
                 continue
+            # dropping the op must not orphan a live Mask reader
+            if any(
+                consumers.get(n)
+                for n in pv.outputs.get("Mask", ())
+            ) or any(n in protected for n in pv.outputs.get("Mask", ())):
+                continue
             dropout = pv
             pv = _sole_consumer(
                 consumers, protected, dropout.outputs["Out"][0]
@@ -737,3 +743,35 @@ def _multihead_fuse_pass(program, ctx):
     program._bump_version()
     ctx.stats["multihead_matmul_fuse"] = {"fused": fused}
     return program
+
+
+def resolve_tensor_array_indices(program):
+    """Execution-time fixup: fold each TensorArray op's index into a
+    `static_index` attr when the index var's SOLE writer in the whole
+    program is one fill_constant. Runs when the program is COMPLETE (a
+    build-time fold would miss later writers — e.g. a While body
+    incrementing the index AFTER the array op was appended, which must
+    stay dynamic and hit the loud error in ops/tail.py)."""
+    marker = getattr(program, "_tarray_resolved_version", None)
+    if marker == program._version:
+        return
+    targets = [
+        op
+        for b in program.blocks
+        for op in b.ops
+        if op.type in ("write_to_array", "read_from_array")
+    ]
+    if targets:
+        writers = {}
+        for b in program.blocks:
+            for op in b.ops:
+                for n in op.output_names():
+                    writers.setdefault(n, []).append(op)
+        for op in targets:
+            iname = op.inputs["I"][0]
+            w = writers.get(iname, [])
+            if len(w) == 1 and w[0].type == "fill_constant":
+                op.attrs["static_index"] = int(w[0].attrs.get("value", 0))
+            else:
+                op.attrs.pop("static_index", None)
+    program._tarray_resolved_version = program._version
