@@ -117,6 +117,11 @@ def _interpret_block(block, env, rng_key, use_pallas=True, ops=None):
         if op_def.needs_block:
             attrs = dict(attrs)
             attrs["_ctx_block"] = block
+        if op_def.needs_out_counts:
+            attrs = dict(attrs)
+            attrs["__out_counts__"] = {
+                s: len(ns) for s, ns in op.outputs.items()
+            }
         try:
             outs = op_def.lowering(use_pallas)(ins, attrs)
         except EnforceError:
@@ -626,6 +631,11 @@ class Executor:
             if op_def.needs_block:
                 op_attrs = dict(op_attrs)
                 op_attrs["_ctx_block"] = block
+            if op_def.needs_out_counts:
+                op_attrs = dict(op_attrs)
+                op_attrs["__out_counts__"] = {
+                    s: len(ns) for s, ns in op.outputs.items()
+                }
             if flags.benchmark:
                 # per-op timing: block on the op's outputs so device time is
                 # attributed to the op (reference: FLAGS_benchmark serializes

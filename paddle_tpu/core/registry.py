@@ -38,6 +38,7 @@ class OpDef:
         stateful=False,
         needs_base_rng=False,
         needs_block=False,
+        needs_out_counts=False,
     ):
         self.type = type
         self.lower = lower
@@ -56,6 +57,9 @@ class OpDef:
         # the sub_block attr is an index that only resolves against the
         # program actually being run (survives Program.clone)
         self.needs_block = needs_block
+        # ops with variable output arity (select_output) get
+        # attrs['__out_counts__'] = {slot: len(names)} injected at execution
+        self.needs_out_counts = needs_out_counts
 
     def lowering(self, use_pallas=True):
         if use_pallas and self.pallas is not None:
@@ -88,7 +92,7 @@ class OpRegistry:
         return sorted(cls._ops)
 
 
-def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False, needs_block=False):
+def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False, needs_block=False, needs_out_counts=False):
     """Decorator form:  @register_op("relu")  def _(ins, attrs): ..."""
 
     def deco(fn):
@@ -103,6 +107,7 @@ def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(
                 stateful=stateful,
                 needs_base_rng=needs_base_rng,
                 needs_block=needs_block,
+                needs_out_counts=needs_out_counts,
             )
         )
         return fn

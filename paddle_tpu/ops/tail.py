@@ -128,7 +128,9 @@ _lod_refusal("merge_lod_tensor")
 @register_op("select_input", nondiff_inputs=("Mask",))
 def _select_input(ins, attrs):
     """reference: controlflow/select_input_output_op.cc — Out = X[mask].
-    All branch tensors must share shape/dtype (static-shape contract)."""
+    All branch tensors must share shape/dtype (static-shape contract). A
+    concrete out-of-range mask raises; a traced one clamps to the last
+    branch (a data-dependent branch index cannot be validated in-graph)."""
     xs, mask = ins["X"], first(ins, "Mask")
     shapes = {tuple(x.shape) for x in xs}
     enforce(
@@ -136,18 +138,27 @@ def _select_input(ins, attrs):
         f"select_input: branch shapes differ {sorted(shapes)} — a traced "
         "select needs identical shapes (pad or restructure)",
     )
+    if not isinstance(mask, jax.core.Tracer):
+        m = int(np.asarray(mask).reshape(-1)[0])
+        enforce(
+            0 <= m < len(xs),
+            f"select_input: mask {m} out of range for {len(xs)} branches",
+        )
     idx = jnp.clip(mask.reshape(()).astype(jnp.int32), 0, len(xs) - 1)
     return {"Out": [jnp.stack(list(xs))[idx]]}
 
 
-@register_op("select_output", nondiff_inputs=("Mask",))
+@register_op("select_output", nondiff_inputs=("Mask",),
+             needs_out_counts=True)
 def _select_output(ins, attrs):
     """Out[i] = X when i == mask else zeros — the dense form of routing
     one value to the mask-th branch (consumers pair it with select_input
-    on the same mask)."""
+    on the same mask). Output arity comes from the op desc
+    (__out_counts__, injected by the executor)."""
     x, mask = first(ins, "X"), first(ins, "Mask")
     idx = mask.reshape(()).astype(jnp.int32)
-    n_out = int(attrs.get("n_out", 2))
+    counts = attrs.get("__out_counts__") or {}
+    n_out = int(counts.get("Out", attrs.get("n_out", 2)))
     outs = [jnp.where(idx == i, x, jnp.zeros_like(x)) for i in range(n_out)]
     return {"Out": outs}
 
@@ -345,10 +356,17 @@ def _save_combine(ins, attrs):
 
 
 def _host_read(path):
+    import re
+
     from paddle_tpu.io import _read_combined
 
     d = _read_combined(path)
-    return [d[k] for k in sorted(d, key=lambda s: int(s[1:]))]
+    if all(re.fullmatch(r"x\d+", k) for k in d):
+        # written by the save/save_combine ops: ordinal order
+        return [d[k] for k in sorted(d, key=lambda s: int(s[1:]))]
+    # any other combined container (e.g. io.save_params output): values in
+    # sorted-name order — deterministic, documented
+    return [d[k] for k in sorted(d)]
 
 
 @register_op("load")

@@ -66,6 +66,15 @@ def test_select_input_output(rng):
     outs = lower("select_output", {"X": a, "Mask": m1}, {"n_out": 2})["Out"]
     assert np.all(np.asarray(outs[0]) == 0)
     np.testing.assert_array_equal(np.asarray(outs[1]), a)
+    # output arity follows the op desc (__out_counts__ injected by the
+    # executor), not the default attr
+    outs3 = lower("select_output", {"X": a, "Mask": m1},
+                  {"__out_counts__": {"Out": 3}})["Out"]
+    assert len(outs3) == 3
+    with pytest.raises(EnforceError, match="range"):
+        lower("select_input",
+              {"X": [jnp.asarray(a), jnp.asarray(b)],
+               "Mask": np.array([7], np.int32)})
     with pytest.raises(EnforceError, match="shapes"):
         lower("select_input",
               {"X": [jnp.asarray(a), jnp.zeros((4,), jnp.float32)],
@@ -146,6 +155,20 @@ def test_save_load_ops(tmp_path, rng):
           {"file_path": cpath})
     outs = lower("load_combine", {}, {"file_path": cpath})["Out"]
     np.testing.assert_array_equal(np.asarray(outs[0]), a)
+    np.testing.assert_array_equal(np.asarray(outs[1]), b)
+
+
+def test_load_combine_name_keyed_container(tmp_path, rng):
+    """load_combine of a container written by io.save_params-style code
+    (real var-name keys) loads in sorted-name order instead of crashing."""
+    from paddle_tpu.io import _write_combined
+
+    path = str(tmp_path / "named.tensor")
+    a = rng.randn(2).astype("float32")
+    b = rng.randn(3).astype("float32")
+    _write_combined(path, {"fc_0.w_0": b, "emb.w": a})
+    outs = lower("load_combine", {}, {"file_path": path})["Out"]
+    np.testing.assert_array_equal(np.asarray(outs[0]), a)  # 'emb.w' first
     np.testing.assert_array_equal(np.asarray(outs[1]), b)
 
 

@@ -34,6 +34,8 @@ v = np.asarray(x@x); print('ok', float(v[0,0]))
     timeout 2400 python tools/profile_bench.py >> "$log" 2>&1
     echo "--- bench_sparse_embedding (sgd_sparse vs dense at vocab 100k)" >> "$log"
     timeout 900 python tools/bench_sparse_embedding.py >> "$log" 2>&1
+    echo "--- bench_transformer_infer (big cfg bucketed beam, 37k vocab)" >> "$log"
+    timeout 1800 python tools/bench_transformer_infer.py >> "$log" 2>&1
     echo "=== CAPTURE COMPLETE $(date +%H:%M:%S)" >> "$log"
     exit 0
   fi
