@@ -1,0 +1,61 @@
+"""Pallas row-scatter for the sgd_sparse SelectedRows-analog update.
+
+reference: paddle/fluid/operators/optimizers/sgd_op.h (sparse branch) —
+the reference walks SelectedRows and subtracts each row in place. The XLA
+form (`param.at[ids].add(-lr * rows)`) compiles to a scatter-add, which the
+TPU serializes conservatively. This kernel exploits what the scatter cannot
+assume: after the duplicate-merge (segment-sum over unique ids, done in XLA
+before the call), every destination row is touched ONCE, so the update is a
+sequential grid over unique ids with scalar-prefetch block indexing — each
+step streams one [1, D] row through VMEM and writes param[ids[i]] back,
+one read + one write per touched row, no serialization analysis.
+
+Gated by FLAGS_pallas_sparse_update (off until on-chip numbers arbitrate);
+interpret-mode parity vs the XLA scatter in tests/test_pallas_kernels.py.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["sparse_row_update"]
+
+
+def _row_update_kernel(ids_ref, rows_ref, param_ref, out_ref):
+    # param is also an input mapped to the same row, so the read is
+    # well-defined; the aliased output buffer keeps untouched rows
+    out_ref[...] = param_ref[...] + rows_ref[...]
+
+
+def sparse_row_update(param, uniq_ids, merged_rows, interpret=None):
+    """param[uniq_ids[i]] += merged_rows[i] with all ids DISTINCT.
+    uniq_ids [N] int32, merged_rows [N, D]. Returns the updated param."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    vma = getattr(jax.typeof(param), "vma", None) or frozenset()
+    if pltpu is None or (interpret and vma):
+        return param.at[uniq_ids].add(merged_rows.astype(param.dtype))
+    n, d = merged_rows.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, ids: (i, 0)),
+            pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),
+    )
+    from paddle_tpu.ops.pallas.flash_attention import _sds
+
+    return pl.pallas_call(
+        _row_update_kernel,
+        grid_spec=grid_spec,
+        out_shape=_sds(param.shape, param.dtype, param, merged_rows),
+        input_output_aliases={2: 0},  # param (flat operand 2) -> output
+        interpret=interpret,
+    )(uniq_ids.astype(jnp.int32), merged_rows.astype(param.dtype), param)

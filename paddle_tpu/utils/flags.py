@@ -93,3 +93,15 @@ define_flag(
     "fuse lookup_table_grad + sgd into a row-sparse update (SelectedRows "
     "analog): the [V, D] dense embedding gradient never materializes",
 )
+define_flag(
+    "pallas_sparse_update", False,
+    "serve sgd_sparse row-scatter through the Pallas kernel "
+    "(ops/pallas/sparse_update.py) instead of the XLA scatter; "
+    "interpret-tested, flag-gated until on-chip numbers arbitrate",
+)
+define_flag(
+    "pallas_dgc_topk", False,
+    "use the blocked Pallas top-k (ops/pallas/topk.py) for DGC gradient "
+    "compaction instead of lax.top_k; interpret-tested, flag-gated until "
+    "on-chip numbers arbitrate",
+)

@@ -47,9 +47,4 @@ def rng():
     return np.random.RandomState(1234)
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers",
-        "slow: multi-process / multi-hundred-ms-compile tests; deselect "
-        "with -m 'not slow' for a fast smoke run",
-    )
+# the `slow` marker is registered in pytest.ini (single source of truth)

@@ -1,0 +1,132 @@
+"""Interpret-mode validation of the flag-gated Pallas kernels
+(VERDICT r4 item 9): blocked DGC top-k and the sgd_sparse row-scatter —
+exactness vs the XLA forms they replace, plus the flag wiring end to end."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.utils.flags import flags
+
+
+def test_blocked_topk_matches_lax(rng):
+    from paddle_tpu.ops.pallas.topk import blocked_topk_abs
+
+    x = jnp.asarray(rng.randn(1000).astype("float32"))
+    k = 16
+    vals, idx = blocked_topk_abs(x, k, block=128, interpret=True)
+    ref_v, ref_i = jax.lax.top_k(jnp.abs(x), k)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v),
+                               rtol=1e-6)
+    # same elements selected (tie order may differ)
+    assert set(np.asarray(idx).tolist()) == set(np.asarray(ref_i).tolist())
+    # selected values really are |x| at the reported indices
+    np.testing.assert_allclose(
+        np.abs(np.asarray(x))[np.asarray(idx)], np.asarray(vals), rtol=1e-6
+    )
+
+
+def test_blocked_topk_nondivisible_and_small(rng):
+    from paddle_tpu.ops.pallas.topk import blocked_topk_abs
+
+    for n, k, blk in ((1000, 8, 300), (50, 5, 16), (40, 30, 8)):
+        x = jnp.asarray(rng.randn(n).astype("float32"))
+        vals, idx = blocked_topk_abs(x, k, block=blk, interpret=True)
+        ref_v, _ = jax.lax.top_k(jnp.abs(x), k)
+        np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v),
+                                   rtol=1e-6, err_msg=f"{n},{k},{blk}")
+
+
+def test_sparse_row_update_matches_scatter(rng):
+    from paddle_tpu.ops.pallas.sparse_update import sparse_row_update
+
+    V, D, N = 50, 8, 6
+    p = jnp.asarray(rng.randn(V, D).astype("float32"))
+    ids = jnp.asarray(
+        rng.choice(V, N, replace=False).astype("int32")
+    )
+    rows = jnp.asarray(rng.randn(N, D).astype("float32"))
+    out = sparse_row_update(p, ids, rows, interpret=True)
+    ref = p.at[ids].add(rows)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+    # untouched rows unchanged
+    untouched = np.setdiff1d(np.arange(V), np.asarray(ids))
+    np.testing.assert_array_equal(
+        np.asarray(out)[untouched], np.asarray(p)[untouched]
+    )
+
+
+def test_sgd_sparse_flag_parity(rng):
+    """The sgd_sparse op under FLAGS_pallas_sparse_update must reproduce
+    the XLA scatter exactly — duplicate ids and padding_idx included."""
+    from paddle_tpu.core.registry import OpRegistry
+
+    V, D = 30, 4
+    p = jnp.asarray(rng.randn(V, D).astype("float32"))
+    ids = jnp.asarray(np.array([3, 7, 3, 0, 29, 7, 7], np.int32))
+    rows = jnp.asarray(rng.randn(7, D).astype("float32"))
+    lr = jnp.asarray(np.array([0.5], np.float32))
+    ins = {"Param": [p], "Ids": [ids], "RowGrad": [rows],
+           "LearningRate": [lr]}
+    attrs = {"padding_idx": 0}
+    lowering = OpRegistry.get("sgd_sparse").lowering()
+    ref = lowering(dict(ins), dict(attrs))["ParamOut"][0]
+    flags.pallas_sparse_update = True
+    try:
+        got = lowering(dict(ins), dict(attrs))["ParamOut"][0]
+    finally:
+        flags.pallas_sparse_update = False
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_dgc_topk_flag_end_to_end(rng):
+    """DGC data-parallel training with FLAGS_pallas_dgc_topk on matches
+    the flag-off run step for step. On this CPU rig the flag exercises the
+    WIRING and the documented fallback (inside shard_map off-TPU,
+    blocked_topk_abs degrades to lax.top_k) — the blocked kernel itself is
+    validated directly by the interpret-mode unit tests above; on a real
+    chip the same flag engages the kernel."""
+    assert jax.device_count() >= 8
+
+    def run():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[-1, 16], dtype="float32")
+            y = fluid.data("y", shape=[-1, 1], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y)
+            )
+            opt = fluid.optimizer.DGCMomentumOptimizer(
+                learning_rate=0.05, momentum=0.9, rampup_begin_step=0,
+                sparsity=[0.8],
+            )
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name
+            )
+            r = np.random.RandomState(0)
+            feed = {
+                "x": r.randn(16, 16).astype("float32"),
+                "y": r.randn(16, 1).astype("float32"),
+            }
+            for _ in range(4):
+                out = exe.run(prog, feed=feed, fetch_list=[loss.name])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        return losses
+
+    ref = run()
+    flags.pallas_dgc_topk = True
+    try:
+        got = run()
+    finally:
+        flags.pallas_dgc_topk = False
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
