@@ -50,21 +50,47 @@ def _to_global(arr, sharding):
 
 class BuildStrategy:
     """Accepted for API parity (reference: paddle/fluid/framework/details/
-    build_strategy.h:37). Fusion/memory-opt toggles are XLA's job; the
-    meaningful knobs map to sharding choices."""
+    build_strategy.h:37). Fusion/memory-opt toggles are XLA's job here:
+    operator fusion happens in the XLA compiler, memory reuse comes from
+    buffer donation (core/executor.py), and all-reduce fusion from GSPMD's
+    collective combiner — flipping those fields changes NOTHING and says
+    so once (a silent no-op would let a tuning session chase a knob that
+    is not connected). The meaningful knobs map to sharding choices."""
+
+    #: parity-only fields: owned by XLA/GSPMD/donation on this backend
+    _XLA_OWNED = {
+        "fuse_all_reduce_ops": "GSPMD's all-reduce combiner",
+        "fuse_elewise_add_act_ops": "XLA fusion",
+        "memory_optimize": "XLA buffer assignment + donation",
+        "enable_inplace": "buffer donation (FLAGS_use_donation)",
+    }
+    _warned = set()
 
     class ReduceStrategy:
         AllReduce = 0
         Reduce = 1
 
     def __init__(self):
-        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
-        self.fuse_all_reduce_ops = True
-        self.fuse_elewise_add_act_ops = True
-        self.memory_optimize = True
-        self.enable_inplace = True
-        self.num_trainers = 1
-        self.trainer_id = 0
+        d = object.__setattr__
+        d(self, "reduce_strategy", BuildStrategy.ReduceStrategy.AllReduce)
+        d(self, "fuse_all_reduce_ops", True)
+        d(self, "fuse_elewise_add_act_ops", True)
+        d(self, "memory_optimize", True)
+        d(self, "enable_inplace", True)
+        d(self, "num_trainers", 1)
+        d(self, "trainer_id", 0)
+
+    def __setattr__(self, name, value):
+        owner = self._XLA_OWNED.get(name)
+        if owner is not None and name not in BuildStrategy._warned:
+            BuildStrategy._warned.add(name)
+            warnings.warn(
+                f"BuildStrategy.{name} is a no-op on this backend: "
+                f"{owner} owns that optimization (set once per process; "
+                "this message will not repeat)",
+                stacklevel=2,
+            )
+        object.__setattr__(self, name, value)
 
 
 class ExecutionStrategy:

@@ -1,0 +1,91 @@
+"""Toolchain-free Go-binding cross-check.
+
+The build image has no Go compiler and no network (recorded each round in
+ROUND*_NOTES), so `go build` can never run here. This checker provides the
+verification that IS possible: every `C.<symbol>` reference in go/ must
+resolve against csrc/capi/paddle_tpu_capi.h — functions, typedefs, and enum
+constants — so an ABI drift (renamed function, changed enum) fails the test
+suite instead of waiting for a Go toolchain to notice.
+
+Run: python tools/check_go_binding.py  (exit 0 = all symbols resolve)
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADER = os.path.join(REPO, "csrc", "capi", "paddle_tpu_capi.h")
+
+# cgo builtins that never come from the header
+_CGO_BUILTINS = {
+    "CString", "GoString", "GoStringN", "GoBytes", "CBytes", "free",
+    "malloc", "int", "uint", "char", "uchar", "short", "ushort", "long",
+    "ulong", "longlong", "ulonglong", "float", "double", "size_t",
+    "int32_t", "int64_t", "uint8_t", "bool",
+}
+
+
+def _strip_comments(src):
+    src = re.sub(r"/\*.*?\*/", " ", src, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", src)
+
+
+def header_symbols():
+    # comments stripped FIRST: a doc comment naming an old function must
+    # not keep a renamed symbol "declared"
+    src = _strip_comments(open(HEADER).read())
+    syms = set()
+    # plain / struct / enum typedefs, incl. pointer targets:
+    #   typedef struct PD_Foo PD_Foo;   typedef struct PD_Bar *PD_BarH;
+    syms.update(re.findall(
+        r"typedef\s+(?:struct\s+\w+|enum\s+\w+|\w+)\s*\*?\s*(\w+)\s*;", src
+    ))
+    # function-pointer typedefs: typedef void (*PD_Cb)(int);
+    syms.update(re.findall(r"typedef[^;{]*\(\s*\*\s*(\w+)\s*\)", src))
+    syms.update(re.findall(r"}\s*(\w+)\s*;", src))  # "} PD_Baz;"
+    syms.update(re.findall(r"typedef\s+struct\s+(\w+)", src))
+    # enum constants
+    for body in re.findall(r"enum[^{]*{([^}]*)}", src, re.S):
+        syms.update(re.findall(r"\b(PD_\w+)", body))
+    # function declarations
+    syms.update(re.findall(r"\b(PD_\w+)\s*\(", src))
+    return syms
+
+
+def go_references():
+    refs = {}
+    go_root = os.path.join(REPO, "go")
+    for root, _dirs, files in os.walk(go_root):
+        for fn in files:
+            if not fn.endswith(".go"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, go_root)
+            # cgo comments are directives, not prose: scan the whole file
+            for sym in re.findall(r"\bC\.(\w+)", open(path).read()):
+                refs.setdefault(sym, []).append(rel)
+    return refs
+
+
+def main():
+    syms = header_symbols()
+    refs = go_references()
+    missing = {
+        s: files
+        for s, files in sorted(refs.items())
+        if s not in syms and s not in _CGO_BUILTINS
+    }
+    total = len(refs)
+    if missing:
+        print(f"UNRESOLVED {len(missing)}/{total} C symbols:")
+        for s, files in missing.items():
+            print(f"  C.{s}  (used in {', '.join(sorted(set(files)))})")
+        return 1
+    print(f"OK: all {total} C.<symbol> references resolve against "
+          f"{os.path.relpath(HEADER, REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
