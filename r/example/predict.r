@@ -1,0 +1,42 @@
+#!/usr/bin/env Rscript
+# R inference through reticulate (reference: r/example/mobilenet.r — the
+# reference's R binding is exactly this pattern: import the Python API).
+# predict.py is the executable contract; this file tracks it line for line.
+
+library(reticulate)
+
+# point reticulate at a Python that can `import paddle_tpu`
+# use_python("/opt/venv/bin/python")
+
+np <- import("numpy")
+paddle_infer <- import("paddle_tpu.inference")
+
+model_dir <- "/tmp/r_demo_model"
+
+set_config <- function() {
+    config <- paddle_infer$Config(model_dir)
+    config$disable_gpu()
+    return(config)
+}
+
+run_predict <- function() {
+    config <- set_config()
+    predictor <- paddle_infer$create_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_handle <- predictor$get_input_handle(input_names[[1]])
+    data <- np$random$RandomState(0L)$randn(1L, 3L, 32L, 32L)
+    input_handle$copy_from_cpu(np$float32(data))
+
+    predictor$run()
+
+    output_names <- predictor$get_output_names()
+    output_handle <- predictor$get_output_handle(output_names[[1]])
+    output_data <- output_handle$copy_to_cpu()
+    print(dim(output_data))
+    print(sum(output_data))
+}
+
+if (!interactive()) {
+    run_predict()
+}

@@ -36,10 +36,11 @@ def header_symbols():
     # not keep a renamed symbol "declared"
     src = _strip_comments(open(HEADER).read())
     syms = set()
-    # plain / struct / enum typedefs, incl. pointer targets:
-    #   typedef struct PD_Foo PD_Foo;   typedef struct PD_Bar *PD_BarH;
+    # typedefs, incl. pointer targets and multi-word base types:
+    #   typedef struct PD_Foo PD_Foo;  typedef struct PD_Bar *PD_BarH;
+    #   typedef unsigned char PD_Bool;  typedef const char *PD_Str;
     syms.update(re.findall(
-        r"typedef\s+(?:struct\s+\w+|enum\s+\w+|\w+)\s*\*?\s*(\w+)\s*;", src
+        r"typedef\s+(?:[A-Za-z_]\w*\s+)+\*?\s*(\w+)\s*;", src
     ))
     # function-pointer typedefs: typedef void (*PD_Cb)(int);
     syms.update(re.findall(r"typedef[^;{]*\(\s*\*\s*(\w+)\s*\)", src))
@@ -71,6 +72,12 @@ def go_references():
 def main():
     syms = header_symbols()
     refs = go_references()
+    if not refs:
+        # zero references means the scan found nothing — a moved go/ dir
+        # must fail the gate, not silently disable it
+        print("ERROR: no C.<symbol> references found under go/ — "
+              "binding sources missing or moved?")
+        return 1
     missing = {
         s: files
         for s, files in sorted(refs.items())
