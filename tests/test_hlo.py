@@ -227,3 +227,99 @@ def test_tp_mesh_no_weight_sized_collectives():
         if len(shape) == 2 and shape in ((64, 128), (128, 64))
     ]
     assert not weightlike, f"weight-sized collective operands: {weightlike}"
+
+
+def test_flash_long_context_no_s2():
+    """Long-context story: at S=2048 (16x the bench S) the flash train
+    step still materializes nothing S^2-shaped — the memory property that
+    makes long sequences fit at all."""
+    S_long = 2048
+    cfg = bert.BertConfig(
+        vocab_size=1024, hidden_size=256, num_hidden_layers=1,
+        num_attention_heads=4, max_position_embeddings=S_long,
+        use_flash_attention=True, attention_probs_dropout_prob=0.0,
+    )
+    main, startup, feeds, fetches = bert.build_bert_pretrain(
+        cfg, seq_len=S_long, lr=1e-4, use_amp=True,
+        max_predictions_per_seq=64,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        data = bert.synthetic_batch(
+            np.random.RandomState(0), 2, S_long, cfg,
+            max_predictions_per_seq=64,
+        )
+        txt = hlo.lower_program_step(
+            main, data, [fetches[0]], scope=scope
+        ).as_text()
+    tensors = hlo.stablehlo_tensors(txt)
+    s2 = hlo.tensors_with_trailing(tensors, (S_long, S_long))
+    assert not s2, f"S^2 buffers at S={S_long}: {set(s2)}"
+
+
+@pytest.mark.slow
+def test_full_bert_base_12_layer_properties():
+    """The REAL flagship at full depth: 12-layer BERT-base lowers with
+    every per-layer property intact (the default-suite 2-layer tests
+    prove the per-layer math; this proves nothing depth-dependent breaks)."""
+    cfg = bert.BertConfig.base()
+    cfg.use_flash_attention = True
+    cfg.attention_probs_dropout_prob = 0.0
+    main, startup, feeds, fetches = bert.build_bert_pretrain(
+        cfg, seq_len=S, lr=1e-4, use_amp=True,
+        max_predictions_per_seq=P_PRED,
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        data = bert.synthetic_batch(
+            np.random.RandomState(0), 4, S, cfg,
+            max_predictions_per_seq=P_PRED,
+        )
+        txt = hlo.lower_program_step(
+            main, data, [fetches[0]], scope=scope
+        ).as_text()
+    tensors = hlo.stablehlo_tensors(txt)
+    assert not hlo.tensors_with_trailing(tensors, (S, S))
+    assert not hlo.tensors_containing_dims(tensors, (S, VOCAB))
+    dots = hlo.stablehlo_dots(txt)
+    bad = [d for d in dots if not (
+        d[0].endswith("bf16") and d[1].endswith("bf16")
+    )]
+    assert not bad, bad[:5]
+
+
+def test_resnet_dp_mesh_collectives():
+    """ResNet under a pure-DP mesh: gradient all-reduces present, no
+    all-to-all — the conv-net analog of the BERT dp check."""
+    from paddle_tpu.models import resnet
+    from paddle_tpu.parallel.env import make_mesh
+
+    assert jax.device_count() >= 8
+    main, startup, feeds, fetches = resnet.build_resnet_train(
+        depth=18, class_dim=10, lr=0.1
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        mesh = make_mesh(shape=(8,), axis_names=("data",))
+        prog = fluid.CompiledProgram(main).with_parallel(
+            mesh=mesh, loss_name=fetches[0].name
+        )
+        feed = {
+            "img": np.random.RandomState(0).randn(8, 3, 32, 32).astype(
+                "float32"
+            ),
+            "label": np.zeros((8, 1), "int64"),
+        }
+        lowered, _ = hlo.lower_parallel_step(
+            exe, prog, feed, [fetches[0]], scope
+        )
+        txt = lowered.compile().as_text()
+    c = hlo.count_collectives(txt)
+    assert c["all-reduce"] >= 1, c
+    assert c["all-to-all"] == 0, c

@@ -1,0 +1,119 @@
+"""Perf-evidence report: structural metrics of the flagship train steps.
+
+Prints one JSON object summarizing what tests/test_hlo.py asserts — S²
+buffer count on the flash path, dot-operand dtype census, transpose count,
+[S,V] logits check, conv dtype census, dp/tp collective counts — so a
+round's perf posture is inspectable without a chip (PROFILE.md links here).
+
+Usage: python tools/hlo_report.py   (~4 min on the CPU rig)
+"""
+
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import bert  # noqa: E402
+from paddle_tpu.utils import hlo  # noqa: E402
+
+S, VOCAB, P = 512, 30522, 77
+
+
+def bert_step_text(flash):
+    cfg = bert.BertConfig(
+        vocab_size=VOCAB, hidden_size=768, num_hidden_layers=2,
+        num_attention_heads=12, max_position_embeddings=S,
+        use_flash_attention=flash,
+        attention_probs_dropout_prob=0.0 if flash else 0.1,
+    )
+    main, startup, feeds, fetches = bert.build_bert_pretrain(
+        cfg, seq_len=S, lr=1e-4, use_amp=True, max_predictions_per_seq=P
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        data = bert.synthetic_batch(
+            np.random.RandomState(0), 4, S, cfg, max_predictions_per_seq=P
+        )
+        return hlo.lower_program_step(
+            main, data, [fetches[0]], scope=scope
+        ).as_text()
+
+
+def dot_census(txt):
+    dots = hlo.stablehlo_dots(txt)
+    c = Counter(
+        (d[0].rsplit("x", 1)[-1], d[1].rsplit("x", 1)[-1]) for d in dots
+    )
+    return {f"{a}*{b}": n for (a, b), n in sorted(c.items())}
+
+
+def main():
+    report = {}
+    flash = bert_step_text(flash=True)
+    tens = hlo.stablehlo_tensors(flash)
+    report["bert_flash"] = {
+        "s2_buffers": len(hlo.tensors_with_trailing(tens, (S, S))),
+        "s_by_vocab_tensors": len(
+            hlo.tensors_containing_dims(tens, (S, VOCAB))
+        ),
+        "dot_operand_dtypes": dot_census(flash),
+        "transposes": flash.count("stablehlo.transpose"),
+    }
+    unfused = bert_step_text(flash=False)
+    report["bert_unfused_control"] = {
+        "s2_buffers": len(
+            hlo.tensors_with_trailing(hlo.stablehlo_tensors(unfused), (S, S))
+        ),
+    }
+
+    from paddle_tpu.parallel.env import make_mesh
+    from paddle_tpu.parallel.sharding import MEGATRON_RULES
+
+    for name, shape, axes, rules in (
+        ("dp8", (8,), ("data",), None),
+        ("dp2_tp4", (2, 4), ("data", "model"), MEGATRON_RULES),
+    ):
+        cfg = bert.BertConfig.tiny()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_probs_dropout_prob = 0.0
+        main, startup, feeds, fetches = bert.build_bert_pretrain(
+            cfg, seq_len=16, lr=1e-3
+        )
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            mesh = make_mesh(shape=shape, axis_names=axes)
+            prog = fluid.CompiledProgram(main).with_parallel(
+                mesh=mesh, loss_name=fetches[0].name, param_rules=rules
+            )
+            data = bert.synthetic_batch(np.random.RandomState(0), 8, 16, cfg)
+            lowered, _ = hlo.lower_parallel_step(
+                exe, prog, data, [fetches[0]], scope
+            )
+            report[f"collectives_{name}"] = hlo.count_collectives(
+                lowered.compile().as_text()
+            )
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
