@@ -2,8 +2,10 @@
 
 Prints one JSON object summarizing what tests/test_hlo.py asserts — S²
 buffer count on the flash path, dot-operand dtype census, transpose count,
-[S,V] logits check, conv dtype census, dp/tp collective counts — so a
-round's perf posture is inspectable without a chip (PROFILE.md links here).
+[S,V] logits check, ResNet conv dtype census, dp/tp collective counts.
+The steps are lowered through the SAME shared builders the test gates use
+(paddle_tpu/utils/hlo.py), so the committed evidence cannot drift from the
+asserted computation. PROFILE.md links the committed snapshot.
 
 Usage: python tools/hlo_report.py   (~4 min on the CPU rig)
 """
@@ -26,35 +28,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np  # noqa: E402
-
-import paddle_tpu as fluid  # noqa: E402
-from paddle_tpu.models import bert  # noqa: E402
 from paddle_tpu.utils import hlo  # noqa: E402
 
 S, VOCAB, P = 512, 30522, 77
-
-
-def bert_step_text(flash):
-    cfg = bert.BertConfig(
-        vocab_size=VOCAB, hidden_size=768, num_hidden_layers=2,
-        num_attention_heads=12, max_position_embeddings=S,
-        use_flash_attention=flash,
-        attention_probs_dropout_prob=0.0 if flash else 0.1,
-    )
-    main, startup, feeds, fetches = bert.build_bert_pretrain(
-        cfg, seq_len=S, lr=1e-4, use_amp=True, max_predictions_per_seq=P
-    )
-    exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        data = bert.synthetic_batch(
-            np.random.RandomState(0), 4, S, cfg, max_predictions_per_seq=P
-        )
-        return hlo.lower_program_step(
-            main, data, [fetches[0]], scope=scope
-        ).as_text()
 
 
 def dot_census(txt):
@@ -66,8 +42,12 @@ def dot_census(txt):
 
 
 def main():
+    from paddle_tpu.parallel.sharding import MEGATRON_RULES
+
     report = {}
-    flash = bert_step_text(flash=True)
+    flash = hlo.bert_train_step_text(
+        True, seq_len=S, vocab=VOCAB, max_pred=P
+    )
     tens = hlo.stablehlo_tensors(flash)
     report["bert_flash"] = {
         "s2_buffers": len(hlo.tensors_with_trailing(tens, (S, S))),
@@ -77,41 +57,24 @@ def main():
         "dot_operand_dtypes": dot_census(flash),
         "transposes": flash.count("stablehlo.transpose"),
     }
-    unfused = bert_step_text(flash=False)
+    unfused = hlo.bert_train_step_text(
+        False, seq_len=S, vocab=VOCAB, max_pred=P
+    )
     report["bert_unfused_control"] = {
         "s2_buffers": len(
             hlo.tensors_with_trailing(hlo.stablehlo_tensors(unfused), (S, S))
         ),
     }
-
-    from paddle_tpu.parallel.env import make_mesh
-    from paddle_tpu.parallel.sharding import MEGATRON_RULES
-
-    for name, shape, axes, rules in (
-        ("dp8", (8,), ("data",), None),
-        ("dp2_tp4", (2, 4), ("data", "model"), MEGATRON_RULES),
-    ):
-        cfg = bert.BertConfig.tiny()
-        cfg.hidden_dropout_prob = 0.0
-        cfg.attention_probs_dropout_prob = 0.0
-        main, startup, feeds, fetches = bert.build_bert_pretrain(
-            cfg, seq_len=16, lr=1e-3
-        )
-        exe = fluid.Executor(fluid.CPUPlace())
-        scope = fluid.Scope()
-        with fluid.scope_guard(scope):
-            exe.run(startup)
-            mesh = make_mesh(shape=shape, axis_names=axes)
-            prog = fluid.CompiledProgram(main).with_parallel(
-                mesh=mesh, loss_name=fetches[0].name, param_rules=rules
-            )
-            data = bert.synthetic_batch(np.random.RandomState(0), 8, 16, cfg)
-            lowered, _ = hlo.lower_parallel_step(
-                exe, prog, data, [fetches[0]], scope
-            )
-            report[f"collectives_{name}"] = hlo.count_collectives(
-                lowered.compile().as_text()
-            )
+    report["resnet50_conv_dtypes"] = hlo.conv_dtype_census(
+        hlo.resnet_train_step_text(depth=50, use_amp=True)
+    )
+    report["collectives_dp8"] = hlo.count_collectives(
+        hlo.tiny_bert_parallel_text((8,), ("data",))
+    )
+    report["collectives_dp2_tp4"] = hlo.count_collectives(
+        hlo.tiny_bert_parallel_text((2, 4), ("data", "model"),
+                                    MEGATRON_RULES)
+    )
     print(json.dumps(report, indent=1))
 
 
