@@ -237,7 +237,7 @@ def prefetch_for_program(program, next_feed):
         ids = np.asarray(ids)
         if ids.ndim >= 2 and ids.shape[-1] == 1:
             ids = ids[..., 0]
-        ctx.prefetch(tname, ids, min_push_count=fence)
+        ctx.prefetch(t.get("table_name", tname), ids, min_push_count=fence)
 
 
 def pull_host(name, ids):

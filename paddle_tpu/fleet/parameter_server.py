@@ -6,9 +6,12 @@ batch's sparse rows from servers, run fwd/bwd locally, and push sparse grads
 back; dense parameters stay worker-side. The TPU translation: dense params
 live on-device inside the jit step (better than PS round-trips), sparse
 tables live on native PS servers (csrc/ps), and the worker's pull -> step ->
-push pipeline is host code around the compiled step (PSWorker.run). The
-trainer program needs NO transpilation — sparse_embedding already emitted
-the rows/idx feed structure (layers/nn.py sparse_embedding).
+push pipeline is host code around the compiled step (PSWorker.run).
+sparse_embedding programs need no transpilation (the rows/idx feed
+structure is emitted at build time); reference-style
+`embedding(is_distributed=True)` programs ARE transpiled by
+ParameterServerOptimizer.minimize into in-graph remote lookups — the
+DistributeTranspiler rewrite, re-based on host callbacks.
 
 Usage:
     from paddle_tpu.fleet import parameter_server as psfleet
@@ -65,9 +68,100 @@ class ParameterServerOptimizer(DistributedOptimizer):
     def __init__(self, optimizer, strategy=None):
         super().__init__(optimizer, strategy or PSDistributedStrategy())
 
+    def _transpile_distributed_embeddings(self, program, startup_program):
+        """The reference's DistributeTranspiler rewrite for
+        `embedding(..., is_distributed=True)` (reference: python/paddle/
+        fluid/transpiler/distribute_transpiler.py lookup-table handling):
+        each lookup over an is_distributed Parameter becomes the remote
+        in-graph form — the table never materializes locally. The local
+        Parameter and its startup init are removed; the table is created
+        server-side at fleet.init_worker."""
+        import warnings as _warnings
+
+        block = program.global_block()
+        tables = getattr(program, "_remote_tables", None)
+        # group by table var first: one W may feed several lookups (shared
+        # table across slots) — all of them rewrite against ONE server
+        # table, and the var is dropped once
+        sites = {}  # wname -> [op index]
+        for i, op in enumerate(block.ops):
+            if op.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            wname = op.inputs.get("W", [None])[0]
+            w = block.vars.get(wname)
+            if w is None or not getattr(w, "is_distributed", False):
+                continue
+            # validate BEFORE any mutation: a mid-rewrite failure would
+            # leave a half-transpiled program (some lookups remote, the
+            # local table still present, no push ops)
+            pad = op.attrs.get("padding_idx", -1)
+            enforce(
+                pad is None or pad < 0,
+                f"embedding '{wname}': is_distributed=True with "
+                "padding_idx is not supported on the remote path — drop "
+                "padding_idx (mask downstream) or keep the table local",
+            )
+            sites.setdefault(wname, []).append(i)
+        rewritten = []
+        from paddle_tpu.core.ir import Operator
+        from paddle_tpu.layers.nn import _next_table_id
+
+        for wname, idxs in sites.items():
+            w = block.vars[wname]
+            dim = int(w.shape[-1])
+            if tables is None:
+                tables = program._remote_tables = {}
+            table_id = _next_table_id(program)
+            for k, i in enumerate(idxs):
+                op = block.ops[i]
+                block.ops[i] = Operator(
+                    block, "distributed_lookup_table",
+                    {"Ids": list(op.inputs["Ids"])},
+                    {"Outputs": list(op.outputs["Out"])},
+                    {"table_name": wname, "dim": dim},
+                )
+                out_name = op.outputs["Out"][0]
+                ov = block.vars.get(out_name)
+                if ov is not None:
+                    ov.stop_gradient = False
+                entry_key = wname if k == 0 else f"{wname}__use{k}"
+                tables[entry_key] = {
+                    "table_id": table_id,
+                    "table_name": wname,  # the wire/registration name
+                    "ids": op.inputs["Ids"][0],
+                    "out": out_name,
+                    "dim": dim,
+                    "init_range": 0.01,
+                    "optimizer": "sgd",
+                }
+            rewritten.append(wname)
+            # the table exists only on the servers: drop the local
+            # Parameter and its startup initialization
+            block.vars.pop(wname, None)
+            if startup_program is not None:
+                sblock = startup_program.global_block()
+                sblock.ops = [
+                    o for o in sblock.ops
+                    if wname not in o.output_names()
+                ]
+                sblock.vars.pop(wname, None)
+        if rewritten:
+            program._bump_version()
+            _warnings.warn(
+                f"embedding(is_distributed=True) tables {rewritten} "
+                "transpiled to parameter-server remote lookups (the "
+                "reference's distribute_transpiler rewrite); they train "
+                "with the server-side optimizer at strategy.sparse_lr",
+                stacklevel=3,
+            )
+        return rewritten
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         program = loss.block.program
+        self._transpile_distributed_embeddings(
+            program, startup_program or default_startup_program()
+        )
         tables = getattr(program, "_sparse_tables", {})
         remote = getattr(program, "_remote_tables", {})
         rows_names = [t["rows"] for t in tables.values()]
@@ -90,7 +184,8 @@ class ParameterServerOptimizer(DistributedOptimizer):
                 "distributed_push_sparse",
                 {"Ids": [t["ids"]], "Grad": [t["out"] + "@GRAD"]},
                 {},
-                {"table_name": tname, "dim": t["dim"], "op_role": 1},
+                {"table_name": t.get("table_name", tname), "dim": t["dim"],
+                 "op_role": 1},
             )
         optimize_ops = opt.apply_gradients(params_grads)
         # dataset-mode wiring (reference: the transpiler writing opt_info
@@ -299,7 +394,11 @@ class _PSFleet(Fleet):
         tables = getattr(program, "_sparse_tables", {})
         remote = getattr(program, "_remote_tables", {})
         if self.worker_index() <= 0:
+            created = set()
             for t in list(tables.values()) + list(remote.values()):
+                if t["table_id"] in created:
+                    continue  # shared table: several lookups, one table
+                created.add(t["table_id"])
                 self._client.create_table(
                     t["table_id"],
                     dim=t["dim"],
@@ -314,7 +413,9 @@ class _PSFleet(Fleet):
                 self._client, sparse_lr=strategy.sparse_lr
             )
             for tname, t in remote.items():
-                ctx.register(tname, t["table_id"], t["dim"])
+                ctx.register(
+                    t.get("table_name", tname), t["table_id"], t["dim"]
+                )
             _rl.activate(ctx)
         if self.worker_num() > 1:
             self._client.barrier(self.worker_num())

@@ -583,6 +583,19 @@ def moe_ffn(input, num_experts, d_ff=None, expert_axis="expert",
     return out, aux
 
 
+def _next_table_id(program):
+    """First free PS table id across BOTH registries (host-pull
+    `_sparse_tables` and in-graph `_remote_tables`) — one allocation rule
+    for every producer (sparse_embedding, distributed_embedding, the
+    is_distributed transpiler)."""
+    used = {
+        t["table_id"]
+        for reg in ("_sparse_tables", "_remote_tables")
+        for t in getattr(program, reg, {}).values()
+    }
+    return max(used, default=100) + 1
+
+
 def sparse_embedding(
     input,
     embedding_dim,
@@ -609,8 +622,7 @@ def sparse_embedding(
     if tables is None:
         tables = program._sparse_tables = {}
     if table_id is None:
-        used = {t["table_id"] for t in tables.values()}
-        table_id = max(used, default=0) + 1
+        table_id = _next_table_id(program)
     rows = tensor_layers.data(
         f"{tname}__rows", shape=[-1, embedding_dim],
         dtype="float32", append_batch_size=False,
@@ -680,12 +692,7 @@ def distributed_embedding(
     if tables is None:
         tables = program._remote_tables = {}
     if table_id is None:
-        used = {t["table_id"] for t in tables.values()}
-        used |= {
-            t["table_id"]
-            for t in getattr(program, "_sparse_tables", {}).values()
-        }
-        table_id = max(used, default=100) + 1
+        table_id = _next_table_id(program)
     out = helper.create_variable_for_type_inference(dtype)
     ids_shape = [d for d in (input.shape or [-1])]
     if len(ids_shape) >= 2 and ids_shape[-1] == 1:
@@ -700,6 +707,7 @@ def distributed_embedding(
     )
     tables[tname] = {
         "table_id": table_id,
+        "table_name": tname,  # wire/registration name (entry keys may differ)
         "ids": input.name,
         "out": out.name,
         "dim": dim,

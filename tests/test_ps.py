@@ -558,3 +558,80 @@ def test_downpour_dataset_mode_e2e(tmp_path):
     finally:
         fleet.stop_worker()
         srv.stop()
+
+
+def test_embedding_is_distributed_transpiles_to_remote():
+    """The reference's port path: embedding(..., is_distributed=True) under
+    the PS fleet transpiles to remote in-graph lookups (reference:
+    distribute_transpiler.py lookup-table handling) — the local Parameter
+    disappears, one table serves MULTIPLE lookups (shared across slots),
+    and training moves server-side rows."""
+    import warnings
+
+    from paddle_tpu.fleet import parameter_server as psfleet
+    from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+    fleet = psfleet.fleet
+    fleet.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("a", shape=[-1, 2], dtype="int64")
+        b = fluid.data("b", shape=[-1, 2], dtype="int64")
+        label = fluid.data("label", shape=[-1, 1], dtype="float32")
+        # ONE shared is_distributed table feeding two lookups
+        ea = fluid.layers.embedding(
+            a, size=(1000, 8), is_distributed=True,
+            param_attr=fluid.ParamAttr(name="shared_emb"),
+        )
+        eb = fluid.layers.embedding(
+            b, size=(1000, 8), is_distributed=True,
+            param_attr=fluid.ParamAttr(name="shared_emb"),
+        )
+        feat = fluid.layers.concat(
+            [fluid.layers.reduce_sum(ea, dim=1),
+             fluid.layers.reduce_sum(eb, dim=1)], axis=1)
+        logit = fluid.layers.fc(feat, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        strategy = psfleet.PSDistributedStrategy(mode="sync", sparse_lr=0.3)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fleet.distributed_optimizer(
+                fluid.optimizer.SGD(learning_rate=0.3), strategy
+            ).minimize(loss)
+        assert any("transpiled" in str(x.message) for x in w)
+
+    # transpile evidence: no local parameter, two remote entries sharing
+    # one table, two lookup + two push ops
+    assert "shared_emb" not in main.global_block().vars
+    entries = list(main._remote_tables.values())
+    assert len(entries) == 2
+    assert len({e["table_id"] for e in entries}) == 1
+    ops = [op.type for op in main.global_block().ops]
+    assert ops.count("distributed_lookup_table") == 2
+    assert ops.count("distributed_push_sparse") == 2
+    assert "lookup_table_v2" not in ops
+
+    srv = fleet.init_server(port=0)
+    try:
+        fleet.init_worker(main)
+        exe = fluid.Executor(fluid.CPUPlace())
+        r = np.random.RandomState(0)
+        feed = {"a": r.randint(0, 1000, (16, 2)).astype("int64"),
+                "b": r.randint(0, 1000, (16, 2)).astype("int64"),
+                "label": (r.rand(16, 1) > 0.5).astype("float32")}
+        losses = []
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for _ in range(10):
+                out = exe.run(main, feed=feed, fetch_list=[loss.name])
+                losses.append(float(out[0][0]))
+        assert losses[-1] < losses[0], losses
+        # rows moved server-side; the shared table holds BOTH slots' ids
+        stats = fleet._client.table_stats()
+        tid = entries[0]["table_id"]
+        uniq = len(np.unique(np.concatenate([feed["a"], feed["b"]])))
+        assert stats[tid] == uniq, (stats, uniq)
+    finally:
+        fleet.stop_worker()
+        srv.stop()
