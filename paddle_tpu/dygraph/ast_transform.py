@@ -25,12 +25,18 @@ Contract (documented limits, loud failures otherwise):
   loop body, are rejected at transform time (those loops stay plain
   Python: static trip counts still work, data-dependent ones hit the loud
   capture guard);
-- loop-carried variables must hold tensor values (or numbers promotable to
-  tensors) and be assigned BEFORE the loop;
+- loop-carried variables must hold tensor values (or numbers promotable
+  to tensors); state read before its in-body assignment must be assigned
+  BEFORE the loop (write-before-read temps — e.g. a nested loop's counter
+  — get a synthesized zero init from their traced shape);
 - `for x in <tensor>` iteration is not converted (use layers.while_loop or
   index with a range loop);
 - after a ZERO-trip converted `for`, the loop variable holds `start`
-  (CPython leaves it unbound/stale) — carried state needs an init value.
+  (CPython leaves it unbound/stale) — carried state needs an init value;
+  likewise a write-before-read body temp (synthesized zero init) reads as
+  ZEROS after a zero-trip `while` where CPython would raise NameError —
+  trip counts are run-time values, so the divergence cannot be detected
+  at trace time.
 """
 
 import ast
@@ -436,7 +442,14 @@ class _Undefined:
         )
 
     __getattr__ = __call__ = __add__ = __radd__ = __mul__ = __rmul__ = \
-        __sub__ = __rsub__ = __truediv__ = __rtruediv__ = __bool__ = _boom
+        __sub__ = __rsub__ = __truediv__ = __rtruediv__ = __bool__ = \
+        __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = \
+        __getitem__ = __iter__ = __len__ = __neg__ = __pos__ = \
+        __pow__ = __rpow__ = __mod__ = __rmod__ = __matmul__ = \
+        __rmatmul__ = __float__ = __int__ = __index__ = _boom
+    # __eq__ override kills default hashing; identity hash is the right
+    # semantic for a placeholder
+    __hash__ = object.__hash__
 
 
 def _select_if(pred, true_fn, false_fn, thunks=()):
@@ -540,15 +553,21 @@ def _run_while(cond_fn, body_fn, thunks, names):
             "converted loop: symbolic condition outside capture mode"
         )
     from paddle_tpu.layers.control_flow import While
+    from paddle_tpu.utils import unique_name as _un
 
     prog = cap.main_program
     svs = []
+    undef_slots = []
     for nm, v in zip(names, vals):
         if isinstance(v, _Undefined):
-            raise RuntimeError(
-                f"converted loop: variable '{nm}' is loop state but has no "
-                "value before the loop; initialize it first"
-            )
+            # a name assigned inside the body but never defined before the
+            # loop (e.g. an inner loop's counter re-initialized each outer
+            # iteration): its init shape becomes known once the body is
+            # traced — materialize a zero init then. Reading it BEFORE its
+            # in-body assignment still fails loudly (_Undefined._boom).
+            svs.append(None)
+            undef_slots.append(len(svs) - 1)
+            continue
         if isinstance(v, VarBase):
             vb = v
         else:
@@ -563,10 +582,40 @@ def _run_while(cond_fn, body_fn, thunks, names):
             sv = cap.to_static_var(vb)
         svs.append(sv)
     cond_sv = c.static_var
+    parent = prog.current_block()
     with While(cond_sv):
         sub = prog.current_block()
-        out = body_fn(*[VarBase.from_static(sv) for sv in svs])
+        out = body_fn(*[
+            VarBase.from_static(sv) if sv is not None else _Undefined()
+            for sv in svs
+        ])
         out = out if isinstance(out, tuple) else (out,)
+        for idx in undef_slots:
+            nv = out[idx]
+            nsv = nv.static_var if isinstance(nv, VarBase) else None
+            shape = (
+                list(nsv.shape)
+                if nsv is not None and nsv.shape is not None
+                else None
+            )
+            if (
+                shape is None
+                or any(d is None or d < 0 for d in shape)
+            ):
+                raise RuntimeError(
+                    f"converted loop: variable '{names[idx]}' is loop "
+                    "state with no value before the loop and no statically "
+                    "known in-body shape; initialize it before the loop"
+                )
+            init_name = _un.generate(f"__pt_loop_init_{names[idx]}")
+            parent.create_var(name=init_name, shape=shape, dtype=nsv.dtype)
+            # emitted into the PARENT block; the while op is appended after
+            # it on __exit__, so the init dominates the loop
+            parent.append_op(
+                "fill_constant", {}, {"Out": [init_name]},
+                {"shape": shape, "dtype": nsv.dtype, "value": 0.0},
+            )
+            svs[idx] = parent.var(init_name)
         for nm, sv, nv in zip(names, svs, out):
             if not isinstance(nv, VarBase):
                 try:

@@ -80,6 +80,22 @@ class ParameterServerOptimizer(DistributedOptimizer):
 
         block = program.global_block()
         tables = getattr(program, "_remote_tables", None)
+        # the rewrite covers the GLOBAL block; an is_distributed lookup
+        # buried in a cond/while sub-block must fail loudly, not silently
+        # train a worker-local table
+        for b in program.blocks[1:]:
+            for op in b.ops:
+                if op.type not in ("lookup_table", "lookup_table_v2"):
+                    continue
+                wname = op.inputs.get("W", [None])[0]
+                w = block._find_var_recursive(wname) if wname else None
+                enforce(
+                    w is None or not getattr(w, "is_distributed", False),
+                    f"embedding '{wname}': is_distributed=True inside a "
+                    "cond/while sub-block cannot transpile to the remote "
+                    "path — hoist the lookup out of the control-flow "
+                    "region or keep the table local",
+                )
         # group by table var first: one W may feed several lookups (shared
         # table across slots) — all of them rewrite against ONE server
         # table, and the var is dropped once

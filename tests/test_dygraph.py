@@ -576,3 +576,55 @@ def test_declarative_mixed_scalar_tensor_branch():
         neg = to_variable(np.full((2,), -1.0, dtype=np.float32))
         np.testing.assert_allclose(f(pos).numpy(), [3.0, 3.0])
         np.testing.assert_allclose(f(neg).numpy(), [-1.0, -1.0])
+
+
+def test_declarative_if_inside_converted_loop():
+    """Data-dependent `if` INSIDE a converted `while` body: the if becomes
+    where-selection inside the loop's traced sub-block — both transforms
+    compose in one program."""
+    from paddle_tpu.dygraph.jit import declarative
+
+    @declarative
+    def f(x, n):
+        s = dygraph.trace_op("mean", {"X": [x]}, {})["Out"][0] * 0.0
+        acc = x * 0.0
+        while s < n:
+            if acc[0] > 2.0:
+                acc = acc + 0.5
+            else:
+                acc = acc + 1.0
+            s = s + 1.0
+        return acc
+
+    with dygraph.guard():
+        x = to_variable(np.zeros((1,), dtype=np.float32))
+        out = f(x, to_variable(np.asarray(5.0, dtype=np.float32)))
+        # steps: 1, 2, 3 (acc<=2 so +1), then 3>2 -> +0.5 twice = 4.0
+        np.testing.assert_allclose(out.numpy().reshape(-1)[0], 4.0)
+        # same traced program, different trip count
+        out = f(x, to_variable(np.asarray(2.0, dtype=np.float32)))
+        np.testing.assert_allclose(out.numpy().reshape(-1)[0], 2.0)
+
+
+def test_declarative_nested_converted_loops():
+    """A converted while nested inside a converted while (inner trip count
+    depends on the outer counter)."""
+    from paddle_tpu.dygraph.jit import declarative
+
+    @declarative
+    def f(x, n):
+        total = x * 0.0
+        i = dygraph.trace_op("mean", {"X": [x]}, {})["Out"][0] * 0.0
+        while i < n:
+            j = i * 0.0
+            while j < i + 1.0:
+                total = total + 1.0
+                j = j + 1.0
+            i = i + 1.0
+        return total
+
+    with dygraph.guard():
+        x = to_variable(np.zeros((1,), dtype=np.float32))
+        out = f(x, to_variable(np.asarray(3.0, dtype=np.float32)))
+        # i=0: 1 inner; i=1: 2; i=2: 3 -> total 6
+        np.testing.assert_allclose(out.numpy().reshape(-1)[0], 6.0)
