@@ -18,6 +18,13 @@ class Scope:
         self._vars = {}
         self.parent = parent
         self.kids = []
+        # name -> {devices} the value is KNOWN to live on — the
+        # steady-state dispatch fast path (Executor._committed) is then one
+        # dict lookup instead of a per-step jax.Array.devices() call (~5 us
+        # each; BERT threads ~600 scope entries per step). Any user-facing
+        # set() invalidates; the executor re-marks values it verified or
+        # produced itself.
+        self._device_verified = {}
         if parent is not None:
             parent.kids.append(self)
 
@@ -26,14 +33,28 @@ class Scope:
 
     def set(self, name, value):
         self._vars[name] = value
+        self._device_verified.pop(name, None)
 
-    def find_var(self, name):
+    def _set_verified(self, name, value, device):
+        """Executor-internal write-back: `value` came out of the compiled
+        step (or was just committed), so it is on `device` by construction
+        — and ONLY there: the verification set resets (the old value's
+        devices do not describe the replacement; a stale entry would hand
+        another executor a wrong-device array through the fast path)."""
+        self._vars[name] = value
+        self._device_verified[name] = {device}
+
+    def _find_owner(self, name):
         scope = self
         while scope is not None:
             if name in scope._vars:
-                return scope._vars[name]
+                return scope
             scope = scope.parent
         return None
+
+    def find_var(self, name):
+        owner = self._find_owner(name)
+        return owner._vars[name] if owner is not None else None
 
     def has_var(self, name):
         return self.find_var(name) is not None
@@ -44,6 +65,7 @@ class Scope:
     def erase(self, names):
         for n in names:
             self._vars.pop(n, None)
+            self._device_verified.pop(n, None)
 
     def find_var_numpy(self, name):
         v = self.find_var(name)

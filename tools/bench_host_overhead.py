@@ -1,0 +1,76 @@
+"""Executor host-dispatch overhead: per-step Python cost of exe.run.
+
+A trivial one-op program (scale of a [1] tensor) makes device time
+negligible, so the steady-state wall time per step IS the host path:
+compile-cache hit, feed signature hash, scope reads through the committed
+fast path, donation bookkeeping, fetch conversion. PROFILE.md's round-2
+finding was ~200 device_puts per step costing milliseconds; the committed
+-scope design (core/executor.py _committed) is what this measures.
+
+Prints one JSON line with per-step microseconds for a param-light and a
+param-heavy (200 persistables) program.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+
+
+def measure(n_params, steps=300):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[1], dtype="float32")
+        acc = None
+        for i in range(n_params):
+            w = fluid.layers.tensor.create_global_var(
+                shape=[4], value=float(i), dtype="float32",
+                persistable=True, name=f"w_{i}",
+            )
+            term = fluid.layers.reduce_sum(w)
+            acc = term if acc is None else acc + term
+        y = fluid.layers.scale(x, scale=2.0)
+        out = y + acc if acc is not None else y
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones(1, np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):  # compile + commit
+            exe.run(main, feed=feed, fetch_list=[out.name],
+                    return_numpy=False)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = exe.run(main, feed=feed, fetch_list=[out.name],
+                        return_numpy=False)
+        np.asarray(r[0])
+        dt = time.perf_counter() - t0
+    return dt / steps * 1e6
+
+
+def main():
+    light = measure(0)
+    heavy = measure(200)
+    print(json.dumps({
+        "metric": "executor_host_overhead_us_per_step",
+        "light_program_us": round(light, 1),
+        "heavy_200_persistables_us": round(heavy, 1),
+        "per_persistable_ns": round((heavy - light) / 200 * 1e3, 1),
+        "note": "steady-state dispatch cost; committed-scope fast path "
+                "(core/executor.py _committed) keeps the per-persistable "
+                "cost to a type check, not a device_put",
+    }))
+
+
+if __name__ == "__main__":
+    main()
