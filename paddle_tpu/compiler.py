@@ -48,6 +48,34 @@ def _to_global(arr, sharding):
     )
 
 
+def _to_global_verified(scope, name, sharding, store):
+    """_to_global with the scope's verified-cache fast path (the mesh
+    twin of Executor._committed): a value the previous step wrote back
+    under this exact sharding OBJECT (cache entries hold stable ones)
+    skips the per-step sharding comparison — one dict lookup + identity
+    check for ~600 entries on a real model. The set holds a strong
+    reference to the sharding, so the identity can never be recycled;
+    user-facing scope.set invalidates.
+
+    `store=False` for DONATED inputs: their committed buffer is consumed
+    by the step, so storing it would leave a deleted array in the scope
+    whenever the step fails (or forever, for a parent-scope param) — the
+    post-step write-back is their only legitimate store. Their steady
+    state still fast-paths: the write-back marks the output verified."""
+    owner = scope._find_owner(name)
+    if owner is not None:
+        ver = owner._device_verified.get(name)
+        if ver is not None and len(ver) == 1 and \
+                next(iter(ver)) is sharding:
+            return owner._vars[name]
+    out = _to_global(scope.find_var(name), sharding)
+    if store:
+        # child-scope store (shadowing a parent var, like scope.set
+        # always has): the parent keeps its original valid value
+        scope._set_verified(name, out, sharding)
+    return out
+
+
 class BuildStrategy:
     """Accepted for API parity (reference: paddle/fluid/framework/details/
     build_strategy.h:37). Fusion/memory-opt toggles are XLA's job here:
@@ -451,10 +479,12 @@ class CompiledProgram:
         # commit scope inputs to their mesh shardings so first-step vs
         # steady-state layouts match — same fix as Executor._run_compiled
         donated_vals = tuple(
-            _to_global(scope.find_var(n), scope_shardings[n]) for n in donated
+            _to_global_verified(scope, n, scope_shardings[n], store=False)
+            for n in donated
         )
         readonly_vals = tuple(
-            _to_global(scope.find_var(n), scope_shardings[n]) for n in readonly
+            _to_global_verified(scope, n, scope_shardings[n], store=True)
+            for n in readonly
         )
         rng_key = exe._next_rng_key(self._program)
         from paddle_tpu.parallel.env import mesh_context
@@ -469,7 +499,15 @@ class CompiledProgram:
                 )
         for name, val in zip(written, updates):
             if val is not None:
-                scope.set(name, val)
+                # owner-targeted (see Executor._run_compiled write-back)
+                target = scope._find_owner(name) or scope
+                sh = scope_shardings.get(name)
+                if sh is not None:
+                    # out_shardings pinned this output to `sh`: mark
+                    # verified so the next step's commit is one lookup
+                    target._set_verified(name, val, sh)
+                else:
+                    target.set(name, val)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -485,7 +485,7 @@ class Executor:
         return jax.device_put(np.asarray(value), self.place.jax_device())
 
     @staticmethod
-    def _committed(scope, name, dev):
+    def _committed(scope, name, dev, store=True):
         """Scope value as a device-committed array, verifying at most once:
         steady-state training steps hand back the arrays the previous step
         produced (written back via _set_verified, already on `dev`), so the
@@ -493,7 +493,12 @@ class Executor:
         profile's biggest host-side line item) and not even a per-step
         `.devices()` call (~5 us x ~600 scope entries on BERT,
         tools/bench_host_overhead.py). User-facing scope.set invalidates
-        the verification."""
+        the verification.
+
+        `store=False` for DONATED inputs: their buffer is consumed by the
+        step, so storing the committed copy would leave a deleted array in
+        the scope whenever the step fails — the post-step write-back is
+        their only legitimate store."""
         owner = scope._find_owner(name)
         v = owner._vars[name] if owner is not None else None
         if isinstance(v, jax.Array):
@@ -505,7 +510,8 @@ class Executor:
                 owner._device_verified.setdefault(name, set()).add(dev)
                 return v
         arr = jax.device_put(v, dev)
-        scope._set_verified(name, arr, dev)
+        if store:
+            scope._set_verified(name, arr, dev)
         return arr
 
     def _next_rng_key(self, program):
@@ -586,7 +592,9 @@ class Executor:
         # written back below are already committed device arrays.
         dev = self.place.jax_device()
         feed_vals = tuple(feed_arrays[n] for n in sorted(feed_arrays))
-        donated_vals = tuple(self._committed(scope, n, dev) for n in donated)
+        donated_vals = tuple(
+            self._committed(scope, n, dev, store=False) for n in donated
+        )
         readonly_vals = tuple(self._committed(scope, n, dev) for n in readonly)
         rng_key = self._next_rng_key(program)
         with warnings.catch_warnings():
@@ -596,9 +604,14 @@ class Executor:
             )
         for name, val in zip(written_persistable, updates):
             if val is not None:
-                # step outputs are on `dev` by construction: mark verified
-                # so the next step's dispatch skips the devices() probe
-                scope._set_verified(name, val, dev)
+                # write back to the scope the variable LIVES in (reference
+                # semantics: persistables update in place through child
+                # scopes — and the owner's buffer was donated, so leaving
+                # it unreplaced would strand a deleted array there). Step
+                # outputs are on `dev` by construction: mark verified so
+                # the next dispatch skips the devices() probe.
+                target = scope._find_owner(name) or scope
+                target._set_verified(name, val, dev)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
@@ -680,7 +693,7 @@ class Executor:
         for name, val in env.items():
             var = block._find_var_recursive(name)
             if var is not None and var.persistable:
-                scope.set(name, val)
+                (scope._find_owner(name) or scope).set(name, val)
         fetches = [env[n] for n in fetch_names]
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -178,3 +178,35 @@ def test_while_loop_survives_dead_op_pruning():
     with fluid.scope_guard(fluid.Scope()):
         out = exe.run(main, fetch_list=[acc])
     assert float(np.asarray(out[0]).reshape(-1)[0]) == 15.0
+
+
+def test_parent_scope_params_survive_child_run():
+    """Running through a CHILD scope must never leave the parent's params
+    as donated (deleted) buffers: persistables update IN PLACE in the
+    scope they live in (reference Scope semantics), so the parent holds
+    the trained value and stays readable."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4], dtype="float32")
+        y = fluid.data("y", shape=[-1, 1], dtype="float32")
+        p = fluid.layers.fc(x, size=1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    parent = fluid.Scope()
+    with fluid.scope_guard(parent):
+        exe.run(startup)
+    w0 = np.asarray(parent.find_var("w")).copy()
+    child = parent.new_scope()
+    r = np.random.RandomState(0)
+    feed = {"x": r.randn(8, 4).astype("float32"),
+            "y": r.randn(8, 1).astype("float32")}
+    with fluid.scope_guard(child):
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+    # parent value still READABLE (not a donated/deleted buffer) and holds
+    # the TRAINED value (in-place update through the child run)
+    trained = np.asarray(parent._vars["w"])
+    assert not np.allclose(trained, w0)
+    assert "w" not in child._vars  # no stale shadow in the child
