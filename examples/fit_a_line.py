@@ -1,0 +1,65 @@
+"""Linear regression — the reference's first book example
+(reference: python/paddle/fluid/tests/book/test_fit_a_line.py), on
+synthetic housing-shaped data: train with the default-program API, save an
+inference model, reload it and predict.
+
+Run: python examples/fit_a_line.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    from paddle_tpu.core.places import ensure_backend_or_cpu
+
+    # short probe: examples must not stall minutes when the TPU tunnel is
+    # dark (PADDLE_TPU_FORCE_CPU=1 skips the probe entirely)
+    on_acc, diag = ensure_backend_or_cpu(timeout=20, retries=1)
+    print(f"backend: {'accelerator' if on_acc else 'cpu'} ({diag})")
+
+    import paddle_tpu as fluid
+
+    x = fluid.data("x", shape=[-1, 13], dtype="float32")
+    y = fluid.data("y", shape=[-1, 1], dtype="float32")
+    y_predict = fluid.layers.fc(x, size=1, act=None)
+    avg_cost = fluid.layers.mean(
+        fluid.layers.square_error_cost(y_predict, y)
+    )
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype("float32")
+    xs = rng.randn(256, 13).astype("float32")
+    ys = xs @ w_true + 0.1 * rng.randn(256, 1).astype("float32")
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    for epoch in range(50):
+        for i in range(0, 256, 32):
+            feed = {"x": xs[i:i + 32], "y": ys[i:i + 32]}
+            (loss,) = exe.run(feed=feed, fetch_list=[avg_cost])
+        if epoch % 10 == 0:
+            print(f"epoch {epoch}: loss {float(loss[0]):.4f}")
+    assert float(loss[0]) < 0.1, "did not converge"
+
+    # save -> reload -> infer (the book flow)
+    save_dir = tempfile.mkdtemp()
+    fluid.io.save_inference_model(save_dir, ["x"], [y_predict], exe)
+    infer_prog, feed_names, fetch_names = fluid.io.load_inference_model(
+        save_dir, exe
+    )
+    probe = rng.randn(4, 13).astype("float32")
+    (pred,) = exe.run(infer_prog, feed={feed_names[0]: probe},
+                      fetch_list=fetch_names)
+    np.testing.assert_allclose(pred, probe @ w_true, atol=0.5)
+    print("inference model round-trip OK; predictions track ground truth")
+
+
+if __name__ == "__main__":
+    main()
