@@ -195,6 +195,25 @@ class VarBase:
 
         return trace_op("scale", {"X": [self]}, {"scale": -1.0})["Out"][0]
 
+    def __iter__(self):
+        """Row iteration (`for row in x`), matching the reference's tensor
+        iteration. Requires a static leading dim — without this method,
+        Python's fallback iteration protocol would call __getitem__ with
+        ever-growing indices and never terminate (our slice op cannot
+        raise IndexError). The validation runs HERE (not in the generator)
+        so iter(x) fails at the call site, not at the first next()."""
+        shape = self.shape
+        enforce(
+            shape is not None and len(shape) > 0,
+            f"cannot iterate '{self.name}': 0-d tensors are not iterable",
+        )
+        enforce(
+            shape[0] is not None and shape[0] >= 0,
+            f"cannot iterate '{self.name}': leading dimension is not "
+            "statically known",
+        )
+        return (self[i] for i in range(shape[0]))
+
     def __getitem__(self, idx):
         from paddle_tpu.dygraph.base import trace_op
 
@@ -211,7 +230,10 @@ class VarBase:
             else:
                 axes.append(ax)
                 starts.append(int(s))
-                ends.append(int(s) + 1)
+                # s == -1 must select the LAST element: -1 + 1 = 0 would
+                # make an empty slice, so use the int-max sentinel the
+                # slice op treats as "to the end" (paddle convention)
+                ends.append(int(s) + 1 if int(s) != -1 else int(1e9))
                 squeeze_axes.append(ax)
         out = trace_op(
             "slice",

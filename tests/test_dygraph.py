@@ -628,3 +628,30 @@ def test_declarative_nested_converted_loops():
         out = f(x, to_variable(np.asarray(3.0, dtype=np.float32)))
         # i=0: 1 inner; i=1: 2; i=2: 3 -> total 6
         np.testing.assert_allclose(out.numpy().reshape(-1)[0], 6.0)
+
+
+def test_varbase_row_iteration():
+    """`for row in x` yields rows (and terminates — the default iteration
+    protocol over our __getitem__ would loop forever); also composes with
+    @declarative tracing for static shapes."""
+    from paddle_tpu.dygraph.jit import declarative
+
+    with dygraph.guard():
+        x = to_variable(np.arange(6, dtype=np.float32).reshape(3, 2))
+        rows = [r.numpy() for r in x]
+        assert len(rows) == 3
+        np.testing.assert_array_equal(rows[1], [2.0, 3.0])
+        # negative indexing selects from the end (x[-1] was an empty slice)
+        np.testing.assert_array_equal(x[-1].numpy(), [4.0, 5.0])
+        np.testing.assert_array_equal(x[-2].numpy(), [2.0, 3.0])
+
+    @declarative
+    def f(x):
+        acc = x[0] * 0.0
+        for row in x:
+            acc = acc + row
+        return acc
+
+    with dygraph.guard():
+        x = to_variable(np.arange(6, dtype=np.float32).reshape(3, 2))
+        np.testing.assert_allclose(f(x).numpy(), [6.0, 9.0])
