@@ -46,6 +46,46 @@ def _current_name_scope():
     return "/".join(_name_scope_stack)
 
 
+def parse_getitem_index(idx):
+    """Shared tensor-index parser for Variable/VarBase.__getitem__:
+    idx -> (axes, starts, ends, squeeze_axes). Ints squeeze their axis and
+    -1 selects from the end (int-max end sentinel); only step-1 slices are
+    expressible as one slice op — anything else raises here so BOTH
+    surfaces refuse identically."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    axes, starts, ends, squeeze_axes = [], [], [], []
+    for ax, s in enumerate(idx):
+        if isinstance(s, slice):
+            if s.step not in (None, 1):
+                raise ValueError(
+                    "tensor slicing supports step 1 only "
+                    "(use layers.strided_slice)"
+                )
+            if s.start is None and s.stop is None:
+                continue
+            axes.append(ax)
+            starts.append(s.start or 0)
+            ends.append(s.stop if s.stop is not None else int(1e9))
+        else:
+            import operator
+
+            try:
+                # accepts python/numpy ints; a SYMBOLIC tensor index routes
+                # through __index__ and hits its loud capture guard
+                i = operator.index(s)
+            except TypeError:
+                raise TypeError(
+                    f"unsupported tensor index {type(s).__name__} "
+                    "(tensor-valued indices: use layers.gather)"
+                ) from None
+            axes.append(ax)
+            starts.append(i)
+            ends.append(i + 1 if i != -1 else int(1e9))
+            squeeze_axes.append(ax)
+    return axes, starts, ends, squeeze_axes
+
+
 class Variable:
     """A named tensor slot in a Block.
 
@@ -152,6 +192,41 @@ class Variable:
         from paddle_tpu import layers
 
         return layers.matmul(self, other)
+
+    def __getitem__(self, idx):
+        """Slicing sugar on static Variables (reference:
+        python/paddle/fluid/framework.py Variable.__getitem__ emitting the
+        slice op): ints and step-1 slices per axis; int indices squeeze
+        their axis, -1 selects from the end."""
+        from paddle_tpu import layers
+
+        axes, starts, ends, squeeze_axes = parse_getitem_index(idx)
+        out = (
+            layers.slice(self, axes=axes, starts=starts, ends=ends)
+            if axes
+            else self
+        )
+        if squeeze_axes:
+            out = layers.squeeze(out, axes=squeeze_axes)
+        return out
+
+    def __iter__(self):
+        """Row iteration over a static leading dim — without this, adding
+        __getitem__ would make `for v in x` append slice ops forever
+        (Python's fallback protocol stops only on IndexError)."""
+        from paddle_tpu.utils.enforce import enforce as _enforce
+
+        shape = self.shape
+        _enforce(
+            shape is not None and len(shape) > 0,
+            f"cannot iterate '{self.name}': 0-d tensors are not iterable",
+        )
+        _enforce(
+            shape[0] is not None and shape[0] >= 0,
+            f"cannot iterate '{self.name}': leading dimension is not "
+            "statically known",
+        )
+        return (self[i] for i in range(shape[0]))
 
 
 class Parameter(Variable):

@@ -215,26 +215,12 @@ class VarBase:
         return (self[i] for i in range(shape[0]))
 
     def __getitem__(self, idx):
+        from paddle_tpu.core.ir import parse_getitem_index
         from paddle_tpu.dygraph.base import trace_op
 
-        if not isinstance(idx, tuple):
-            idx = (idx,)
-        axes, starts, ends, squeeze_axes = [], [], [], []
-        for ax, s in enumerate(idx):
-            if isinstance(s, slice):
-                if s.start is None and s.stop is None:
-                    continue
-                axes.append(ax)
-                starts.append(s.start or 0)
-                ends.append(s.stop if s.stop is not None else int(1e9))
-            else:
-                axes.append(ax)
-                starts.append(int(s))
-                # s == -1 must select the LAST element: -1 + 1 = 0 would
-                # make an empty slice, so use the int-max sentinel the
-                # slice op treats as "to the end" (paddle convention)
-                ends.append(int(s) + 1 if int(s) != -1 else int(1e9))
-                squeeze_axes.append(ax)
+        axes, starts, ends, squeeze_axes = parse_getitem_index(idx)
+        if not axes:
+            return self
         out = trace_op(
             "slice",
             {"Input": [self]},

@@ -36,6 +36,8 @@ __all__ = [
     "retinanet_detection_output",
     "locality_aware_nms",
     "proximal_gd",  # exposed for parity; normally reached via optimizers
+    "unique",
+    "unique_with_counts",
 ]
 
 
@@ -432,3 +434,37 @@ def proximal_gd(param, grad, learning_rate, l1=0.0, l2=0.0):
         {"l1": l1, "l2": l2},
     )
     return out
+
+
+def unique(x, dtype="int32"):
+    """reference: python/paddle/fluid/layers/nn.py unique — returns
+    (Out, Index). Static-shape contract: Out keeps x's length with unique
+    values front-compacted (see ops/misc_extra.py _unique)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("unique")
+    out = _out(helper, x.dtype)
+    index = _out(helper, dtype, stop_gradient=True)
+    helper.append_op(
+        "unique", {"X": [x.name]},
+        {"Out": [out.name], "Index": [index.name]},
+        {"dtype": dtype},
+    )
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    """reference: python/paddle/fluid/layers/nn.py unique_with_counts —
+    returns (Out, Index, Count); same static-shape contract as unique."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("unique_with_counts")
+    out = _out(helper, x.dtype)
+    index = _out(helper, dtype, stop_gradient=True)
+    count = _out(helper, dtype, stop_gradient=True)
+    helper.append_op(
+        "unique_with_counts", {"X": [x.name]},
+        {"Out": [out.name], "Index": [index.name], "Count": [count.name]},
+        {"dtype": dtype},
+    )
+    return out, index, count

@@ -210,3 +210,25 @@ def test_parent_scope_params_survive_child_run():
     trained = np.asarray(parent._vars["w"])
     assert not np.allclose(trained, w0)
     assert "w" not in child._vars  # no stale shadow in the child
+
+
+def test_static_variable_getitem():
+    """Variable slicing sugar (reference: framework.py math_op_patch):
+    ints squeeze, -1 selects from the end, slices keep the axis."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 4, 3], dtype="float32")
+        a = x[0]          # [4, 3]
+        b = x[:, -1]      # [-1?, 3] last row of axis 1
+        c = x[:, 1:3]     # [-1, 2, 3]
+        loss = fluid.layers.reduce_sum(a) + fluid.layers.reduce_sum(b) \
+            + fluid.layers.reduce_sum(c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.arange(2 * 4 * 3, dtype="float32").reshape(2, 4, 3)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        av, bv, cv = exe.run(main, feed={"x": arr},
+                             fetch_list=[a.name, b.name, c.name])
+    np.testing.assert_array_equal(av, arr[0])
+    np.testing.assert_array_equal(bv, arr[:, -1])
+    np.testing.assert_array_equal(cv, arr[:, 1:3])

@@ -1124,3 +1124,25 @@ def test_distributed_lookup_table_alias(rng):
     np.testing.assert_allclose(
         np.asarray(outs["Outputs"][0]), w[ids[:, 0]], rtol=1e-6
     )
+
+
+def test_unique_layers(rng):
+    """layers.unique / unique_with_counts reach their ops end to end."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[6], dtype="int64")
+        out, index = fluid.layers.unique(x)
+        out2, idx2, count = fluid.layers.unique_with_counts(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    arr = np.array([3, 1, 3, 2, 1, 3], dtype="int64")
+    with fluid.scope_guard(fluid.Scope()):
+        ov, iv, cv = exe.run(
+            main, feed={"x": arr},
+            fetch_list=[out.name, idx2.name, count.name],
+        )
+    # reconstruct: every position maps back to its value
+    np.testing.assert_array_equal(np.asarray(ov)[iv], arr)
+    # counts for the 3 real uniques (front-compacted, sorted: 1, 2, 3)
+    assert cv[:3].tolist() == [2, 1, 3]
