@@ -15,6 +15,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def build_programs(main_prog=None, startup_prog=None):
+    """Pure graph construction (no training, no execution): linear model,
+    loss, and SGD step. Returns (main, startup, feed_names,
+    fetch_vars=[avg_cost, y_predict]) — also the entry point
+    tools/lint_program.py-style program linting uses in CI."""
+    import paddle_tpu as fluid
+
+    main_prog = main_prog if main_prog is not None else fluid.Program()
+    startup_prog = startup_prog if startup_prog is not None else fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        x = fluid.data("x", shape=[-1, 13], dtype="float32")
+        y = fluid.data("y", shape=[-1, 1], dtype="float32")
+        y_predict = fluid.layers.fc(x, size=1, act=None)
+        avg_cost = fluid.layers.mean(
+            fluid.layers.square_error_cost(y_predict, y)
+        )
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    return main_prog, startup_prog, ["x", "y"], [avg_cost, y_predict]
+
+
 def main():
     from paddle_tpu.core.places import ensure_backend_or_cpu
 
@@ -25,13 +45,9 @@ def main():
 
     import paddle_tpu as fluid
 
-    x = fluid.data("x", shape=[-1, 13], dtype="float32")
-    y = fluid.data("y", shape=[-1, 1], dtype="float32")
-    y_predict = fluid.layers.fc(x, size=1, act=None)
-    avg_cost = fluid.layers.mean(
-        fluid.layers.square_error_cost(y_predict, y)
+    _, _, _, (avg_cost, y_predict) = build_programs(
+        fluid.default_main_program(), fluid.default_startup_program()
     )
-    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
 
     rng = np.random.RandomState(0)
     w_true = rng.randn(13, 1).astype("float32")

@@ -15,6 +15,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def build_programs(src_len=12, tgt_len=12):
+    """Pure graph construction (no training, no execution): the tiny
+    transformer train program. Returns (main, startup, feed_names,
+    fetch_vars, cfg) — also the entry point tools/lint_program.py-style
+    program linting uses in CI."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny()
+    main_prog, startup, feeds, fetches = tfm.build_wmt_train(
+        cfg, src_len=src_len, tgt_len=tgt_len,
+        optimizer=fluid.optimizer.Adam(2e-3),
+    )
+    feed_names = [f if isinstance(f, str) else f.name for f in feeds]
+    return main_prog, startup, feed_names, fetches, cfg
+
+
 def main():
     from paddle_tpu.core.places import ensure_backend_or_cpu
 
@@ -26,12 +43,8 @@ def main():
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tfm
 
-    cfg = tfm.TransformerConfig.tiny()
     src_len = tgt_len = 12
-    main_prog, startup, feeds, fetches = tfm.build_wmt_train(
-        cfg, src_len=src_len, tgt_len=tgt_len,
-        optimizer=fluid.optimizer.Adam(2e-3),
-    )
+    main_prog, startup, _, fetches, cfg = build_programs(src_len, tgt_len)
     exe = fluid.Executor(fluid.TPUPlace(0))
     scope = fluid.Scope()
     rng = np.random.RandomState(0)

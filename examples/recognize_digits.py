@@ -25,6 +25,32 @@ def synthetic_digits(rng, n):
     return imgs, labels.reshape(-1, 1)
 
 
+def build_programs(main_prog=None, startup_prog=None):
+    """Pure graph construction (no training, no execution): the conv net,
+    loss/metric, and Adam step. Returns (main, startup, feed_names,
+    fetch_vars=[loss, acc, prediction]) — also the entry point
+    tools/lint_program.py-style program linting uses in CI."""
+    import paddle_tpu as fluid
+
+    main_prog = main_prog if main_prog is not None else fluid.Program()
+    startup_prog = startup_prog if startup_prog is not None else fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        img = fluid.data("img", shape=[-1, 1, 28, 28], dtype="float32")
+        label = fluid.data("label", shape=[-1, 1], dtype="int64")
+        c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
+        p1 = fluid.layers.pool2d(c1, pool_size=2, pool_stride=2)
+        c2 = fluid.layers.conv2d(p1, num_filters=16, filter_size=5, act="relu")
+        p2 = fluid.layers.pool2d(c2, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(p2, [0, 16 * 4 * 4])
+        prediction = fluid.layers.fc(flat, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(prediction, label)
+        )
+        acc = fluid.layers.accuracy(prediction, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main_prog, startup_prog, ["img", "label"], [loss, acc, prediction]
+
+
 def main():
     from paddle_tpu.core.places import ensure_backend_or_cpu
 
@@ -35,19 +61,9 @@ def main():
 
     import paddle_tpu as fluid
 
-    img = fluid.data("img", shape=[-1, 1, 28, 28], dtype="float32")
-    label = fluid.data("label", shape=[-1, 1], dtype="int64")
-    c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
-    p1 = fluid.layers.pool2d(c1, pool_size=2, pool_stride=2)
-    c2 = fluid.layers.conv2d(p1, num_filters=16, filter_size=5, act="relu")
-    p2 = fluid.layers.pool2d(c2, pool_size=2, pool_stride=2)
-    flat = fluid.layers.reshape(p2, [0, 16 * 4 * 4])
-    prediction = fluid.layers.fc(flat, size=10, act="softmax")
-    loss = fluid.layers.mean(
-        fluid.layers.cross_entropy(prediction, label)
+    _, _, _, (loss, acc, prediction) = build_programs(
+        fluid.default_main_program(), fluid.default_startup_program()
     )
-    acc = fluid.layers.accuracy(prediction, label)
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
 
     rng = np.random.RandomState(0)
     xs, ys = synthetic_digits(rng, 512)

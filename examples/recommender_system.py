@@ -15,6 +15,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
+def build_programs():
+    """Pure graph construction (no PS server, no training): the Wide&Deep
+    CTR train program in local mode. Returns (main, startup, feed_names,
+    fetch_vars) — also the entry point tools/lint_program.py-style program
+    linting uses in CI. (main() builds the remote-PS variant instead, which
+    needs an initialized fleet.)"""
+    from paddle_tpu.models import ctr
+
+    main_prog, startup, feeds, fetches = ctr.build_ctr_train(
+        num_slots=4, ids_per_slot=2, deep_dim=8, hidden=(16,),
+        sparse_lr=0.2, ps_mode=False, vocab_size=200,
+    )
+    feed_names = [f if isinstance(f, str) else f.name for f in feeds]
+    return main_prog, startup, feed_names, fetches
+
+
 def main():
     from paddle_tpu.core.places import ensure_backend_or_cpu
 

@@ -1,0 +1,47 @@
+"""Static analysis over the Program IR.
+
+Fluid's central idea — training features as program transforms — means every
+subsystem (autodiff, AMP, fusion, sharding, inference) is a rewrite of the
+same IR, and a single buggy rewrite silently corrupts every downstream
+consumer. This package is the shared correctness layer over core/ir.py:
+
+  * usedef.py  — ONE control-flow-aware use-def/liveness computation
+                 (producers/consumers/live vars, recursing into while/
+                 conditional_block/recurrent sub-blocks). The fusion passes,
+                 DCE, backward pruning and the executor's planner all consume
+                 it instead of private per-pass scans.
+  * verify.py  — a program verifier: use-before-def, dangling op inputs/
+                 outputs, dtype/rank consistency against registered op
+                 signatures, duplicate/shadowed var definitions, orphaned
+                 sub-blocks, sharding-spec consistency. Returns structured
+                 Diagnostics carrying op callstacks.
+  * signatures.py — per-op static signatures (rank/dtype constraints) the
+                 verifier checks op descs against.
+
+PassManager(verify_each_pass=True) runs the verifier after every pass and
+names the pass that broke an invariant; tools/lint_program.py is the CLI.
+"""
+
+from paddle_tpu.analysis.usedef import (
+    UseDefMap,
+    build_usedef,
+    live_ops,
+    live_var_sets,
+    subtree_io,
+)
+from paddle_tpu.analysis.verify import (
+    Diagnostic,
+    verify_program,
+    verify_shardings,
+)
+
+__all__ = [
+    "UseDefMap",
+    "build_usedef",
+    "live_ops",
+    "live_var_sets",
+    "subtree_io",
+    "Diagnostic",
+    "verify_program",
+    "verify_shardings",
+]

@@ -1,0 +1,93 @@
+"""Static op signatures: the declared-metadata constraints an op desc must
+satisfy for its registered lowering (core/registry.py) to be well-typed.
+
+The reference encodes these per-op in C++ InferShape/GetExpectedKernelType
+(reference: paddle/fluid/framework/shape_inference.h, operator.cc). Here the
+lowering rules are jax tracers that discover violations only at trace time —
+deep inside jit, far from the op that seeded the bad desc. This table gives
+the verifier (analysis/verify.py) the *static* subset: rank requirements on
+declared shapes and same-dtype groups over declared dtypes, checked without
+tracing. An op may also carry a signature on its OpDef (registry.py
+``signature=``), which takes precedence over this table.
+
+Only constraints that hold for EVERY legal call site belong here — the
+verifier must never flag a well-formed program.
+"""
+
+__all__ = ["OpSignature", "get_signature"]
+
+
+class OpSignature:
+    """Constraints over an op desc's declared var metadata.
+
+    same_dtype: groups of input/output slot names whose declared dtypes must
+        all agree; every list member of each named slot participates
+        (members with undeclared dtypes are skipped), so a single-slot
+        group like ``("X",)`` requires all of that slot's members to match.
+    ranks: {slot: rank or tuple-of-ranks} required len(shape) for the slot's
+        members with declared shapes.
+    dtype_family: {slot: family} where family is a dtype-name prefix
+        ("float", "int", "bool", "uint") every declared member dtype must
+        start with.
+    """
+
+    def __init__(self, same_dtype=(), ranks=None, dtype_family=None):
+        self.same_dtype = tuple(tuple(g) for g in same_dtype)
+        self.ranks = dict(ranks or {})
+        self.dtype_family = dict(dtype_family or {})
+
+
+_ELEMENTWISE = OpSignature(same_dtype=[("X", "Y")])
+
+#: op type -> signature for the built-in op set. Extend alongside new ops.
+_SIGNATURES = {
+    # no rank constraint on mul: x/y_num_col_dims flatten arbitrary ranks
+    "mul": OpSignature(same_dtype=[("X", "Y")]),
+    "matmul": OpSignature(same_dtype=[("X", "Y")]),
+    "elementwise_add": _ELEMENTWISE,
+    "elementwise_sub": _ELEMENTWISE,
+    "elementwise_mul": _ELEMENTWISE,
+    "elementwise_div": _ELEMENTWISE,
+    "elementwise_min": _ELEMENTWISE,
+    "elementwise_max": _ELEMENTWISE,
+    "elementwise_pow": _ELEMENTWISE,
+    "sum": OpSignature(same_dtype=[("X",)]),
+    "fc": OpSignature(
+        same_dtype=[("Input", "W", "Bias")], ranks={"W": 2, "Bias": 1}
+    ),
+    "conv2d": OpSignature(
+        same_dtype=[("Input", "Filter")], ranks={"Filter": 4}
+    ),
+    "depthwise_conv2d": OpSignature(
+        same_dtype=[("Input", "Filter")], ranks={"Filter": 4}
+    ),
+    "batch_norm": OpSignature(
+        ranks={"Scale": 1, "Bias": 1, "Mean": 1, "Variance": 1},
+        dtype_family={"X": "float"},
+    ),
+    "scaled_dot_product_attention": OpSignature(
+        same_dtype=[("Q", "K", "V")], ranks={"Q": 4, "K": 4, "V": 4}
+    ),
+    "lookup_table": OpSignature(
+        dtype_family={"Ids": "int", "W": "float"}, ranks={"W": 2}
+    ),
+    "lookup_table_v2": OpSignature(
+        dtype_family={"Ids": "int", "W": "float"}, ranks={"W": 2}
+    ),
+    "sgd": OpSignature(same_dtype=[("Param", "Grad")]),
+    "softmax": OpSignature(dtype_family={"X": "float"}),
+    "layer_norm": OpSignature(dtype_family={"X": "float"}),
+    "dropout": OpSignature(dtype_family={"X": "float"}),
+}
+
+
+def get_signature(op_type):
+    """Signature for `op_type`, or None. An OpDef-attached signature wins
+    over the built-in table."""
+    from paddle_tpu.core.registry import OpRegistry
+
+    if OpRegistry.has(op_type):
+        sig = getattr(OpRegistry.get(op_type), "signature", None)
+        if sig is not None:
+            return sig
+    return _SIGNATURES.get(op_type)

@@ -256,10 +256,16 @@ def _collapse_segments(block, ops, checkpoints, loss_name, requires):
     outside segments; 1-op segments aren't worth a replay."""
     ckpt_set = set(checkpoints)
     walk, cur = [], []
-    readers = {}
-    for o in block.ops:
-        for n in o.input_names():
-            readers.setdefault(n, set()).add(id(o))
+    # control-flow-aware readers (analysis/usedef.py): a var read inside a
+    # while/cond body counts its control-flow op as a reader, so a segment
+    # producing it keeps it as a boundary output instead of replay-privat-
+    # izing a value a sub-block needs
+    from paddle_tpu.analysis.usedef import build_usedef
+
+    readers = {
+        n: {id(c) for c in cons}
+        for n, cons in build_usedef(block).consumers.items()
+    }
 
     def flush():
         nonlocal cur

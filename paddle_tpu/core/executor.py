@@ -44,47 +44,11 @@ CONTROL_FLOW_OPS = {"while", "conditional_block"}
 ELIDED_OPS = {"feed", "fetch"}
 
 
-def _subtree_io(program, op, reads, writes):
-    """All names read/written by `op` including nested sub-blocks."""
-    reads.update(op.input_names())
-    writes.update(op.output_names())
-    for attr in ("sub_block", "sub_block_false"):
-        idx = op.attrs.get(attr)
-        if idx is None:
-            continue
-        sub = program.block(idx)
-        for sop in sub.ops:
-            _subtree_io(program, sop, reads, writes)
-
-
-def live_ops(block, fetch_names):
-    """Dead-op elimination before planning (reference: paddle/fluid/framework/
-    prune.cc): keep ops that (transitively) feed a fetch, write persistable
-    state (optimizer/metric updates), or have side effects. Dropping dead ops
-    here — not in XLA DCE — matters because a dead op's inputs would otherwise
-    become mandatory feeds. Control-flow ops write loop-carried state through
-    their sub-blocks, so keep/needed decisions use the whole sub-tree's
-    reads+writes (nested blocks included)."""
-    needed = set(fetch_names)
-    keep = [False] * len(block.ops)
-    for i in range(len(block.ops) - 1, -1, -1):
-        op = block.ops[i]
-        if op.type in ELIDED_OPS:
-            continue
-        reads, writes = set(), set()
-        _subtree_io(block.program, op, reads, writes)
-        writes_persistable = any(
-            (v := block._find_var_recursive(n)) is not None and v.persistable
-            for n in writes
-        )
-        stateful_side_effect = op.type in (
-            "print", "py_func", "distributed_push_sparse",
-            "push_box_sparse", "save", "save_combine",
-        )
-        if writes_persistable or stateful_side_effect or (writes & needed):
-            keep[i] = True
-            needed.update(reads)
-    return [op for op, k in zip(block.ops, keep) if k]
+# the use-def/liveness computation lives in the shared static-analysis
+# layer (one control-flow-aware implementation for the executor's planner,
+# the DCE/fusion passes, and the verifier); re-exported here because this
+# module is its historical home
+from paddle_tpu.analysis.usedef import live_ops  # noqa: E402
 
 
 def _interpret_block(block, env, rng_key, use_pallas=True, ops=None):

@@ -523,15 +523,20 @@ class Program:
 
     def _prune(self, targets):
         """Prune to the subgraph needed for `targets`
-        (reference: paddle/fluid/framework/prune.cc)."""
+        (reference: paddle/fluid/framework/prune.cc). Reads/writes are
+        control-flow aware (analysis/usedef.py): a while op whose BODY
+        writes a target survives, and its body's reads stay needed."""
+        from paddle_tpu.analysis.usedef import build_usedef
+
         target_names = {t.name if isinstance(t, Variable) else t for t in targets}
         block = self.global_block()
+        usedef = build_usedef(block)
         needed = set(target_names)
         kept = []
         for op in reversed(block.ops):
-            if any(n in needed for n in op.output_names()):
+            if usedef.writes_of(op) & needed:
                 kept.append(op)
-                needed.update(op.input_names())
+                needed.update(usedef.reads_of(op))
         block.ops = list(reversed(kept))
         self._bump_version()
         return self

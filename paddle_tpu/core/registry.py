@@ -39,12 +39,17 @@ class OpDef:
         needs_base_rng=False,
         needs_block=False,
         needs_out_counts=False,
+        signature=None,
     ):
         self.type = type
         self.lower = lower
         self.infer_shape = infer_shape
         self.grad = grad
         self.pallas = pallas
+        # optional static signature (analysis/signatures.py OpSignature):
+        # rank/dtype constraints the program verifier checks op descs
+        # against without tracing the lowering
+        self.signature = signature
         # input slots that never receive gradients (indices, masks, ...)
         self.nondiff_inputs = frozenset(nondiff_inputs)
         # stateful ops (random, print, ...) must not be CSE'd away
@@ -92,7 +97,7 @@ class OpRegistry:
         return sorted(cls._ops)
 
 
-def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False, needs_block=False, needs_out_counts=False):
+def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(), stateful=False, needs_base_rng=False, needs_block=False, needs_out_counts=False, signature=None):
     """Decorator form:  @register_op("relu")  def _(ins, attrs): ..."""
 
     def deco(fn):
@@ -108,6 +113,7 @@ def register_op(type, infer_shape=None, grad=None, pallas=None, nondiff_inputs=(
                 needs_base_rng=needs_base_rng,
                 needs_block=needs_block,
                 needs_out_counts=needs_out_counts,
+                signature=signature,
             )
         )
         return fn

@@ -115,12 +115,21 @@ class RemoteLookupContext:
             with self._push_cv:
                 observed = self.stats["pushes"]
             if fence == observed:
-                self.stats["prefetch_hits"] += 1
-                return fut.result()
-            # mispredicted fence: the future either pulled too early
-            # (stale rows) or waits on pushes this very step must produce
-            # (would deadlock) — drop it and pull fresh
-            self.stats["stale_prefetch"] += 1
+                pulled_at, rows = fut.result()
+                if pulled_at >= fence:
+                    self.stats["prefetch_hits"] += 1
+                    return rows
+                # the background pull timed out waiting for the fence and
+                # read PRE-push rows; the pushes landed afterwards, so the
+                # current count looks right but the rows are stale —
+                # validate the count recorded AT pull time, never the
+                # count now (ADVICE r5 low)
+                self.stats["stale_prefetch"] += 1
+            else:
+                # mispredicted fence: the future either pulled too early
+                # (stale rows) or waits on pushes this very step must
+                # produce (would deadlock) — drop it and pull fresh
+                self.stats["stale_prefetch"] += 1
         self.stats["pulls"] += 1
         return self._pull_now(name, ids)
 
@@ -157,8 +166,13 @@ class RemoteLookupContext:
         return fence
 
     def _pull_after(self, name, ids, min_pushes):
-        if min_pushes:
-            with self._push_cv:
+        """Returns (pushes_observed_at_pull_time, rows). The observed count
+        is recorded BEFORE the pull so it is a lower bound on the rows'
+        freshness — pull() accepts the future only when that recorded count
+        has reached the fence (a 60s-timeout early pull records a smaller
+        count and is rejected instead of being served as a fresh hit)."""
+        with self._push_cv:
+            if min_pushes:
                 # timeout fallback: a failed step would otherwise wedge
                 # every later prefetch behind a push that never comes
                 self._push_cv.wait_for(
@@ -168,7 +182,8 @@ class RemoteLookupContext:
                 )
                 if self._closed:
                     raise RuntimeError("remote lookup context closed")
-        return self._pull_now(name, ids)
+            observed = self.stats["pushes"]
+        return observed, self._pull_now(name, ids)
 
     def push(self, name, ids, grad):
         """Merge duplicate-id grads (sum — dense scatter-add semantics) and

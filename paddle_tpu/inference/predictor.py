@@ -52,6 +52,7 @@ class Config:
         self._precision = PrecisionType.Float32
         self._passes = None  # None = default pipeline
         self._deleted_passes = set()
+        self._verify_each_pass = False
         self._options = {}
 
     # -- model location (reference: AnalysisConfig::SetModel — updates only
@@ -90,6 +91,12 @@ class Config:
     # -- analysis (reference: SwitchIrOptim / pass_builder) ----------------
     def switch_ir_optim(self, x=True):
         self._ir_optim = x
+
+    def enable_program_verification(self, x=True):
+        """Run the IR verifier (analysis/verify.py) after every analysis
+        pass; a pass that breaks a program invariant raises naming the
+        pass instead of serving a silently-corrupted model."""
+        self._verify_each_pass = x
 
     def ir_optim(self):
         return self._ir_optim
@@ -280,7 +287,10 @@ class Predictor:
             bf16_white_list=self._config._options.get("bf16_white_list"),
             bf16_black_list=self._config._options.get("bf16_black_list"),
         )
-        pm = PassManager(self._config.analysis_passes())
+        pm = PassManager(
+            self._config.analysis_passes(),
+            verify_each_pass=self._config._verify_each_pass,
+        )
         self._program = pm.run(self._program, ctx)
         if self._config.precision() != PrecisionType.Float32:
             self._fold_param_casts()

@@ -238,21 +238,22 @@ def save_inference_model(
     target_names = [t if isinstance(t, str) else t.name for t in target_vars]
     infer._prune(target_names)
 
-    # verify feeds suffice for targets
-    needed = set()
-    produced = set(feeded_var_names)
-    for op in infer.global_block().ops:
-        for n in op.input_names():
-            if n not in produced:
-                needed.add(n)
-        produced.update(op.output_names())
-    block = infer.global_block()
-    for n in needed:
-        v = block._find_var_recursive(n)
-        enforce(
-            v is not None and (v.persistable or v.is_data or n in feeded_var_names),
-            f"inference program reads {n} which is neither fed nor persistable",
+    # verify the pruned program is well-formed and the feeds suffice for the
+    # targets before anything touches disk — a saved-then-broken model fails
+    # here with op attribution, not at load/serve time
+    from paddle_tpu.analysis.verify import verify_program
+
+    errors = [
+        d for d in verify_program(
+            infer, feed_names=feeded_var_names, fetch_names=target_names,
         )
+        if d.severity == "error"
+    ]
+    enforce(
+        not errors,
+        "inference program failed verification:\n"
+        + "\n".join(str(d) for d in errors),
+    )
 
     infer._attrs["feed_var_names"] = list(feeded_var_names)
     infer._attrs["fetch_var_names"] = target_names

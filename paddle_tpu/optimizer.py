@@ -15,6 +15,21 @@ from paddle_tpu.layers import tensor as tensor_layers
 from paddle_tpu.utils import unique_name
 from paddle_tpu.utils.enforce import enforce
 
+#: every accumulator slot name the optimizers use (accumulator vars are
+#: named f"{param}_{slot}_{idx}", see _add_accumulator). Seeded with the
+#: built-in optimizers' slots and grown at _add_accumulator time, so a new
+#: optimizer's slots join automatically once it runs. parallel/sharding.py
+#: restricts optimizer-slot partition-spec inheritance to THESE suffixes —
+#: an unrelated user var that merely prefix-extends a param name must not
+#: silently inherit its sharding.
+ACCUMULATOR_SLOT_NAMES = {
+    "velocity", "moment", "moment1", "moment2",
+    "beta1_pow_acc", "beta2_pow_acc", "inf_norm",
+    "_avg_squared_grad", "_avg_squared_update",
+    "momentum", "mean_square", "mean_grad",
+    "squared", "linear", "dgc_u", "dgc_v",
+}
+
 _OP_ROLE_OPTIMIZE = 2
 
 
@@ -71,6 +86,7 @@ class Optimizer:
 
     # -- accumulators -------------------------------------------------
     def _add_accumulator(self, name, param, fill_value=0.0, dtype="float32", shape=None):
+        ACCUMULATOR_SLOT_NAMES.add(name)
         acc = self._accumulators.setdefault(name, {})
         if param.name in acc:
             return acc[param.name]

@@ -70,16 +70,62 @@ def check_spec(shape, spec, mesh):
     return spec
 
 
-def _slot_parent(name, name_set):
+def known_slot_suffixes():
+    """Accumulator slot names — the ONLY suffixes that mark a var as an
+    optimizer slot of its prefix parameter. Anything else extending a
+    param's name with '_' is a user var (e.g. 'emb' vs 'emb_table') and
+    must NOT silently inherit the param's partition spec (ADVICE r5 low);
+    analysis/verify.py warns when that inheritance is skipped. The
+    canonical set lives in optimizer.py next to the _add_accumulator call
+    sites and grows when a new optimizer creates a slot, so the two can't
+    drift apart."""
+    from paddle_tpu.optimizer import ACCUMULATOR_SLOT_NAMES
+
+    return frozenset(ACCUMULATOR_SLOT_NAMES)
+
+
+_slot_re_cache = {}
+
+
+def _slot_suffix_re():
+    suffixes = known_slot_suffixes()
+    cached = _slot_re_cache.get(suffixes)
+    if cached is None:
+        cached = re.compile(
+            r"^(?:%s)(?:_\d+)?$" % "|".join(
+                re.escape(s) for s in sorted(suffixes)
+            )
+        )
+        _slot_re_cache[suffixes] = cached
+    return cached
+
+
+def _prefix_parent(name, name_set):
     """Longest member of `name_set` that `name` extends as ``parent_<suffix>``
-    — resolves optimizer accumulators (named f"{param}_{slot}_{idx}",
-    optimizer.py:77) to their parameter even when the parameter name itself
-    ends in ``_0`` (default fc naming)."""
+    (any suffix) — the raw prefix relation, used by the verifier to spot
+    near-miss slot names."""
     best = None
     for p in name_set:
         if p != name and name.startswith(p + "_"):
             if best is None or len(p) > len(best):
                 best = p
+    return best
+
+
+def _slot_parent(name, name_set):
+    """Longest member of `name_set` that `name` extends as
+    ``parent_<slot>[_<idx>]`` where <slot> is a known optimizer-accumulator
+    name (optimizer.py:77 names slots f"{param}_{slot}_{idx}") — resolves
+    accumulators to their parameter even when the parameter name itself ends
+    in ``_0`` (default fc naming), without capturing unrelated user vars
+    that merely share a prefix."""
+    slot_re = _slot_suffix_re()
+    best = None
+    for p in name_set:
+        if p != name and name.startswith(p + "_"):
+            if slot_re.match(name[len(p) + 1:]):
+                if best is None or len(p) > len(best):
+                    best = p
     return best
 
 
@@ -91,8 +137,10 @@ def derive_shardings(names, shapes, mesh, rules=None, overrides=None):
     Adam moments stayed replicated makes GSPMD gather the FULL weight every
     step to reconcile the update (caught by tests/test_hlo.py
     test_tp_mesh_no_weight_sized_collectives) — so when a name matches no
-    explicit rule, its longest-prefix parent's spec applies. Scalar slots
-    (beta_pow) fall back to replicated via check_spec's rank guard."""
+    explicit rule and extends a parameter's name with a known accumulator
+    suffix (known_slot_suffixes(), canonical set in
+    optimizer.ACCUMULATOR_SLOT_NAMES), the parent's spec applies. Scalar
+    slots (beta_pow) fall back to replicated via check_spec's rank guard."""
     rules = rules if rules is not None else MEGATRON_RULES
     overrides = overrides or {}
     name_set = set(names)

@@ -12,9 +12,17 @@ through a PassManager.
 
 A pass is a callable `(Program, PassContext) -> Program` registered by name.
 Passes may mutate in place and return the same Program, or return a new one.
+
+Producer/consumer reasoning inside passes goes through the shared
+control-flow-aware use-def analysis (analysis/usedef.py) — a var read only by
+a while/conditional_block body still counts as consumed, so fusions can't
+delete a producer a sub-block reads. `PassManager(verify_each_pass=True)`
+runs the program verifier (analysis/verify.py) after every pass and raises
+naming the pass that broke an invariant.
 """
 
-from paddle_tpu.utils.enforce import enforce
+from paddle_tpu.analysis.usedef import build_usedef
+from paddle_tpu.utils.enforce import EnforceError, enforce
 
 __all__ = [
     "register_pass",
@@ -62,18 +70,55 @@ class PassContext:
 
 class PassManager:
     """Apply a sequence of named passes (reference:
-    paddle/fluid/inference/analysis/ir_pass_manager.cc:36)."""
+    paddle/fluid/inference/analysis/ir_pass_manager.cc:36).
 
-    def __init__(self, pass_names):
+    With ``verify_each_pass=True`` the program verifier
+    (analysis/verify.py) runs after every pass; a pass that introduces a
+    NEW error-grade diagnostic (relative to the program as it entered the
+    manager) raises EnforceError naming that pass. Per-pass diagnostics are
+    recorded under ``ctx.stats['verify'][pass_name]`` either way."""
+
+    def __init__(self, pass_names, verify_each_pass=False):
         self.pass_names = list(pass_names)
+        self.verify_each_pass = verify_each_pass
         for n in self.pass_names:
             get_pass(n)  # fail fast on unknown names
 
+    def _verify(self, program, ctx):
+        from paddle_tpu.analysis.verify import verify_program
+
+        return verify_program(
+            program, feed_names=ctx.feed_names, fetch_names=ctx.fetch_names,
+        )
+
     def run(self, program, ctx=None):
         ctx = ctx or PassContext()
+        seen = None
+        if self.verify_each_pass:
+            # pre-existing diagnostics are the caller's, not a pass's
+            seen = {d.key() for d in self._verify(program, ctx)}
         for name in self.pass_names:
             out = get_pass(name)(program, ctx)
             program = out if out is not None else program
+            if self.verify_each_pass:
+                diags = self._verify(program, ctx)
+                for d in diags:
+                    d.pass_name = name
+                fresh = [
+                    d for d in diags
+                    if d.severity == "error" and d.key() not in seen
+                ]
+                ctx.stats.setdefault("verify", {})[name] = [
+                    str(d) for d in diags if d.key() not in seen
+                ]
+                if fresh:
+                    detail = "\n".join(str(d) for d in fresh)
+                    raise EnforceError(
+                        f"pass '{name}' broke program invariants "
+                        f"({len(fresh)} new error"
+                        f"{'s' if len(fresh) > 1 else ''}):\n{detail}"
+                    )
+                seen |= {d.key() for d in diags}
         return program
 
 
@@ -87,7 +132,7 @@ def _dce_pass(program, ctx):
     """Drop ops that don't (transitively) feed a fetch and have no side
     effects (reference: paddle/fluid/framework/prune.cc). Requires
     ctx.fetch_names."""
-    from paddle_tpu.core.executor import live_ops
+    from paddle_tpu.analysis.usedef import live_ops
 
     if not ctx.fetch_names:
         return program
@@ -270,13 +315,7 @@ def _sparse_weight_update_pass(program, ctx):
                                              "skipped": "microbatched"}
         return program
     block = program.global_block()
-    producers = {}
-    consumers = {}
-    for op in block.ops:
-        for n in op.output_names():
-            producers.setdefault(n, []).append(op)
-        for n in op.input_names():
-            consumers.setdefault(n, []).append(op)
+    usedef = build_usedef(block)
 
     lookup_types = {"lookup_table_grad", "lookup_table_v2_grad"}
     rewrites = []  # (sgd_op, grad_op)
@@ -284,8 +323,8 @@ def _sparse_weight_update_pass(program, ctx):
         if op.type != "sgd":
             continue
         gname = op.inputs["Grad"][0]
-        prods = producers.get(gname, [])
-        cons = consumers.get(gname, [])
+        prods = usedef.producers.get(gname, [])
+        cons = usedef.consumers.get(gname, [])
         v = block.vars.get(gname)
         if (
             len(prods) == 1
@@ -354,42 +393,17 @@ def apply_deferred_sparse_rewrite(program):
 # ---------------------------------------------------------------------------
 
 
-def _build_use_maps(block, fetch_names):
-    producers, consumers = {}, {}
-    for op in block.ops:
-        for n in op.output_names():
-            producers.setdefault(n, []).append(op)
-        for n in op.input_names():
-            consumers.setdefault(n, []).append(op)
-    protected = set(fetch_names)
-    for v in block.vars.values():
-        if v.persistable:
-            protected.add(v.name)
-    return producers, consumers, protected
-
-
-def _sole_consumer(consumers, protected, name, op=None):
-    """The single op consuming `name`, or None if the var escapes (multiple
-    readers, fetched, or persistable)."""
-    if name in protected:
-        return None
-    cons = consumers.get(name, [])
-    if len(cons) != 1:
-        return None
-    if op is not None and cons[0] is not op:
-        return None
-    return cons[0]
-
-
 @register_pass("fc_fuse")
 def _fc_fuse_pass(program, ctx):
     """mul + elementwise_add(1-D bias) [+ activation] -> one `fc` op
     (reference: paddle/fluid/framework/ir/fc_fuse_pass.cc:1). Shrinks the
-    traced inference program; XLA sees one fused dot+bias+act region."""
+    traced inference program; XLA sees one fused dot+bias+act region.
+
+    Use maps come from analysis/usedef.py, so an intermediate read by a
+    while/conditional_block body counts its control-flow op as a consumer
+    and the pattern correctly refuses to swallow it."""
     block = program.global_block()
-    producers, consumers, protected = _build_use_maps(
-        block, ctx.fetch_names
-    )
+    usedef = build_usedef(block, ctx.fetch_names)
     drop = set()
     rewrites = {}  # id(mul op) -> replacement Operator
     from paddle_tpu.core.ir import Operator
@@ -412,7 +426,7 @@ def _fc_fuse_pass(program, ctx):
             continue  # the fc lowering assumes a 2-D weight
         k = op.attrs.get("x_num_col_dims", 1)
         out = op.outputs["Out"][0]
-        add = _sole_consumer(consumers, protected, out)
+        add = usedef.sole_consumer(out)
         if add is None or add.type != "elementwise_add":
             continue
         if add.inputs["X"][0] != out:  # bias must be the Y operand
@@ -426,7 +440,7 @@ def _fc_fuse_pass(program, ctx):
         if bias_var is None or not bias_var.shape or len(bias_var.shape) != 1:
             continue
         add_out = add.outputs["Out"][0]
-        act_op = _sole_consumer(consumers, protected, add_out)
+        act_op = usedef.sole_consumer(add_out)
         act = ""
         final_out = add_out
         tail = [op, add]
@@ -483,9 +497,7 @@ def _conv_bn_fuse_pass(program, ctx):
         ctx.stats["conv_bn_fuse"] = {"fused": 0, "skipped": "no scope"}
         return program
     block = program.global_block()
-    producers, consumers, protected = _build_use_maps(
-        block, ctx.fetch_names
-    )
+    usedef = build_usedef(block, ctx.fetch_names)
     drop = set()
     replacements = {}  # id(bn op) -> new bias-add Operator
     fused = 0
@@ -495,7 +507,7 @@ def _conv_bn_fuse_pass(program, ctx):
         if op.attrs.get("data_format", "NCHW") not in ("NCHW", "AnyLayout"):
             continue
         conv_out = op.outputs["Output"][0]
-        nxt = _sole_consumer(consumers, protected, conv_out)
+        nxt = usedef.sole_consumer(conv_out)
         bias_add = None
         bn = nxt
         if nxt is not None and nxt.type == "elementwise_add":
@@ -503,7 +515,7 @@ def _conv_bn_fuse_pass(program, ctx):
             if y is None or not y.persistable:
                 continue
             bias_add = nxt
-            bn = _sole_consumer(consumers, protected, nxt.outputs["Out"][0])
+            bn = usedef.sole_consumer(nxt.outputs["Out"][0])
         if bn is None or bn.type != "batch_norm":
             continue
         if not bn.attrs.get("is_test"):
@@ -519,13 +531,15 @@ def _conv_bn_fuse_pass(program, ctx):
             for slot in ("MeanOut", "VarianceOut", "SavedMean",
                          "SavedVariance")
             for n in bn.outputs.get(slot, ())
-            if any(c is not bn for c in consumers.get(n, ()))
+            if any(c is not bn for c in usedef.consumers.get(n, ()))
         ]
         if side:
             continue
         w_name = op.inputs["Filter"][0]
-        if len(consumers.get(w_name, [])) != 1:
-            continue  # shared filter: folding would corrupt the other use
+        if len(usedef.consumers.get(w_name, [])) != 1:
+            # shared filter: folding would corrupt the other use (sub-block
+            # conv reads count — they appear via their control-flow op)
+            continue
         names = {
             "scale": bn.inputs["Scale"][0],
             "shift": bn.inputs["Bias"][0],
@@ -602,9 +616,7 @@ def _multihead_fuse_pass(program, ctx):
     from paddle_tpu.core.ir import Operator
 
     block = program.global_block()
-    producers, consumers, protected = _build_use_maps(
-        block, ctx.fetch_names
-    )
+    usedef = build_usedef(block, ctx.fetch_names)
     drop = set()
     rewrites = {}  # id(qk matmul) -> list of replacement Operators
     fused = 0
@@ -614,28 +626,24 @@ def _multihead_fuse_pass(program, ctx):
         if sm.attrs.get("axis", -1) not in (-1, 3):
             continue
         sm_in = sm.inputs["X"][0]
-        prod = producers.get(sm_in, [])
+        prod = usedef.producers.get(sm_in, [])
         if len(prod) != 1:
             continue
         add = None
         qk = prod[0]
         if qk.type == "elementwise_add":
             add = qk
-            p2 = producers.get(add.inputs["X"][0], [])
+            p2 = usedef.producers.get(add.inputs["X"][0], [])
             if len(p2) != 1:
                 continue
             qk = p2[0]
-            if _sole_consumer(consumers, protected, qk.outputs["Out"][0],
-                              add) is None:
+            if usedef.sole_consumer(qk.outputs["Out"][0], add) is None:
                 continue
         if qk.type != "matmul" or not qk.attrs.get("transpose_Y"):
             continue
         if qk.attrs.get("transpose_X"):
             continue
-        if _sole_consumer(
-            consumers, protected,
-            (add or qk).outputs["Out"][0], sm,
-        ) is None:
+        if usedef.sole_consumer((add or qk).outputs["Out"][0], sm) is None:
             continue
         q_name = qk.inputs["X"][0]
         k_name = qk.inputs["Y"][0]
@@ -643,7 +651,7 @@ def _multihead_fuse_pass(program, ctx):
         if qv is None or qv.shape is None or len(qv.shape) != 4:
             continue  # [B, H, S, D] attention only
         # downstream: softmax -> (dropout) -> matmul(p, v)
-        pv = _sole_consumer(consumers, protected, sm.outputs["Out"][0])
+        pv = usedef.sole_consumer(sm.outputs["Out"][0])
         dropout = None
         if pv is not None and pv.type == "dropout":
             impl = pv.attrs.get(
@@ -657,14 +665,13 @@ def _multihead_fuse_pass(program, ctx):
                 continue
             # dropping the op must not orphan a live Mask reader
             if any(
-                consumers.get(n)
+                usedef.consumers.get(n)
                 for n in pv.outputs.get("Mask", ())
-            ) or any(n in protected for n in pv.outputs.get("Mask", ())):
+            ) or any(n in usedef.protected
+                     for n in pv.outputs.get("Mask", ())):
                 continue
             dropout = pv
-            pv = _sole_consumer(
-                consumers, protected, dropout.outputs["Out"][0]
-            )
+            pv = usedef.sole_consumer(dropout.outputs["Out"][0])
         if (
             pv is None
             or pv.type != "matmul"
@@ -691,7 +698,7 @@ def _multihead_fuse_pass(program, ctx):
             if len(bshape) == 4 and bshape[1] == 1 and bshape[2] == 1:
                 # [B,1,1,S]: reuse the pre-reshape [B,S] source if there is
                 # one, else flatten here
-                bprod = producers.get(bias_name, [])
+                bprod = usedef.producers.get(bias_name, [])
                 src = None
                 if len(bprod) == 1 and bprod[0].type in ("reshape2",
                                                          "reshape"):

@@ -179,3 +179,64 @@ def test_predictor_applies_fusion_passes(rng, tmp_path):
         predictor.get_output_names()[0]
     ).copy_to_cpu()
     np.testing.assert_allclose(ref, out, rtol=1e-4, atol=1e-5)
+
+
+def test_fc_fuse_skips_intermediate_read_by_while_body(rng):
+    """ADVICE r5 medium regression: the fc pattern's MUL output (the
+    intermediate the fusion would swallow) is also read inside a while
+    body — desc-level the while op lists only its Condition input, so a
+    consumer map built from op descs alone would let fc_fuse delete the
+    mul whose output the loop body reads. The control-flow-aware use maps
+    (analysis/usedef.py) must refuse the fusion, and the program must
+    still run."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 8], dtype="float32")
+        # hand-rolled fc pattern so the INTERMEDIATE (mul out) is nameable
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("fcw")
+        w = helper.create_parameter(
+            fluid.ParamAttr(name="fcw_w"), shape=[8, 4], dtype="float32"
+        )
+        b = helper.create_parameter(
+            fluid.ParamAttr(name="fcw_b"), shape=[4], dtype="float32"
+        )
+        m = fluid.layers.mul(x, w)
+        h = fluid.layers.elementwise_add(m, b)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 3.0)
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(i, limit)
+        with fluid.layers.While(cond):
+            t = fluid.layers.reduce_sum(m)  # sub-block read of the mul out
+            ns = fluid.layers.elementwise_add(s, t)
+            fluid.layers.assign(ns, s)
+            ni = fluid.layers.increment(i, value=1.0, in_place=False)
+            fluid.layers.assign(ni, i)
+            fluid.layers.less_than(i, limit, cond=cond)
+        y = fluid.layers.elementwise_add(
+            fluid.layers.reduce_sum(h), s
+        )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    feed = {"x": rng.randn(2, 8).astype("float32")}
+    before = _run(main, feed, [y.name], scope)[0]
+
+    infer = main.clone(for_test=True)
+    ctx = PassContext(scope=scope, fetch_names=[y.name])
+    get_pass("fc_fuse")(infer, ctx)
+    # the mul out m is consumed by the while body through its control-flow
+    # op: the mul+add pair must survive un-fused
+    assert ctx.stats["fc_fuse"]["fused"] == 0
+    types = _op_types(infer)
+    assert "mul" in types and "fc" not in types
+    # and the verifier agrees the pass left the program intact
+    from paddle_tpu.analysis import verify_program
+
+    assert verify_program(infer, feed_names=["x"],
+                          fetch_names=[y.name]) == []
+    after = _run(infer, feed, [y.name], scope)[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6, atol=1e-6)

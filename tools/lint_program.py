@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Lint a serialized program (train or inference) with the program verifier.
+
+Runs analysis/verify.py over a program file and exits nonzero when errors
+are found — the CI hook that keeps every serialized/example program
+well-formed (use-before-def, dangling vars, dtype/rank violations, orphaned
+sub-blocks) on every PR.
+
+Accepts either a raw ``Program.to_bytes()`` JSON file or a saved inference
+``__model__`` (whose desc embeds feed/fetch names — they are used as the
+lint's feed/fetch context automatically). ``--builtin`` lints a
+freshly-built model program instead of a file.
+
+Usage:
+  python tools/lint_program.py path/to/__model__ [path2 ...]
+  python tools/lint_program.py --builtin mnist --builtin transformer
+  python tools/lint_program.py model.json --feed x,y --fetch loss \\
+      [--json] [--warnings-as-errors]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUILTINS = ("mnist", "mnist_conv", "transformer")
+
+
+def _load_program(path):
+    """Load a serialized program; returns (program, feed_names, fetch_names).
+    Handles both Program.to_bytes() output and save_inference_model's
+    __model__ desc (feed/fetch names embedded)."""
+    from paddle_tpu.core.ir import Program
+
+    with open(path, "rb") as f:
+        data = f.read()
+    # from_bytes only reads format_version/random_seed/blocks, so the
+    # embedded feed/fetch keys of a saved __model__ can ride along
+    desc = json.loads(data.decode("utf-8"))
+    program = Program.from_bytes(data)
+    return (program, desc.get("feed_var_names", []),
+            desc.get("fetch_var_names", []))
+
+
+def _build_builtin(name):
+    """Build a known model's train program in-process (no training, no
+    execution) — lints the graph builders themselves."""
+    import paddle_tpu as fluid
+
+    if name in ("mnist", "mnist_conv"):
+        from paddle_tpu.models import mnist
+
+        main, startup, feeds, fetches = mnist.build_mnist_train(
+            use_conv=(name == "mnist_conv")
+        )
+    elif name == "transformer":
+        from paddle_tpu.models import transformer as tfm
+
+        main, startup, feeds, fetches = tfm.build_wmt_train(
+            tfm.TransformerConfig.tiny(), src_len=8, tgt_len=8,
+            optimizer=fluid.optimizer.Adam(1e-3),
+        )
+    else:
+        raise SystemExit(f"unknown --builtin '{name}'; have {BUILTINS}")
+    feed_names = [f if isinstance(f, str) else f.name for f in feeds]
+    fetch_names = [f if isinstance(f, str) else f.name for f in fetches]
+    return main, feed_names, fetch_names
+
+
+def lint(program, feed_names, fetch_names, label, as_json=False,
+         warnings_as_errors=False, out=sys.stdout):
+    """Verify one program; returns the number of gating findings."""
+    from paddle_tpu.analysis.verify import verify_program
+
+    diags = verify_program(
+        program, feed_names=feed_names, fetch_names=fetch_names
+    )
+    errors = [d for d in diags if d.severity == "error"]
+    gating = diags if warnings_as_errors else errors
+    if as_json:
+        out.write(json.dumps({
+            "program": label,
+            "errors": len(errors),
+            "warnings": len(diags) - len(errors),
+            "diagnostics": [
+                {
+                    "severity": d.severity,
+                    "code": d.code,
+                    "message": d.message,
+                    "block": d.block_idx,
+                    "op_index": d.op_index,
+                    "op_type": d.op_type,
+                    "var": d.var,
+                }
+                for d in diags
+            ],
+        }) + "\n")
+    else:
+        for d in diags:
+            out.write(f"{label}: {d}\n")
+        out.write(
+            f"{label}: {len(errors)} error(s), "
+            f"{len(diags) - len(errors)} warning(s)\n"
+        )
+    return len(gating)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Lint serialized programs with the IR verifier"
+    )
+    ap.add_argument("programs", nargs="*", help="serialized program files")
+    ap.add_argument("--builtin", action="append", default=[],
+                    choices=BUILTINS,
+                    help="lint a freshly-built known model program")
+    ap.add_argument("--feed", default="",
+                    help="comma-separated feed names (files without "
+                    "embedded feed names)")
+    ap.add_argument("--fetch", default="",
+                    help="comma-separated fetch names")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON report line per program")
+    ap.add_argument("--warnings-as-errors", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.programs and not args.builtin:
+        ap.error("nothing to lint: pass program files and/or --builtin")
+
+    feed = [n for n in args.feed.split(",") if n]
+    fetch = [n for n in args.fetch.split(",") if n]
+
+    failures = 0
+    for path in args.programs:
+        program, ffeed, ffetch = _load_program(path)
+        failures += lint(
+            program, ffeed or feed, ffetch or fetch, os.path.basename(path),
+            as_json=args.as_json, warnings_as_errors=args.warnings_as_errors,
+        )
+    for name in args.builtin:
+        program, bfeed, bfetch = _build_builtin(name)
+        failures += lint(
+            program, bfeed, bfetch, f"builtin:{name}",
+            as_json=args.as_json, warnings_as_errors=args.warnings_as_errors,
+        )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
