@@ -205,6 +205,10 @@ struct PD_Program {
   std::string last_op_type;
 };
 
+struct PD_ServingEngine {
+  PyObject* obj = nullptr;  // bridge _ServingHandle
+};
+
 extern "C" {
 
 PD_AnalysisConfig* PD_NewAnalysisConfig(void) { return new PD_AnalysisConfig; }
@@ -338,6 +342,116 @@ int PD_GetOutput(PD_Predictor* p, const char* name, PD_DataType* dtype,
 void PD_Free(void* ptr) { free(ptr); }
 
 const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+/* -- online serving ----------------------------------------------------- */
+
+PD_ServingEngine* PD_NewServingEngine(const PD_AnalysisConfig* c,
+                                      int max_batch, int max_seq,
+                                      int queue_depth, int max_wait_ms,
+                                      int num_replicas) {
+  if (!ensure_python()) return nullptr;
+  GIL gil;
+  PyObject* obj = bridge_call(
+      "new_serving_engine",
+      Py_BuildValue("(sssiiiiiii)", c->model_dir.c_str(),
+                    c->prog_file.c_str(), c->params_file.c_str(),
+                    c->use_tpu ? 1 : 0, c->device_id, max_batch, max_seq,
+                    queue_depth, max_wait_ms, num_replicas));
+  if (!obj) return nullptr;
+  auto* e = new PD_ServingEngine;
+  e->obj = obj;
+  return e;
+}
+
+void PD_DeleteServingEngine(PD_ServingEngine* e) {
+  if (!e) return;
+  if (e->obj) {
+    GIL gil;
+    PyObject* out =
+        bridge_call("serving_shutdown", Py_BuildValue("(O)", e->obj));
+    Py_XDECREF(out);
+    Py_DECREF(e->obj);
+  }
+  delete e;
+}
+
+int64_t PD_ServingSubmit(PD_ServingEngine* e, int n_inputs,
+                         const char* const* names, const PD_DataType* dtypes,
+                         const int64_t* const* shapes, const int* ndims,
+                         const void* const* buffers, int priority,
+                         int deadline_ms) {
+  GIL gil;
+  PyObject* name_list = PyList_New(n_inputs);
+  PyObject* dtype_list = PyList_New(n_inputs);
+  PyObject* shape_list = PyList_New(n_inputs);
+  PyObject* buf_list = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) {
+    int dt = static_cast<int>(dtypes[i]);
+    if (dt < 0 || static_cast<size_t>(dt) >=
+                      sizeof(kDtypeItemSize) / sizeof(*kDtypeItemSize)) {
+      g_last_error = "PD_ServingSubmit: invalid PD_DataType";
+      Py_DECREF(name_list);
+      Py_DECREF(dtype_list);
+      Py_DECREF(shape_list);
+      Py_DECREF(buf_list);
+      return -1;
+    }
+    size_t n = 1;
+    PyObject* shp = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d) {
+      n *= static_cast<size_t>(shapes[i][d]);
+      PyTuple_SetItem(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyList_SetItem(name_list, i, PyUnicode_FromString(names[i]));
+    PyList_SetItem(dtype_list, i, PyLong_FromLong(dt));
+    PyList_SetItem(shape_list, i, shp);
+    PyList_SetItem(
+        buf_list, i,
+        PyMemoryView_FromMemory(
+            const_cast<char*>(static_cast<const char*>(buffers[i])),
+            static_cast<Py_ssize_t>(n * kDtypeItemSize[dt]), PyBUF_READ));
+  }
+  PyObject* out = bridge_call(
+      "serving_submit",
+      Py_BuildValue("(ONNNNii)", e->obj, name_list, dtype_list, shape_list,
+                    buf_list, priority, deadline_ms));
+  if (!out) return -1;  // rejected — PD_GetLastError has code + retry hint
+  int64_t ticket = PyLong_AsLongLong(out);
+  Py_DECREF(out);
+  return ticket;
+}
+
+int PD_ServingPoll(PD_ServingEngine* e, int64_t ticket,
+                   const char* output_name, PD_DataType* dtype,
+                   int64_t** shape, int* ndim, void** data, size_t* nbytes) {
+  GIL gil;
+  PyObject* out = bridge_call(
+      "serving_poll", Py_BuildValue("(OLs)", e->obj, ticket, output_name));
+  if (!out) return 2;  // failed (or bad ticket) — PD_GetLastError
+  if (out == Py_None) {
+    Py_DECREF(out);
+    return 1;  // pending
+  }
+  return unpack_tensor_tuple(out, dtype, shape, ndim, data, nbytes) ? 2 : 0;
+}
+
+void PD_ServingRelease(PD_ServingEngine* e, int64_t ticket) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("serving_release", Py_BuildValue("(OL)", e->obj, ticket));
+  Py_XDECREF(out);
+}
+
+char* PD_ServingStats(PD_ServingEngine* e) {
+  GIL gil;
+  PyObject* out =
+      bridge_call("serving_stats_json", Py_BuildValue("(O)", e->obj));
+  if (!out) return nullptr;
+  const char* s = PyUnicode_AsUTF8(out);
+  char* copy = s ? strdup(s) : nullptr;
+  Py_DECREF(out);
+  return copy;
+}
 
 /* -- train API ---------------------------------------------------------- */
 

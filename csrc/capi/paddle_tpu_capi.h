@@ -65,6 +65,47 @@ int PD_GetOutput(PD_Predictor* predictor, const char* name,
 void PD_Free(void* ptr);
 const char* PD_GetLastError(void);
 
+/* -- online serving (paddle_tpu/serving: admission queue + dynamic
+ * batcher + SLO scheduling over predictor replicas) --------------------
+ * Submit/poll surface: PD_ServingSubmit never blocks on inference — it
+ * admits (ticket >= 0) or rejects (-1; PD_GetLastError explains, and a
+ * full queue asks the caller to back off). Poll from any thread. */
+typedef struct PD_ServingEngine PD_ServingEngine;
+
+/* Builds, warms (pre-compiles every shape bucket) and starts the engine.
+ * Ladders are power-of-two up to max_batch / max_seq; max_seq 0 = the
+ * model has no variable-length axis. queue_depth/max_wait_ms/num_replicas
+ * <= 0 pick defaults (256 rows / 5 ms / 1 replica). */
+PD_ServingEngine* PD_NewServingEngine(const PD_AnalysisConfig* config,
+                                      int max_batch, int max_seq,
+                                      int queue_depth, int max_wait_ms,
+                                      int num_replicas);
+/* graceful drain (queued requests finish), then free */
+void PD_DeleteServingEngine(PD_ServingEngine* engine);
+
+/* Submit one request of n_inputs named tensors (parallel arrays; buffers
+ * are copied before return). priority: 0 high / 1 normal / 2 low.
+ * deadline_ms <= 0 = no deadline. Returns ticket >= 0 or -1. */
+int64_t PD_ServingSubmit(PD_ServingEngine* engine, int n_inputs,
+                         const char* const* names, const PD_DataType* dtypes,
+                         const int64_t* const* shapes, const int* ndims,
+                         const void* const* buffers, int priority,
+                         int deadline_ms);
+
+/* 0 = served (output buffers filled; free with PD_Free), 1 = pending,
+ * 2 = failed (PD_GetLastError). A failed REQUEST consumes the ticket;
+ * caller errors (bad ticket, unknown output name) do NOT — release such
+ * tickets with PD_ServingRelease. Served tickets stay pollable (other
+ * output names) until PD_ServingRelease. */
+int PD_ServingPoll(PD_ServingEngine* engine, int64_t ticket,
+                   const char* output_name, PD_DataType* dtype,
+                   int64_t** shape, int* ndim, void** data, size_t* nbytes);
+void PD_ServingRelease(PD_ServingEngine* engine, int64_t ticket);
+
+/* stats snapshot (queue depth, occupancy, p50/p99 latency, rejection and
+ * deadline counters, compile-cache hit rate) as a JSON string; PD_Free */
+char* PD_ServingStats(PD_ServingEngine* engine);
+
 /* -- train API (reference: paddle/fluid/train/ C++ train demo) ----------
  * model_dir holds main_program/startup_program (+ optional params/) as
  * written by paddle_tpu.io.save_train_model. */

@@ -51,6 +51,7 @@ from paddle_tpu import profiler
 from paddle_tpu import debugger
 from paddle_tpu import fleet
 from paddle_tpu import inference
+from paddle_tpu import serving
 from paddle_tpu import passes
 from paddle_tpu import analysis
 

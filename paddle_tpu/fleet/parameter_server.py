@@ -34,7 +34,7 @@ import time
 import numpy as np
 
 from paddle_tpu.core.backward import append_backward
-from paddle_tpu.core.ir import default_startup_program
+from paddle_tpu.core.ir import default_main_program, default_startup_program
 from paddle_tpu.fleet.base import DistributedOptimizer, Fleet
 from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.utils.enforce import enforce
@@ -118,6 +118,19 @@ class ParameterServerOptimizer(DistributedOptimizer):
                 "padding_idx (mask downstream) or keep the table local",
             )
             sites.setdefault(wname, []).append(i)
+        # resolve-startup check belongs with the other pre-mutation
+        # validations: raising mid-rewrite would leave a half-transpiled
+        # program (remote lookups in place, init ops never stripped)
+        enforce(
+            not sites or startup_program is not None,
+            f"embedding(is_distributed=True) tables {sorted(sites)}: "
+            "cannot resolve the startup program to strip their init ops — "
+            "minimize() ran outside the program's own program_guard and "
+            "got no startup_program. Pass minimize(loss, "
+            "startup_program=...) (the reference transpiler takes it "
+            "explicitly); otherwise running the real startup would still "
+            "materialize the full [vocab, dim] local table.",
+        )
         rewritten = []
         from paddle_tpu.core.ir import Operator
         from paddle_tpu.layers.nn import _next_table_id
@@ -154,13 +167,22 @@ class ParameterServerOptimizer(DistributedOptimizer):
             # the table exists only on the servers: drop the local
             # Parameter and its startup initialization
             block.vars.pop(wname, None)
-            if startup_program is not None:
-                sblock = startup_program.global_block()
-                sblock.ops = [
-                    o for o in sblock.ops
-                    if wname not in o.output_names()
-                ]
-                sblock.vars.pop(wname, None)
+            sblock = startup_program.global_block()
+            kept_init = [
+                o for o in sblock.ops if wname not in o.output_names()
+            ]
+            if len(kept_init) == len(sblock.ops):
+                _warnings.warn(
+                    f"embedding(is_distributed=True) table '{wname}': no "
+                    "init ops found in the resolved startup program — if "
+                    "another startup program initializes it, the full "
+                    "[vocab, dim] local table will still materialize "
+                    "there (pass that program via minimize(loss, "
+                    "startup_program=...))",
+                    stacklevel=4,
+                )
+            sblock.ops = kept_init
+            sblock.vars.pop(wname, None)
         if rewritten:
             program._bump_version()
             _warnings.warn(
@@ -172,12 +194,29 @@ class ParameterServerOptimizer(DistributedOptimizer):
             )
         return rewritten
 
+    @staticmethod
+    def _resolve_startup(program):
+        """The guard-paired startup when it is provably the right one
+        (program IS the default main, so the default pair is this
+        model's), else None — never a guess."""
+        if program is default_main_program():
+            return default_startup_program()
+        return None
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         program = loss.block.program
-        self._transpile_distributed_embeddings(
-            program, startup_program or default_startup_program()
-        )
+        # ADVICE r5 low: default_startup_program() is only the real
+        # startup when `program` is itself the default main (i.e. minimize
+        # runs inside the user's program_guard, where the guard binds the
+        # pair). Outside the guard the default pair belongs to some OTHER
+        # model; stripping a table's init ops from it is a no-op on the
+        # real startup, which would then still materialize the full
+        # [vocab, dim] local table. Resolve honestly: explicit argument >
+        # guard-paired default > None (transpile then demands the table's
+        # startup explicitly).
+        startup_program = startup_program or self._resolve_startup(program)
+        self._transpile_distributed_embeddings(program, startup_program)
         tables = getattr(program, "_sparse_tables", {})
         remote = getattr(program, "_remote_tables", {})
         rows_names = [t["rows"] for t in tables.values()]

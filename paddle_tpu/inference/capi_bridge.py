@@ -55,17 +55,137 @@ def run(pred):
     return True
 
 
-def get_output(pred, name):
-    """Returns (dtype_enum, shape_tuple, raw_bytes)."""
-    arr = np.ascontiguousarray(pred.get_output_handle(name).copy_to_cpu())
-    if arr.dtype == np.float64:
-        arr = arr.astype(np.float32)
-    if str(arr.dtype) == "bfloat16":
+def _pack_array(arr, name):
+    """One C-ABI tensor marshalling rule for every output path
+    (predictor get_output AND serving poll): downcast float64/bfloat16
+    to float32, return (dtype_enum, shape_tuple, raw_bytes)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype == np.float64 or str(arr.dtype) == "bfloat16":
         arr = arr.astype(np.float32)
     dt = str(arr.dtype)
     if dt not in _DTYPES:
         raise TypeError(f"output '{name}' has non-C-ABI dtype {dt}")
     return _DTYPES.index(dt), tuple(arr.shape), arr.tobytes()
+
+
+def get_output(pred, name):
+    """Returns (dtype_enum, shape_tuple, raw_bytes)."""
+    return _pack_array(pred.get_output_handle(name).copy_to_cpu(), name)
+
+
+# ---------------------------------------------------------------------------
+# C serving bridge (csrc/capi PD_ServingEngine): submit/poll over
+# paddle_tpu.serving.ServingEngine, so C/Go front-ends get the admission
+# queue + dynamic batcher instead of one-request-at-a-time PD_PredictorRun.
+# Tickets are plain ints; the handle owns ticket -> Response resolution.
+# ---------------------------------------------------------------------------
+
+
+class _ServingHandle:
+    def __init__(self, engine):
+        import threading
+
+        self.engine = engine
+        self.tickets = {}
+        self.next_ticket = 0
+        self.lock = threading.Lock()
+
+
+def new_serving_engine(model_dir, prog_file, params_file, use_tpu, device_id,
+                       max_batch, max_seq, queue_depth, max_wait_ms,
+                       num_replicas):
+    """Build + warm + start an engine. max_seq=0 means the model has no
+    variable-length axis (batch-bucketing only); ladders are power-of-two
+    up to the maxima."""
+    from paddle_tpu.serving import BucketLattice, ServingEngine
+
+    if prog_file:
+        config = Config(prog_file, params_file)
+    else:
+        config = Config(model_dir)
+    if use_tpu:
+        config.enable_tpu(device_id)
+    else:
+        config.disable_tpu()
+    # the C header promises "<= 0 picks the default" — negative values
+    # must not leak through (queue_depth=-1 would reject everything)
+    lattice = BucketLattice.pow2(max_batch if max_batch > 0 else 8,
+                                 max_seq if max_seq > 0 else None)
+    config.set_serving_buckets(lattice.batch_sizes, lattice.seq_lens,
+                               lattice.pad_axis)
+    engine = ServingEngine(
+        config, lattice=lattice, num_replicas=max(num_replicas, 1),
+        queue_depth=queue_depth if queue_depth > 0 else 256,
+        max_wait_ms=max_wait_ms if max_wait_ms > 0 else 5.0,
+    )
+    engine.start()
+    return _ServingHandle(engine)
+
+
+def serving_submit(handle, names, dtype_idxs, shapes, buffers, priority,
+                   deadline_ms):
+    """One request: parallel per-input lists. Buffers are memoryviews
+    over caller memory — copied immediately (the C caller may free them
+    after this returns). Raises RejectedError (backpressure/invalid);
+    the C side maps that to ticket -1 + PD_GetLastError."""
+    inputs = {}
+    for name, di, shape, data in zip(names, dtype_idxs, shapes, buffers):
+        inputs[name] = (
+            np.frombuffer(data, dtype=_DTYPES[di]).reshape(shape).copy()
+        )
+    resp = handle.engine.submit(
+        inputs, priority=priority,
+        deadline_ms=deadline_ms if deadline_ms and deadline_ms > 0 else None,
+    )
+    with handle.lock:
+        handle.next_ticket += 1
+        ticket = handle.next_ticket
+        handle.tickets[ticket] = resp
+    return ticket
+
+
+def serving_poll(handle, ticket, output_name):
+    """None while pending; (dtype_idx, shape, bytes) for the named output
+    when served. A FAILED REQUEST raises its structured ServingError and
+    consumes the ticket; caller errors (bad ticket, unknown output name)
+    raise WITHOUT consuming — the served outputs stay pollable/releasable.
+    Successful tickets stay until serving_release so multi-output models
+    can poll each output."""
+    with handle.lock:
+        resp = handle.tickets.get(ticket)
+    if resp is None:
+        raise KeyError(f"unknown or released ticket {ticket}")
+    if not resp.done():
+        return None
+    err = resp.error()
+    if err is not None:
+        with handle.lock:
+            handle.tickets.pop(ticket, None)
+        raise err
+    outputs = resp.result()
+    if output_name not in outputs:
+        raise KeyError(
+            f"no output named '{output_name}' (have {sorted(outputs)}); "
+            "the ticket is NOT consumed — poll again or serving_release it"
+        )
+    return _pack_array(outputs[output_name], output_name)
+
+
+def serving_release(handle, ticket):
+    with handle.lock:
+        handle.tickets.pop(ticket, None)
+    return 0
+
+
+def serving_stats_json(handle):
+    import json as _json
+
+    return _json.dumps(handle.engine.stats())
+
+
+def serving_shutdown(handle):
+    handle.engine.shutdown()
+    return 0
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ device_put once, outputs stay on device until copy_to_cpu.
 
 import json
 import os
+import threading
 
 import numpy as np
 
@@ -54,6 +55,7 @@ class Config:
         self._deleted_passes = set()
         self._verify_each_pass = False
         self._options = {}
+        self._serving_buckets = None
 
     # -- model location (reference: AnalysisConfig::SetModel — updates only
     # the paths; previously configured options must survive) ---------------
@@ -153,6 +155,24 @@ class Config:
                 names.append("bf16_cast")
         return [n for n in names if n not in self._deleted_passes]
 
+    # -- serving (paddle_tpu/serving: bucket lattice + warmup) -------------
+    def set_serving_buckets(self, batch_sizes, seq_lens=None, pad_axis=1):
+        """Declare the serving shape lattice: every served batch will be
+        one of (batch, seq) with batch from `batch_sizes` and seq from
+        `seq_lens` (None = the model has no variable-length axis).
+        Predictor.warmup() pre-compiles every lattice point so first-
+        request latency never includes a trace, and ServingEngine batches
+        only onto these shapes so the compile cache never misses."""
+        self._serving_buckets = {
+            "batch_sizes": tuple(sorted(int(b) for b in batch_sizes)),
+            "seq_lens": (tuple(sorted(int(s) for s in seq_lens))
+                         if seq_lens else None),
+            "pad_axis": int(pad_axis),
+        }
+
+    def serving_buckets(self):
+        return self._serving_buckets
+
     # -- parity shims (accepted, no TPU meaning) ---------------------------
     def set_cpu_math_library_num_threads(self, n):
         self._options["cpu_math_threads"] = n
@@ -226,9 +246,12 @@ class Predictor:
             TPUPlace(config._device_id) if config._use_tpu else CPUPlace()
         )
         if _shared is not None:
-            # clone: share scope (weights), program, and compiled cache
+            # clone: share scope (weights), program, compiled cache, and
+            # the cache hit/miss counters (serving replicas report one
+            # compile-cache hit rate, not per-clone fragments)
             (self._program, self._feed_names, self._fetch_names,
-             self._scope, self._cache, self._analysis_stats) = _shared
+             self._scope, self._cache, self._analysis_stats,
+             self._cache_stats, self._cache_lock) = _shared
         else:
             self._scope = Scope()
             self._program, self._feed_names, self._fetch_names = self._load()
@@ -236,6 +259,11 @@ class Predictor:
             if config.ir_optim():
                 self._analyze()
             self._cache = {}
+            self._cache_stats = {"hits": 0, "misses": 0, "compile_s": 0.0}
+            # clones run in concurrent serving workers; counter updates
+            # and cache writes need the shared lock (compiles don't hold
+            # it — a rare duplicate compile is cheaper than serializing)
+            self._cache_lock = threading.Lock()
         self._inputs = {}
         self._outputs = {}
         block = self._program.global_block()
@@ -373,8 +401,6 @@ class Predictor:
         style) and call run(), or pass `inputs` as {name: np.ndarray} /
         [np.ndarray, ...] (reference: PaddlePredictor::Run). Returns the
         list of output np.ndarrays AND fills the output handles."""
-        import jax
-
         if inputs is not None:
             if isinstance(inputs, dict):
                 for n, v in inputs.items():
@@ -392,12 +418,7 @@ class Predictor:
             v = self._inputs[n].value()
             enforce(v is not None, f"input '{n}' was never set")
             feed_vals.append(np.asarray(v))
-        sig = tuple((v.shape, str(v.dtype)) for v in feed_vals)
-        executable, scope_names = self._compiled(sig)
-        dev = self._place.jax_device()
-        feed_dev = [jax.device_put(v, dev) for v in feed_vals]
-        weights = [self._scope.find_var(n) for n in scope_names]
-        outs = executable(tuple(feed_dev), tuple(weights))
+        outs = self._execute_feeds(feed_vals)
         results = []
         for n, o in zip(self._fetch_names, outs):
             self._outputs[n]._value = o
@@ -413,9 +434,14 @@ class Predictor:
         """AOT-compile the pruned program for one input-shape bucket
         (reference: the predictor's first-run engine build; here it's an
         explicit jax .lower().compile() so serving never retraces)."""
-        hit = self._cache.get(sig)
-        if hit is not None:
-            return hit
+        with self._cache_lock:
+            hit = self._cache.get(sig)
+            if hit is not None:
+                self._cache_stats["hits"] += 1
+                return hit
+            self._cache_stats["misses"] += 1
+        import time as _time
+
         import jax
 
         from paddle_tpu.core.executor import _interpret_block, plan_step
@@ -449,13 +475,110 @@ class Predictor:
             )
             for n in scope_names
         )
-        executable = (
-            jax.jit(fn)
-            .lower(feed_structs, weight_structs)
-            .compile()
-        )
-        self._cache[sig] = (executable, scope_names)
+        from paddle_tpu import profiler
+
+        t0 = _time.perf_counter()
+        with profiler.RecordEvent("predictor::aot_compile"):
+            executable = (
+                jax.jit(fn)
+                .lower(feed_structs, weight_structs)
+                .compile()
+            )
+        profiler.incr_counter("predictor.aot_compiles")
+        with self._cache_lock:
+            self._cache_stats["compile_s"] += _time.perf_counter() - t0
+            self._cache[sig] = (executable, scope_names)
         return self._cache[sig]
+
+    def cache_stats(self):
+        """Compile-cache counters, shared across clones: {hits, misses,
+        compile_s}. A warmed serving fleet holds misses constant while
+        hits grow — the hit-rate metric ServingEngine.stats() reports."""
+        with self._cache_lock:
+            return dict(self._cache_stats)
+
+    def _execute_feeds(self, feed_vals):
+        """Shared execution tail for run()/run_batch(): signature,
+        compile-cache lookup, device transfer, call. ONE place defines
+        the cache-signature format the warmup/bucket machinery matches."""
+        import jax
+
+        sig = tuple((v.shape, str(v.dtype)) for v in feed_vals)
+        executable, scope_names = self._compiled(sig)
+        dev = self._place.jax_device()
+        feed_dev = [jax.device_put(v, dev) for v in feed_vals]
+        weights = [self._scope.find_var(n) for n in scope_names]
+        return executable(tuple(feed_dev), tuple(weights))
+
+    # -- batched serving (paddle_tpu/serving drives these) -----------------
+    def run_batch(self, feeds):
+        """Dict-in/dict-out single-shot run that bypasses the zero-copy
+        handles — the serving hot path. Each engine worker owns a clone,
+        so nothing here touches shared mutable state (the compile cache
+        dict is append-only and shared deliberately)."""
+        feed_vals = []
+        for n in self._feed_names:
+            enforce(n in feeds, f"run_batch feed missing input '{n}'")
+            feed_vals.append(np.ascontiguousarray(feeds[n]))
+        outs = self._execute_feeds(feed_vals)
+        return {n: np.asarray(o) for n, o in zip(self._fetch_names, outs)}
+
+    def _bucket_signature(self, batch, seq):
+        """Concrete feed signature for one lattice point: each feed var's
+        first -1 dim takes the batch bucket, every later -1 takes the
+        length bucket (a fixed-shape var serves as declared)."""
+        block = self._program.global_block()
+        sig = []
+        for n in self._feed_names:
+            v = block._find_var_recursive(n)
+            enforce(v is not None, f"feed var '{n}' not in program")
+            shape, saw_batch = [], False
+            for d in v.shape:
+                if int(d) != -1:
+                    shape.append(int(d))
+                elif not saw_batch:
+                    shape.append(int(batch))
+                    saw_batch = True
+                else:
+                    enforce(
+                        seq is not None,
+                        f"feed '{n}' has a variable non-batch dim "
+                        f"{list(v.shape)}: set_serving_buckets needs "
+                        "seq_lens to warm it",
+                    )
+                    shape.append(int(seq))
+            sig.append((tuple(shape), str(v.dtype)))
+        return tuple(sig)
+
+    def warmup(self, buckets=None):
+        """Pre-compile every serving bucket so no request ever pays a
+        trace (reference: the engine-build-on-first-run latency cliff
+        this removes). `buckets` overrides Config.set_serving_buckets.
+        Returns [(signature, seconds)] per newly compiled bucket; each
+        compile is logged through the profiler event machinery."""
+        import time as _time
+
+        from paddle_tpu import profiler
+
+        spec = buckets if buckets is not None else \
+            self._config.serving_buckets()
+        enforce(
+            spec is not None,
+            "warmup needs buckets: call Config.set_serving_buckets first",
+        )
+        seqs = spec["seq_lens"] or (None,)
+        compiled = []
+        for b in spec["batch_sizes"]:
+            for s in seqs:
+                sig = self._bucket_signature(b, s)
+                if sig in self._cache:
+                    continue
+                t0 = _time.perf_counter()
+                with profiler.RecordEvent("predictor::warmup_bucket"):
+                    self._compiled(sig)
+                compiled.append((sig, _time.perf_counter() - t0))
+                profiler.incr_counter("predictor.warmup_buckets")
+        return compiled
 
     # -- management --------------------------------------------------------
     def clone(self):
@@ -465,7 +588,8 @@ class Predictor:
         return Predictor(
             self._config,
             _shared=(self._program, self._feed_names, self._fetch_names,
-                     self._scope, self._cache, self._analysis_stats),
+                     self._scope, self._cache, self._analysis_stats,
+                     self._cache_stats, self._cache_lock),
         )
 
     def get_serialized_program(self):

@@ -26,11 +26,14 @@ __all__ = [
     "reset_profiler",
     "profiler",
     "profile_ops",
+    "incr_counter",
+    "get_counters",
     "get_profile_report",
     "print_profiler_report",
 ]
 
 _events = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # count,total,max,min
+_counters = defaultdict(int)
 _enabled = False
 _trace_dir = None
 
@@ -63,6 +66,19 @@ def record_event(name):
     return RecordEvent(name)
 
 
+def incr_counter(name, n=1):
+    """Monotonic named counter (occurrence metric with no duration —
+    e.g. serving admissions/rejections/batch rows). Gated on the same
+    enable switch as RecordEvent; counters land in the report's counter
+    section and get_counters()."""
+    if _enabled:
+        _counters[name] += n
+
+
+def get_counters():
+    return dict(_counters)
+
+
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     """state/tracer_option accepted for parity (reference: profiler.py:196);
     device tracing starts when trace_dir is given (jax.profiler)."""
@@ -93,6 +109,7 @@ def stop_profiler(sorted_key="total", profile_path=None):
 
 def reset_profiler():
     _events.clear()
+    _counters.clear()
 
 
 @contextlib.contextmanager
@@ -156,6 +173,10 @@ def _format_report(report):
             f"{r['name']:<48}{r['calls']:>8}{r['total_s']:>12.6f}"
             f"{r['ave_s']:>12.6f}{r['max_s']:>12.6f}"
         )
+    if _counters:
+        lines.append(f"{'Counter':<48}{'Value':>8}")
+        for name in sorted(_counters):
+            lines.append(f"{name:<48}{_counters[name]:>8}")
     return "\n".join(lines)
 
 

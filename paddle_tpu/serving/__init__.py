@@ -1,0 +1,59 @@
+"""Online serving subsystem: admission queue, bucketed dynamic batcher,
+and SLO-aware scheduling over the AOT predictor.
+
+The inference predictor (inference/predictor.py) is a single-request
+engine: one call, one AOT-compiled executable, one answer. This package
+turns it into a service. The design follows the prediction-serving
+literature — Clipper's (NSDI'17) dynamic batching behind an admission
+front-end and Orca's (OSDI'22) batch-window scheduling — re-based on the
+TPU constraint that every served shape must be a pre-compiled bucket:
+the batcher only ever forms (batch, seq-len) shapes drawn from a fixed
+bucket lattice, so a warmed engine never retraces.
+
+Layers (each its own module, composable and separately testable):
+
+* `request`  — Request/Response futures + the structured serving errors
+  (`RejectedError` carries retry-after for backpressure,
+  `DeadlineExceededError` for SLO misses, `RequestError` for per-request
+  failures that must not fail batchmates).
+* `queue`    — `RequestQueue`: bounded-depth admission queue with
+  priority lanes and deadline expiry; rejects loudly instead of queueing
+  unboundedly.
+* `batcher`  — `BucketLattice` (the fixed shape grid + total bucket
+  mapping) and `DynamicBatcher` (coalesce queued requests into padded
+  lattice batches under a max-wait timer).
+* `engine`   — `ServingEngine`: worker loop over one or more Predictor
+  replicas; scatter/gather of per-request rows, failure isolation,
+  graceful drain, and the `stats()` snapshot.
+* `metrics`  — always-on serving counters + latency reservoirs, mirrored
+  into profiler.py's event/counter machinery when profiling is enabled.
+"""
+
+from paddle_tpu.serving.batcher import BucketLattice, DynamicBatcher
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.queue import RequestQueue
+from paddle_tpu.serving.request import (
+    DeadlineExceededError,
+    Priority,
+    RejectedError,
+    Request,
+    RequestError,
+    Response,
+    ServingError,
+)
+
+__all__ = [
+    "BucketLattice",
+    "DeadlineExceededError",
+    "DynamicBatcher",
+    "Priority",
+    "RejectedError",
+    "Request",
+    "RequestError",
+    "RequestQueue",
+    "Response",
+    "ServingEngine",
+    "ServingError",
+    "ServingMetrics",
+]

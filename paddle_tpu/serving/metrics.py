@@ -1,0 +1,121 @@
+"""Serving metrics: always-on counters + latency reservoirs.
+
+Two sinks, one instrumentation point. The engine records into this
+module's always-on structures (a service must answer `stats()` whether
+or not anyone is profiling), and every recording is mirrored into
+profiler.py's event/counter machinery so a `with profiler.profiler():`
+session shows serving spans (queue wait, batch run) and counters next to
+the framework's own events — the same RecordEvent stream the reference
+used for op dispatch.
+"""
+
+import threading
+
+from paddle_tpu import profiler
+
+__all__ = ["ServingMetrics"]
+
+_RESERVOIR = 4096  # newest-N latency window per series
+
+
+class _Latency:
+    """Windowed latency series: count/total over all samples, percentile
+    over the newest `_RESERVOIR` (ring buffer — recent behavior is what
+    an SLO dashboard wants)."""
+
+    __slots__ = ("count", "total", "ring", "pos")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.ring = []
+        self.pos = 0
+
+    def add(self, seconds):
+        self.count += 1
+        self.total += seconds
+        if len(self.ring) < _RESERVOIR:
+            self.ring.append(seconds)
+        else:
+            self.ring[self.pos] = seconds
+            self.pos = (self.pos + 1) % _RESERVOIR
+
+    def percentile(self, p):
+        if not self.ring:
+            return 0.0
+        data = sorted(self.ring)
+        k = min(len(data) - 1, max(0, int(round((p / 100.0) * (len(data) - 1)))))
+        return data[k]
+
+    def snapshot(self, prefix):
+        return {
+            f"{prefix}_count": self.count,
+            f"{prefix}_avg_s": self.total / max(self.count, 1),
+            f"{prefix}_p50_s": self.percentile(50),
+            f"{prefix}_p99_s": self.percentile(99),
+        }
+
+
+class ServingMetrics:
+    COUNTERS = (
+        "submitted", "admitted", "rejected", "rejected_queue_full",
+        "rejected_shutdown", "rejected_invalid", "deadline_missed",
+        "completed", "failed", "batches", "batched_rows", "padded_rows",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {name: 0 for name in self.COUNTERS}
+        self._queue_wait = _Latency()
+        self._run = _Latency()
+        self._total = _Latency()
+        self._occupancy_sum = 0.0
+
+    def incr(self, name, n=1):
+        with self._lock:
+            self._counts[name] += n
+        profiler.incr_counter(f"serving.{name}", n)
+
+    def observe_batch(self, plan, run_seconds):
+        with self._lock:
+            self._counts["batches"] += 1
+            self._counts["batched_rows"] += plan.real_rows
+            self._counts["padded_rows"] += plan.bucket_rows - plan.real_rows
+            self._occupancy_sum += plan.occupancy
+            self._run.add(run_seconds)
+        profiler.incr_counter("serving.batches")
+        profiler.incr_counter("serving.batched_rows", plan.real_rows)
+
+    def observe_request(self, request):
+        """Called at completion: queue-wait + end-to-end latency."""
+        finish = request.response.finish_time
+        with self._lock:
+            if request.dispatch_time is not None:
+                self._queue_wait.add(
+                    request.dispatch_time - request.submit_time
+                )
+            if finish is not None:
+                self._total.add(finish - request.submit_time)
+
+    def count(self, name):
+        with self._lock:
+            return self._counts[name]
+
+    def run_avg_s(self):
+        """O(1) mean batch-run latency (no percentile sorts — safe on
+        the admission hot path)."""
+        with self._lock:
+            return self._run.total / max(self._run.count, 1)
+
+    def snapshot(self, extra=None):
+        with self._lock:
+            out = dict(self._counts)
+            batches = max(out["batches"], 1)
+            out["avg_batch_occupancy"] = self._occupancy_sum / batches
+            out["avg_batch_rows"] = out["batched_rows"] / batches
+            out.update(self._queue_wait.snapshot("queue_wait"))
+            out.update(self._run.snapshot("run"))
+            out.update(self._total.snapshot("latency"))
+        if extra:
+            out.update(extra)
+        return out

@@ -1,0 +1,122 @@
+"""Bounded admission queue with priority lanes and deadline expiry.
+
+The reference served requests thread-per-predictor with no shared queue;
+under overload that design queues inside the kernel's accept backlog and
+times out opaquely. Here admission is explicit (Clipper's front-end
+pattern): a bounded queue that REJECTS with a retry-after estimate when
+full, priority lanes so interactive traffic overtakes batch traffic, and
+deadline expiry so the TPU never runs a request whose caller already
+gave up.
+
+Locking: the queue owns an RLock (`queue.lock`); single calls take it
+internally, and the engine's batcher takes it around compound
+scan-and-remove operations (and builds its dispatch Condition on it).
+"""
+
+import threading
+import time
+from collections import deque
+
+from paddle_tpu.serving.request import Priority, RejectedError
+
+__all__ = ["RequestQueue"]
+
+
+class RequestQueue:
+    def __init__(self, max_depth=256):
+        self.max_depth = int(max_depth)
+        self.lock = threading.RLock()
+        self._lanes = {p: deque() for p in Priority.LANES}
+        self._depth = 0
+        self._closed = False
+
+    # -- admission ---------------------------------------------------------
+    def put(self, request, retry_after_s=0.05):
+        """Admit or reject-with-backpressure. `retry_after_s` is the
+        engine's current drain-time estimate, forwarded verbatim in the
+        rejection so callers back off proportionally to real load."""
+        with self.lock:
+            if self._closed:
+                raise RejectedError(
+                    "serving engine is draining; not accepting requests",
+                    retry_after_s=0.0,
+                )
+            if self._depth + request.rows > self.max_depth:
+                raise RejectedError(
+                    f"queue full ({self._depth}/{self.max_depth} rows); "
+                    f"retry after {retry_after_s:.3f}s",
+                    retry_after_s=retry_after_s,
+                )
+            self._lanes[request.priority].append(request)
+            self._depth += request.rows
+        return request
+
+    def close(self):
+        """Stop admitting (drain mode); queued requests still serve."""
+        with self.lock:
+            self._closed = True
+
+    def reopen(self):
+        with self.lock:
+            self._closed = False
+
+    # -- scheduling surface (callers hold `lock` across compound use) ------
+    def expire(self, now=None):
+        """Remove and return every deadline-expired request (they are
+        rejected BEFORE dispatch — no device time on dead answers)."""
+        now = now if now is not None else time.perf_counter()
+        dead = []
+        with self.lock:
+            for lane in self._lanes.values():
+                kept = deque()
+                for r in lane:
+                    (dead if r.expired(now) else kept).append(r)
+                lane.clear()
+                lane.extend(kept)
+            for r in dead:
+                self._depth -= r.rows
+        return dead
+
+    def head(self):
+        """Oldest request in the highest non-empty lane (dispatch order),
+        or None."""
+        with self.lock:
+            for p in Priority.LANES:
+                if self._lanes[p]:
+                    return self._lanes[p][0]
+        return None
+
+    def iter_requests(self):
+        """Snapshot in dispatch order (priority lanes, FIFO within)."""
+        with self.lock:
+            out = []
+            for p in Priority.LANES:
+                out.extend(self._lanes[p])
+            return out
+
+    def remove(self, requests):
+        """Remove specific admitted requests (they were taken for a
+        batch)."""
+        ids = {r.id for r in requests}
+        with self.lock:
+            for lane in self._lanes.values():
+                kept = [r for r in lane if r.id not in ids]
+                if len(kept) != len(lane):
+                    lane.clear()
+                    lane.extend(kept)
+            for r in requests:
+                self._depth -= r.rows
+
+    # -- introspection -----------------------------------------------------
+    def depth(self):
+        """Queued rows (admission unit: a 4-row request costs 4)."""
+        with self.lock:
+            return self._depth
+
+    def empty(self):
+        with self.lock:
+            return self._depth == 0
+
+    def closed(self):
+        with self.lock:
+            return self._closed

@@ -406,7 +406,7 @@ def test_lint_cli_exit_codes(tmp_path):
 
 @pytest.mark.parametrize(
     "example", ["fit_a_line", "recognize_digits", "machine_translation",
-                "recommender_system"]
+                "recommender_system", "serve_transformer"]
 )
 def test_lint_example_programs(example, tmp_path):
     """Every example's program graph stays well-formed: built in-process,

@@ -12,7 +12,8 @@ pytestmark = pytest.mark.slow
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("name", ["fit_a_line", "recognize_digits"])
+@pytest.mark.parametrize("name", ["fit_a_line", "recognize_digits",
+                                  "serve_transformer"])
 def test_example_runs(name):
     env = dict(os.environ)
     env["PADDLE_TPU_FORCE_CPU"] = "1"

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Serving load generator: closed-loop and open-loop over ServingEngine.
+
+Closed loop (`--mode closed`): N concurrent clients, each submitting its
+next request the moment the previous one returns — measures saturated
+throughput and the batcher's coalescing gain. Open loop (`--mode open`):
+Poisson arrivals at `--rate` req/s regardless of completions — measures
+SLO behavior under offered load, including explicit backpressure
+(rejections counted, not retried). Both report one JSON line:
+throughput, p50/p99 queue+total latency, mean batch occupancy,
+rejection/deadline counters, and the post-warmup compile-cache hit rate
+(anything < 1.0 means the bucket lattice is mis-sized for the traffic).
+
+`--smoke` runs a seconds-scale configuration and asserts the invariants
+(all served, zero retrace) — wired into tier-1 CI by
+tests/test_serving.py.
+
+Usage:
+  python tools/bench_serving.py [--mode closed|open] [--requests 512]
+      [--clients 8] [--rate 200] [--replicas 2] [--max-batch 8]
+      [--seq 0] [--deadline-ms 0] [--smoke]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _save_model(tmpdir, feat=8, seq=0):
+    """Tiny fc stack; with --seq a per-token head over a [-1, -1, feat]
+    input (the padded-axis path)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.ir import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        if seq:
+            x = fluid.data("x", [-1, -1, feat])
+            h = fluid.layers.fc(x, 16, act="relu", num_flatten_dims=2)
+            pred = fluid.layers.fc(h, 4, num_flatten_dims=2)
+        else:
+            x = fluid.data("x", [-1, feat])
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        model_dir = os.path.join(tmpdir, "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                      main_program=main)
+    return model_dir
+
+
+def _make_request(rng, args):
+    rows = int(rng.randint(1, 3))
+    if args.seq:
+        ln = int(rng.randint(2, args.seq + 1))
+        return {"x": rng.randn(rows, ln, args.feat).astype("float32")}
+    return {"x": rng.randn(rows, args.feat).astype("float32")}
+
+
+def run_closed(engine, args, rng):
+    from paddle_tpu.serving import ServingError
+
+    lock = threading.Lock()
+    served, errors = [], []
+    per_client = args.requests // args.clients
+
+    def client(cid):
+        crng = np.random.RandomState(1000 + cid)
+        for i in range(per_client):
+            try:
+                resp = engine.submit(
+                    _make_request(crng, args), priority=i % 3,
+                    deadline_ms=args.deadline_ms or None,
+                )
+                out = resp.result(timeout=120)
+                with lock:
+                    served.append(out)
+            except ServingError as e:
+                with lock:
+                    errors.append(e.code)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return len(served), errors, time.perf_counter() - t0
+
+
+def run_open(engine, args, rng):
+    from paddle_tpu.serving import ServingError
+
+    responses, errors = [], []
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        time.sleep(float(rng.exponential(1.0 / args.rate)))
+        try:
+            responses.append(engine.submit(
+                _make_request(rng, args), priority=i % 3,
+                deadline_ms=args.deadline_ms or None,
+            ))
+        except ServingError as e:
+            errors.append(e.code)
+    served = 0
+    for r in responses:
+        try:
+            r.result(timeout=120)
+            served += 1
+        except ServingError as e:
+            errors.append(e.code)
+    return served, errors, time.perf_counter() - t0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop offered load, req/s")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=0,
+                    help="max padded-axis length (0 = fixed-shape model)")
+    ap.add_argument("--feat", type=int, default=8)
+    ap.add_argument("--queue-depth", type=int, default=512)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale run + invariant asserts (CI)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.requests, args.clients, args.replicas = 32, 4, 1
+        args.max_batch = 4
+
+    from paddle_tpu.core.places import ensure_backend_or_cpu
+
+    on_tpu, diag = ensure_backend_or_cpu()
+
+    from paddle_tpu import inference
+    from paddle_tpu.serving import BucketLattice, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = _save_model(tmp, feat=args.feat, seq=args.seq)
+        config = inference.Config(model_dir)
+        if not on_tpu:
+            config.disable_tpu()
+        lattice = BucketLattice.pow2(args.max_batch, args.seq or None,
+                                     min_seq=2)
+        config.set_serving_buckets(lattice.batch_sizes, lattice.seq_lens)
+        engine = ServingEngine(
+            config, lattice=lattice, num_replicas=args.replicas,
+            queue_depth=args.queue_depth, max_wait_ms=args.max_wait_ms,
+        )
+        t0 = time.perf_counter()
+        engine.start()
+        warm_s = time.perf_counter() - t0
+
+        rng = np.random.RandomState(0)
+        runner = run_closed if args.mode == "closed" else run_open
+        served, errors, wall = runner(engine, args, rng)
+        stats = engine.stats()
+        engine.shutdown()
+
+    report = {
+        "metric": f"serving_{args.mode}_loop_requests_per_sec",
+        "value": round(served / max(wall, 1e-9), 1),
+        "unit": "req/s",
+        "extra": {
+            "device": "tpu" if on_tpu else "cpu",
+            "backend_diag": diag,
+            "served": served,
+            "rejected": stats["rejected"],
+            "deadline_missed": stats["deadline_missed"],
+            "error_codes": sorted(set(errors)),
+            "warmup_seconds": round(warm_s, 2),
+            "avg_batch_rows": round(stats["avg_batch_rows"], 2),
+            "avg_batch_occupancy": round(stats["avg_batch_occupancy"], 3),
+            "queue_wait_p50_s": round(stats["queue_wait_p50_s"], 5),
+            "queue_wait_p99_s": round(stats["queue_wait_p99_s"], 5),
+            "latency_p50_s": round(stats["latency_p50_s"], 5),
+            "latency_p99_s": round(stats["latency_p99_s"], 5),
+            "cache_hit_rate": stats["cache_hit_rate"],
+            "replicas": args.replicas,
+            "mode": args.mode,
+        },
+    }
+    print(json.dumps(report))
+    if args.smoke:
+        assert served == args.requests, (served, args.requests, errors)
+        assert stats["cache_hit_rate"] == 1.0, stats
+        assert stats["cache_misses"] == 0, stats
+        print("SERVING_SMOKE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
