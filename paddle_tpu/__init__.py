@@ -54,6 +54,7 @@ from paddle_tpu import inference
 from paddle_tpu import serving
 from paddle_tpu import passes
 from paddle_tpu import analysis
+from paddle_tpu import resilience
 
 
 class FetchHandler:

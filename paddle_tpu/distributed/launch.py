@@ -9,6 +9,13 @@ chips), and rendezvous is the JAX coordinator (PADDLE_DIST_COORDINATOR)
 instead of NCCL-id RPC. For CPU-based testing, --nproc emulates multiple
 hosts on localhost with virtual devices.
 
+A gang is all-or-nothing: one crashed rank wedges every collective, so
+`wait_gang` POLLS the whole gang and fail-fast terminates the survivors
+the moment any rank exits nonzero (instead of the old sequential
+[p.wait() ...], where a dead rank 3 hung the job until ranks 0-2
+finished). Supervised restarts on top of this live in
+paddle_tpu.resilience.supervisor (--max-restarts below wires it in).
+
 Usage:  python -m paddle_tpu.distributed.launch --nproc 2 train.py [args...]
 """
 
@@ -17,8 +24,10 @@ import os
 import signal
 import subprocess
 import sys
+import time
 
-__all__ = ["launch_procs", "main"]
+__all__ = ["spawn_gang", "wait_gang", "terminate_gang", "launch_procs",
+           "main"]
 
 
 def _free_port():
@@ -29,7 +38,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def launch_procs(
+def spawn_gang(
     script_args,
     nproc=1,
     started_port=None,
@@ -37,8 +46,8 @@ def launch_procs(
     extra_env=None,
     devices_per_proc=None,
 ):
-    """Spawn `nproc` worker processes running `script_args`, with the fleet
-    env contract injected. Returns the list of exit codes."""
+    """Spawn `nproc` worker processes running `script_args` with the fleet
+    env contract injected; returns the list of Popen handles (rank order)."""
     started_port = started_port or _free_port()
     endpoints = ",".join(
         f"127.0.0.1:{started_port + i}" for i in range(nproc)
@@ -77,6 +86,61 @@ def launch_procs(
         procs.append(
             subprocess.Popen([sys.executable] + list(script_args), env=env)
         )
+    return procs
+
+
+def terminate_gang(procs, grace_s=5.0):
+    """TERM every live rank, give them `grace_s` to exit, then KILL."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def wait_gang(procs, fail_fast=True, poll_interval_s=0.1, grace_s=5.0):
+    """Poll ALL ranks until the gang resolves; returns exit codes in rank
+    order. With fail_fast, the first nonzero exit terminates the
+    survivors immediately (they would otherwise hang on dead
+    collectives); their codes then reflect the termination signal."""
+    failed = False
+    while True:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            return codes
+        if fail_fast and not failed and any(
+            c is not None and c != 0 for c in codes
+        ):
+            failed = True
+            terminate_gang(procs, grace_s=grace_s)
+            continue
+        time.sleep(poll_interval_s)
+
+
+def launch_procs(
+    script_args,
+    nproc=1,
+    started_port=None,
+    coordinator=None,
+    extra_env=None,
+    devices_per_proc=None,
+    fail_fast=True,
+):
+    """Spawn a gang and wait for it. Returns the list of exit codes."""
+    procs = spawn_gang(
+        script_args,
+        nproc=nproc,
+        started_port=started_port,
+        coordinator=coordinator,
+        extra_env=extra_env,
+        devices_per_proc=devices_per_proc,
+    )
 
     def _terminate(signum, frame):
         for p in procs:
@@ -84,7 +148,7 @@ def launch_procs(
 
     old = signal.signal(signal.SIGTERM, _terminate)
     try:
-        codes = [p.wait() for p in procs]
+        codes = wait_gang(procs, fail_fast=fail_fast)
     finally:
         signal.signal(signal.SIGTERM, old)
     return codes
@@ -97,11 +161,46 @@ def main():
     parser.add_argument("--started_port", type=int, default=None)
     parser.add_argument("--devices_per_proc", type=int, default=None,
                         help="virtual CPU devices per process (testing)")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="supervised gang restarts on failure (0 = "
+                             "fail fast with no restart)")
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="seconds between restart attempts (doubles)")
+    parser.add_argument("--hang_timeout", type=float, default=None,
+                        help="declare the gang hung when no heartbeat "
+                             "tick lands for this many seconds")
+    parser.add_argument("--heartbeat_dir", type=str, default=None,
+                        help="directory for worker heartbeat files "
+                             "(created; implied by --hang_timeout)")
+    parser.add_argument("--checkpoint_dir", type=str, action="append",
+                        default=None,
+                        help="AutoCheckpoint dir(s) to validate (quarantine "
+                             "corrupt entries) before each restart")
     parser.add_argument("script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args()
+    script_args = [args.script] + args.script_args
+    if args.max_restarts > 0 or args.hang_timeout:
+        from paddle_tpu.resilience.supervisor import GangSupervisor
+
+        sup = GangSupervisor(
+            script_args,
+            nproc=args.nproc,
+            max_restarts=args.max_restarts,
+            restart_backoff_s=args.restart_backoff,
+            hang_timeout_s=args.hang_timeout,
+            heartbeat_dir=args.heartbeat_dir,
+            checkpoint_dirs=args.checkpoint_dir,
+            devices_per_proc=args.devices_per_proc,
+            started_port=args.started_port,
+        )
+        try:
+            sup.run()
+        except Exception as e:
+            sys.exit(str(e))
+        return
     codes = launch_procs(
-        [args.script] + args.script_args,
+        script_args,
         nproc=args.nproc,
         started_port=args.started_port,
         devices_per_proc=args.devices_per_proc,

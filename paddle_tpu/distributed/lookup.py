@@ -27,6 +27,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.retry import RetryPolicy
 from paddle_tpu.utils.enforce import EnforceError, enforce
 
 __all__ = [
@@ -34,7 +36,29 @@ __all__ = [
     "activate",
     "deactivate",
     "active_context",
+    "set_retry_policy",
 ]
+
+# Transient PS failures (connection blips, injected TransientFault) on the
+# in-graph pull/push callbacks retry under the shared policy instead of
+# killing the compiled step. Pulls are idempotent; a retried push is
+# at-least-once (the server may double-apply a grad when the error struck
+# after the apply) — the same trade the reference's async PS mode makes.
+_retry = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+                     deadline_s=30.0)
+
+
+def set_retry_policy(policy):
+    """Swap the pull/push retry policy (None disables retries)."""
+    global _retry
+    old, _retry = _retry, policy
+    return old
+
+
+def _with_retry(fn, *args):
+    if _retry is None:
+        return fn(*args)
+    return _retry.call(fn, *args)
 
 _active = None
 _lock = threading.Lock()
@@ -96,7 +120,12 @@ class RemoteLookupContext:
         t = self._tables[name]
         flat = np.asarray(ids).reshape(-1).astype(np.uint64)
         uniq, inv = np.unique(flat, return_inverse=True)
-        rows = self.client.pull_sparse(t["table_id"], uniq, t["dim"])
+
+        def do_pull():
+            faults.fire("lookup.pull")
+            return self.client.pull_sparse(t["table_id"], uniq, t["dim"])
+
+        rows = _with_retry(do_pull)
         return (
             rows[inv]
             .reshape(tuple(np.shape(ids)) + (t["dim"],))
@@ -194,7 +223,13 @@ class RemoteLookupContext:
         uniq, inv = np.unique(flat, return_inverse=True)
         merged = np.zeros((len(uniq), t["dim"]), dtype=np.float32)
         np.add.at(merged, inv, g)
-        self.client.push_sparse(t["table_id"], uniq, merged, self.sparse_lr)
+
+        def do_push():
+            faults.fire("lookup.push")
+            self.client.push_sparse(t["table_id"], uniq, merged,
+                                    self.sparse_lr)
+
+        _with_retry(do_push)
         with self._push_cv:
             self.stats["pushes"] += 1
             self._push_cv.notify_all()

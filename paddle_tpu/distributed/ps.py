@@ -16,6 +16,8 @@ import threading
 
 import numpy as np
 
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.retry import RetryPolicy
 from paddle_tpu.utils.enforce import enforce
 from paddle_tpu.utils.native import load_native
 
@@ -72,17 +74,36 @@ class PSClient:
     (reference: python/paddle/fluid/transpiler/distribute_transpiler.py:254
     slice_variable round-robin)."""
 
-    def __init__(self, endpoints):
+    _DEFAULT_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                 max_delay_s=1.0, deadline_s=60.0)
+
+    def __init__(self, endpoints, retry=_DEFAULT_RETRY):
         if isinstance(endpoints, str):
             endpoints = endpoints.split(",")
         self._eps = list(endpoints)
         self._socks = []
         self._lock = threading.Lock()
+        # transient transport errors reconnect + resend under the shared
+        # policy (requests are single-message, so a fresh socket starts
+        # clean; non-idempotent cmds become at-least-once on retry);
+        # retry=None disables for raw fail-fast semantics
+        self._retry = retry
         for ep in self._eps:
-            host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks.append(s)
+            self._socks.append(self._connect(ep))
+
+    @staticmethod
+    def _connect(ep):
+        host, port = ep.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _reconnect(self, server):
+        try:
+            self._socks[server].close()
+        except OSError:
+            pass
+        self._socks[server] = self._connect(self._eps[server])
 
     @property
     def n_servers(self):
@@ -92,11 +113,25 @@ class PSClient:
     def _rpc(self, server, cmd, table_id, payload=b""):
         body = struct.pack("<BI", cmd, table_id) + payload
         msg = struct.pack("<I", len(body)) + body
-        s = self._socks[server]
-        s.sendall(msg)
-        hdr = self._read_full(s, 4)
-        (blen,) = struct.unpack("<I", hdr)
-        body = self._read_full(s, blen)
+
+        def exchange():
+            faults.fire("ps.rpc")
+            s = self._socks[server]
+            s.sendall(msg)
+            hdr = self._read_full(s, 4)
+            (blen,) = struct.unpack("<I", hdr)
+            return self._read_full(s, blen)
+
+        def repair(exc, attempt):
+            if isinstance(exc, (ConnectionError, OSError)) and not isinstance(
+                exc, faults.InjectedFault
+            ):
+                self._reconnect(server)
+
+        if self._retry is None:
+            body = exchange()
+        else:
+            body = self._retry.call(exchange, on_retry=repair)
         status = body[0]
         if status != 0:
             raise RuntimeError(

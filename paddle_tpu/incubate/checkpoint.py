@@ -9,21 +9,211 @@ so the unit of recovery is (persistable state + step counter) written
 ASYNCHRONOUSLY (device->host snapshot on the training thread, file IO on a
 background thread — the chip never waits for the disk) with an atomic
 `latest` pointer, plus `resume()` on restart.
+
+Crash consistency (the CheckFreq/Check-N-Run recipe): every checkpoint
+directory carries a manifest with a CRC32 per array AND of the state
+file itself, written BEFORE the atomic rename — so a torn write, a bad
+disk, or a fault-injected corruption is DETECTED at resume time instead
+of silently loading garbage. `resume()` verifies the `latest` target and
+walks back the checkpoint chain past corrupt/torn entries, quarantining
+them as `<name>.corrupt` for forensics. File IO runs under the shared
+retry policy (resilience/retry.py), and the write path is fault-
+injection instrumented (sites `checkpoint.io`,
+`checkpoint.before_rename`, `checkpoint.before_latest`) so tests and
+tools/chaos_train.py can rehearse every failure point deterministically.
 """
 
+import io as _io
 import json
+import logging
 import os
 import shutil
 import threading
 import time
+import zlib
 
 import numpy as np
 
-from paddle_tpu import io as pio
 from paddle_tpu.core.scope import global_scope
-from paddle_tpu.utils.enforce import enforce
+from paddle_tpu.io import array_crc32
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.retry import RetryPolicy
 
-__all__ = ["AutoCheckpoint", "HeartBeatMonitor"]
+__all__ = [
+    "AutoCheckpoint",
+    "HeartBeatMonitor",
+    "CheckpointCorruptError",
+    "verify_checkpoint",
+    "newest_valid_checkpoint",
+    "load_checkpoint",
+]
+
+log = logging.getLogger("paddle_tpu.checkpoint")
+
+MANIFEST_NAME = "manifest.json"
+_DEFAULT_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02,
+                                max_delay_s=0.5)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed integrity verification."""
+
+
+def _ckpt_step(name):
+    tail = name.split("_", 1)[1] if "_" in name else ""
+    return int(tail) if tail.isdigit() else None
+
+
+def verify_checkpoint(dirname, level="full"):
+    """Integrity-check one checkpoint directory; returns (step, arrays)
+    — arrays is None at level="file" — or raises CheckpointCorruptError
+    naming exactly what is wrong.
+
+    Checks, outside-in: meta/state files present -> state.npz whole-file
+    CRC + size against the manifest -> (level="full" only) npz readable
+    -> per-array CRC32. The state file is read ONCE; the arrays are
+    parsed from the same bytes the CRC covered. level="file" stops after
+    the whole-file checks — the cheap pre-relaunch screen the supervisor
+    uses, while the relaunched worker's resume() re-verifies fully.
+    Pre-manifest (legacy) checkpoints pass on readability alone."""
+    state_p = os.path.join(dirname, "state.npz")
+    meta_p = os.path.join(dirname, "meta.json")
+    man_p = os.path.join(dirname, MANIFEST_NAME)
+    for p in (state_p, meta_p):
+        if not os.path.exists(p):
+            raise CheckpointCorruptError(f"{dirname}: missing {os.path.basename(p)}")
+    try:
+        with open(meta_p) as f:
+            meta = json.load(f)
+        step = int(meta["step"])
+    except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(f"{dirname}: bad meta.json ({e})")
+    manifest = None
+    raw = None
+    if os.path.exists(man_p):
+        try:
+            with open(man_p) as f:
+                manifest = json.load(f)
+        except (ValueError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(f"{dirname}: bad manifest ({e})")
+        finfo = manifest.get("files", {}).get("state.npz", {})
+        size = os.path.getsize(state_p)
+        if "size" in finfo and size != finfo["size"]:
+            raise CheckpointCorruptError(
+                f"{dirname}: state.npz is {size} bytes, manifest says "
+                f"{finfo['size']} (torn write)"
+            )
+        if "crc32" in finfo:
+            with open(state_p, "rb") as f:
+                raw = f.read()
+            crc = zlib.crc32(raw) & 0xFFFFFFFF
+            if crc != finfo["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{dirname}: state.npz CRC {crc:#x} != manifest "
+                    f"{finfo['crc32']:#x}"
+                )
+    if level == "file":
+        return step, None
+    arrays = {}
+    try:
+        with np.load(_io.BytesIO(raw) if raw is not None else state_p) as z:
+            for n in z.files:
+                arrays[n] = z[n]
+    except Exception as e:
+        raise CheckpointCorruptError(f"{dirname}: unreadable state.npz ({e})")
+    if manifest is not None:
+        want = manifest.get("arrays", {})
+        missing = sorted(set(want) - set(arrays))
+        if missing:
+            raise CheckpointCorruptError(
+                f"{dirname}: arrays missing from state.npz: {missing[:5]}"
+            )
+        for n, info in want.items():
+            crc = array_crc32(arrays[n])
+            if crc != info["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{dirname}: array '{n}' CRC {crc:#x} != manifest "
+                    f"{info['crc32']:#x}"
+                )
+    return step, arrays
+
+
+def _quarantine(dirname, reason):
+    """Rename a corrupt checkpoint out of the chain (never delete — a
+    human may want the bytes). Idempotent against name collisions."""
+    target = dirname + ".corrupt"
+    n = 0
+    while os.path.exists(target):
+        n += 1
+        target = f"{dirname}.corrupt{n}"
+    try:
+        os.replace(dirname, target)
+        log.error("quarantined corrupt checkpoint %s -> %s (%s)",
+                  dirname, target, reason)
+    except OSError as e:
+        log.error("could not quarantine %s: %s", dirname, e)
+    return target
+
+
+def _candidates(dirname):
+    """Checkpoint names to try, best first: the `latest` pointer target,
+    then every other ckpt_<step> newest-first (the fallback chain)."""
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return []
+    chain = sorted(
+        (d for d in entries
+         if d.startswith("ckpt_") and _ckpt_step(d) is not None),
+        key=_ckpt_step, reverse=True,
+    )
+    ptr = os.path.join(dirname, "latest")
+    if os.path.exists(ptr):
+        try:
+            with open(ptr) as f:
+                name = f.read().strip()
+        except OSError:
+            name = ""
+        if name in chain:
+            chain.remove(name)
+            chain.insert(0, name)
+    return chain
+
+
+def newest_valid_checkpoint(dirname, quarantine=True, level="file"):
+    """Walk the chain (pointer target first, then newest-first) and
+    return the first checkpoint name that verifies; corrupt entries are
+    quarantined as `*.corrupt` along the way (quarantine=False only
+    inspects). Returns None when nothing valid remains. Defaults to the
+    cheap file-level screen (size + whole-file CRC) — callers that will
+    LOAD the result (resume()) re-verify fully anyway."""
+    for name in _candidates(dirname):
+        d = os.path.join(dirname, name)
+        try:
+            verify_checkpoint(d, level=level)
+            return name
+        except CheckpointCorruptError as e:
+            if quarantine:
+                _quarantine(d, str(e))
+    return None
+
+
+def load_checkpoint(dirname, scope=None):
+    """Restore the newest VALID checkpoint into the scope, walking back
+    past corrupt/torn entries (quarantining them); returns the step
+    AFTER the checkpointed one (0 when nothing valid exists)."""
+    scope = scope or global_scope()
+    for name in _candidates(dirname):
+        d = os.path.join(dirname, name)
+        try:
+            step, arrays = verify_checkpoint(d)
+        except CheckpointCorruptError as e:
+            _quarantine(d, str(e))
+            continue
+        for n, a in arrays.items():
+            scope.set(n, a)
+        return step + 1
+    return 0
 
 
 class AutoCheckpoint:
@@ -38,7 +228,7 @@ class AutoCheckpoint:
     """
 
     def __init__(self, exe, program, dirname, save_interval_steps=100,
-                 max_to_keep=3, scope=None):
+                 max_to_keep=3, scope=None, retry=None):
         self._exe = exe
         self._program = program
         self._dir = dirname
@@ -48,6 +238,8 @@ class AutoCheckpoint:
         self._thread = None
         self._lock = threading.Lock()
         self._last_error = None
+        self._pending = None  # (step, snap) of an in-flight/failed write
+        self._retry = retry if retry is not None else _DEFAULT_IO_RETRY
         os.makedirs(dirname, exist_ok=True)
 
     # -- save ----------------------------------------------------------
@@ -64,6 +256,70 @@ class AutoCheckpoint:
         self.save(step, blocking=blocking)
         return True
 
+    def _write(self, step, snap):
+        """The full crash-consistent write protocol: serialize + manifest
+        into a tmp dir, atomic-rename the dir, then atomically swing the
+        `latest` pointer. A crash at ANY point leaves either the old
+        chain intact or a complete new entry the pointer doesn't name
+        yet — both of which resume() handles."""
+        d = os.path.join(self._dir, f"ckpt_{step}")
+        tmp = d + ".tmp"
+
+        def write_files():
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+            # serialize in memory first so the whole-file CRC in the
+            # manifest is computed from the exact bytes that hit disk
+            buf = _io.BytesIO()
+            np.savez(buf, **{k: v for k, v in snap.items()})
+            raw = buf.getvalue()
+            with open(os.path.join(tmp, "state.npz"), "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            # injected IO failure lands mid-protocol: state written, no
+            # manifest yet — a retry restarts write_files from scratch,
+            # a kill leaves classic torn-write debris in the .tmp dir
+            faults.fire("checkpoint.io", step=step,
+                        path=os.path.join(tmp, "state.npz"))
+            manifest = {
+                "format": 1,
+                "step": step,
+                "arrays": {
+                    n: {
+                        "crc32": array_crc32(a),
+                        "dtype": str(np.asarray(a).dtype),
+                        "shape": list(np.shape(a)),
+                    }
+                    for n, a in snap.items()
+                },
+                "files": {
+                    "state.npz": {
+                        "size": len(raw),
+                        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                    }
+                },
+            }
+            with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, "time": time.time()}, f)
+
+        self._retry.call(write_files)
+        faults.fire("checkpoint.before_rename", step=step, path=tmp)
+        shutil.rmtree(d, ignore_errors=True)
+        os.replace(tmp, d)
+        # the pointer update is the COMMIT point: resume() prefers the
+        # pointer target, so a crash here simply leaves the previous
+        # checkpoint committed; the complete new dir only gets used if
+        # the pointer target itself is later lost or corrupt
+        faults.fire("checkpoint.before_latest", step=step, path=d)
+        ptr = os.path.join(self._dir, "latest.tmp")
+        with open(ptr, "w") as f:
+            f.write(f"ckpt_{step}")
+        os.replace(ptr, os.path.join(self._dir, "latest"))
+        self._gc()
+
     def save(self, step, blocking=False):
         """Snapshot device state NOW (cheap: device->host copies), write
         files on a background thread (the reference's checkpoint_notify is
@@ -78,48 +334,32 @@ class AutoCheckpoint:
         self._join()
         if self._last_error is not None:
             err, self._last_error = self._last_error, None
+            self._pending = None
             raise RuntimeError(
                 f"previous async checkpoint write failed: {err}"
             )
 
-        def write():
-            d = os.path.join(self._dir, f"ckpt_{step}")
-            tmp = d + ".tmp"
-            shutil.rmtree(tmp, ignore_errors=True)
-            os.makedirs(tmp, exist_ok=True)
-            np.savez(os.path.join(tmp, "state.npz"),
-                     **{k: v for k, v in snap.items()})
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"step": step, "time": time.time()}, f)
-            shutil.rmtree(d, ignore_errors=True)
-            os.replace(tmp, d)
-            # atomic latest pointer
-            ptr = os.path.join(self._dir, "latest.tmp")
-            with open(ptr, "w") as f:
-                f.write(f"ckpt_{step}")
-            os.replace(ptr, os.path.join(self._dir, "latest"))
-            self._gc()
-
         def guarded():
             try:
-                write()
-            except Exception as e:  # surfaced on the NEXT save/close
-                import logging
-
-                logging.getLogger("paddle_tpu.checkpoint").error(
-                    "async checkpoint write failed: %s", e
-                )
+                self._write(step, snap)
+                self._pending = None
+            except Exception as e:  # surfaced on the NEXT save, or close()
+                log.error("async checkpoint write failed: %s", e)
                 self._last_error = e
 
         if blocking:
-            write()
+            self._pending = (step, snap)
+            self._write(step, snap)
+            self._pending = None
         else:
+            self._pending = (step, snap)
             self._thread = threading.Thread(target=guarded, daemon=True)
             self._thread.start()
 
     def _gc(self):
         entries = os.listdir(self._dir)
-        # clear debris from a save killed mid-write
+        # clear debris from a save killed mid-write (quarantined
+        # *.corrupt entries are kept — they are evidence, not debris)
         for d in entries:
             if d.endswith(".tmp"):
                 shutil.rmtree(os.path.join(self._dir, d), ignore_errors=True)
@@ -138,30 +378,35 @@ class AutoCheckpoint:
 
     # -- resume ----------------------------------------------------------
     def resume(self):
-        """Restore the newest complete checkpoint into the scope; returns
-        the step AFTER the checkpointed one (0 on a fresh start)."""
-        ptr = os.path.join(self._dir, "latest")
-        if not os.path.exists(ptr):
-            return 0
-        with open(ptr) as f:
-            name = f.read().strip()
-        d = os.path.join(self._dir, name)
-        state_p = os.path.join(d, "state.npz")
-        meta_p = os.path.join(d, "meta.json")
-        if not (os.path.exists(state_p) and os.path.exists(meta_p)):
-            return 0
-        with open(meta_p) as f:
-            meta = json.load(f)
-        scope = self._scope or global_scope()
-        with np.load(state_p) as z:
-            for n in z.files:
-                scope.set(n, z[n])
-        return int(meta["step"]) + 1
+        """Restore the newest VALID checkpoint into the scope (verifying
+        CRCs, walking back past corrupt/torn entries and quarantining
+        them as *.corrupt); returns the step AFTER the checkpointed one
+        (0 on a fresh start)."""
+        return load_checkpoint(self._dir, scope=self._scope or global_scope())
 
     def close(self):
+        """Join the async writer and SURFACE its failure (a failed last
+        write used to be silently dropped here). When the failed
+        snapshot is still pending, retry it as a final blocking save
+        first — only raise when the state truly could not be persisted."""
         self._join()
         if self._last_error is not None:
             err, self._last_error = self._last_error, None
+            if self._pending is not None:
+                step, snap = self._pending
+                try:
+                    self._write(step, snap)
+                    self._pending = None
+                    log.warning(
+                        "final blocking save of step %d recovered the "
+                        "failed async write (%s)", step, err,
+                    )
+                    return
+                except Exception as e2:
+                    raise RuntimeError(
+                        f"async checkpoint write failed: {err}; final "
+                        f"blocking save also failed: {e2}"
+                    )
             raise RuntimeError(f"async checkpoint write failed: {err}")
 
 
@@ -189,15 +434,13 @@ class HeartBeatMonitor:
         self.lost = set()
 
     def _loop(self):
-        import logging
-
-        log = logging.getLogger("paddle_tpu.heartbeat")
+        hb_log = logging.getLogger("paddle_tpu.heartbeat")
         start = time.monotonic()
         while not self._stop.is_set():
             try:
                 ages = self._client.heartbeat(self._id)
             except Exception as e:  # server gone: report and stop
-                log.warning("heartbeat RPC failed: %s", e)
+                hb_log.warning("heartbeat RPC failed: %s", e)
                 break
             self._seen.update(ages)
             # a worker that NEVER heartbeats (died during startup) has no
@@ -213,7 +456,7 @@ class HeartBeatMonitor:
             for wid, age in ages.items():
                 if age > self._timeout and wid not in self.lost:
                     self.lost.add(wid)
-                    log.warning(
+                    hb_log.warning(
                         "worker %d LOST: no heartbeat for %.1fs "
                         "(timeout %.1fs)", wid, age, self._timeout,
                     )

@@ -13,14 +13,23 @@ file (save_combine mode, reference: operators/save_combine_op.cc).
 
 import json
 import os
+import zlib
 
 import numpy as np
 
 from paddle_tpu.core.ir import Parameter, Program
 from paddle_tpu.core.scope import global_scope
+from paddle_tpu.reader.decorator import robust  # noqa: F401  (fluid.io.robust)
 from paddle_tpu.utils.enforce import EnforceError, enforce
 
 MODEL_FORMAT_VERSION = 1
+
+
+def array_crc32(arr):
+    """Integrity checksum of an array's payload bytes (dtype-agnostic —
+    bf16 views included); the unit of verification for checkpoint
+    manifests (incubate/checkpoint.py) and separate-files saves below."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 def _is_persistable(var):
@@ -109,7 +118,13 @@ def save_vars(executor, dirname, main_program=None, predicate=None, filename=Non
         os.makedirs(dirname, exist_ok=True)
         for name, arr in arrays.items():
             np.save(os.path.join(dirname, name.replace("/", "_")) + ".npy", arr)
-        manifest = {"format_version": MODEL_FORMAT_VERSION, "vars": sorted(arrays)}
+        manifest = {
+            "format_version": MODEL_FORMAT_VERSION,
+            "vars": sorted(arrays),
+            # per-var payload CRCs: load_vars verifies these, so a torn
+            # or bit-rotted .npy fails loudly naming the variable
+            "crc32": {n: array_crc32(a) for n, a in arrays.items()},
+        }
         with open(os.path.join(dirname, "__manifest__.json"), "w") as f:
             json.dump(manifest, f)
     else:
@@ -133,10 +148,26 @@ def load_vars(executor, dirname, main_program=None, predicate=None, filename=Non
             if (predicate or _is_persistable)(v)
         ]
     if filename is None:
+        crcs = {}
+        man_p = os.path.join(dirname, "__manifest__.json")
+        if os.path.exists(man_p):
+            try:
+                with open(man_p) as f:
+                    crcs = json.load(f).get("crc32", {})
+            except (ValueError, json.JSONDecodeError) as e:
+                raise EnforceError(f"corrupt manifest {man_p}: {e}")
         for name in names:
             path = os.path.join(dirname, name.replace("/", "_")) + ".npy"
             enforce(os.path.exists(path), f"no saved file for variable {name}")
-            scope.set(name, jnp.asarray(np.load(path)))
+            arr = np.load(path)
+            if name in crcs:
+                crc = array_crc32(arr)
+                enforce(
+                    crc == crcs[name],
+                    f"variable {name} is corrupt: CRC {crc:#x} != saved "
+                    f"{crcs[name]:#x} ({path})",
+                )
+            scope.set(name, jnp.asarray(arr))
     else:
         arrays = _read_combined(os.path.join(dirname, filename))
         missing = [n for n in names if n not in arrays]

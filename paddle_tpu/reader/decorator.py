@@ -10,9 +10,11 @@ paddle_tpu/reader/dataloader.py.
 """
 
 import itertools
+import logging
 import queue
 import random
 import threading
+import time
 
 __all__ = [
     "cache",
@@ -24,6 +26,7 @@ __all__ = [
     "firstn",
     "xmap_readers",
     "batch",
+    "robust",
 ]
 
 
@@ -231,6 +234,86 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 raise err[0]
         finally:
             stop.set()
+
+    return data_reader
+
+
+def robust(reader, max_skips=16, max_restarts=4, backoff_s=0.0,
+           retry_on=(Exception,)):
+    """Skip-and-log bad records instead of killing the epoch (opt-in;
+    also exposed as ``fluid.io.robust``).
+
+    Wraps each `next()` on the underlying iterator: a transient exception
+    (matching `retry_on`) is logged and counted as one skipped record —
+    bounded by `max_skips`, after which the error propagates (a reader
+    that is ALL bad records must still fail loudly). Class-based
+    iterators simply continue past the bad record. A plain generator
+    dies on its first raise (Python semantics), so the decorator
+    recreates the reader and fast-forwards past everything already
+    consumed plus the bad record — bounded by `max_restarts`, assuming
+    the deterministic re-iteration a replayable reader provides
+    (file/dataset readers; NOT one-shot streams). Fast-forward
+    re-executes earlier records, so a generator record that fails
+    DETERMINISTICALLY cannot be skipped — the restart budget exhausts
+    and the error is re-raised (never a silent truncation); use a
+    class-based iterator for true skip-past-bad-record semantics.
+    `backoff_s` sleeps before each recovery for readers whose failures
+    are time-transient (e.g. remote storage)."""
+    log = logging.getLogger("paddle_tpu.reader.robust")
+
+    def _recreate(position):
+        return itertools.islice(reader(), position, None)
+
+    def data_reader():
+        import inspect
+
+        consumed = 0
+        skips = 0
+        restarts = 0
+        last_error = None  # last next() raised: detect a dead generator
+        it = reader()
+        # only a GENERATOR dies on raise; a class-based iterator that
+        # raised and then ends simply reached end-of-data
+        mortal = inspect.isgenerator(it)
+        while True:
+            try:
+                sample = next(it)
+            except StopIteration:
+                if last_error is None or not mortal:
+                    return
+                # the previous raise killed a generator: StopIteration
+                # here is death, not end-of-data — restart past the bad
+                # record (position = consumed good + skipped bad)
+                if restarts >= max_restarts:
+                    log.error(
+                        "reader died %d times at record ~%d; raising",
+                        restarts + 1, consumed + skips,
+                    )
+                    raise last_error
+                restarts += 1
+                last_error = None
+                if backoff_s:
+                    time.sleep(backoff_s)
+                it = _recreate(consumed + skips)
+            except retry_on as e:
+                skips += 1
+                if skips > max_skips:
+                    log.error(
+                        "reader exceeded max_skips=%d; re-raising", max_skips
+                    )
+                    raise
+                log.warning(
+                    "skipping bad record %d (skip %d/%d): %s: %s",
+                    consumed + skips, skips, max_skips,
+                    type(e).__name__, e,
+                )
+                last_error = e
+                if backoff_s:
+                    time.sleep(backoff_s)
+            else:
+                last_error = None
+                consumed += 1
+                yield sample
 
     return data_reader
 

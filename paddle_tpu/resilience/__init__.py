@@ -1,0 +1,52 @@
+"""Fault-tolerant training runtime: the failure model and its defenses.
+
+The ROADMAP's production north star means a training job must survive
+worker death, torn writes, and corrupt checkpoint files without a human
+pressing restart. This package supplies the three shared pieces every
+hardened path builds on:
+
+* `faults`     — deterministic, schedule-driven fault injection (kill a
+  worker at step N, corrupt/truncate a checkpoint file, fail or stall
+  file IO, raise transient RPC errors), configured by API or the
+  ``PADDLE_TPU_FAULTS`` env var. The tests and ``tools/chaos_train.py``
+  replay identical failure timelines through it.
+* `retry`      — one capped-exponential-backoff-with-jitter-and-deadline
+  policy used by the PS client, the in-graph lookup pull/push path, and
+  checkpoint file IO.
+* `supervisor` — gang supervision: poll all ranks, on first failure or
+  heartbeat-declared hang terminate + relaunch the whole gang from the
+  newest VALID checkpoint, under a restart budget with backoff.
+
+Crash-consistent checkpoint integrity itself (per-array CRC32 manifests,
+fallback chain walking, `*.corrupt` quarantine) lives with the
+checkpoint code in `paddle_tpu/incubate/checkpoint.py`; the serving
+replica circuit breaker lives with the engine in
+`paddle_tpu/serving/engine.py`. Both are driven by this package's
+harness in tests.
+"""
+
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFault,
+    TransientFault,
+    corrupt_file,
+)
+from paddle_tpu.resilience.retry import RetryPolicy
+from paddle_tpu.resilience.supervisor import (
+    GangFailedError,
+    GangSupervisor,
+    heartbeat_tick,
+)
+
+__all__ = [
+    "FaultInjector",
+    "GangFailedError",
+    "GangSupervisor",
+    "InjectedFault",
+    "RetryPolicy",
+    "TransientFault",
+    "corrupt_file",
+    "faults",
+    "heartbeat_tick",
+]

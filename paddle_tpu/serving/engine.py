@@ -18,7 +18,16 @@ Guarantees:
 * explicit backpressure: admission rejects with retry-after once the
   queue is full, instead of queueing unboundedly;
 * graceful drain: shutdown() stops admission, flushes partial batches,
-  and joins workers — no request admitted is ever silently dropped.
+  and joins workers — no request admitted is ever silently dropped;
+* replica quarantine: a circuit breaker per replica opens after
+  `breaker_threshold` CONSECUTIVE batch-run failures (a healthy batch
+  resets the count) and stops dispatching to that replica; after
+  `breaker_cooldown_s` the next batch is a PROBE — success re-admits
+  the replica, failure re-opens the breaker for another cooldown.
+  Lifecycle counters (batch_failures / breaker_opened / breaker_probes
+  / breaker_closed / breaker_reopened) flow through `stats()` and the
+  C ABI's PD_ServingStats JSON. Draining bypasses quarantine — on
+  shutdown every queued request gets an answer attempt.
 """
 
 import threading
@@ -27,6 +36,7 @@ import time
 import numpy as np
 
 from paddle_tpu import profiler
+from paddle_tpu.resilience import faults
 from paddle_tpu.serving.batcher import BatchPlan, BucketLattice, DynamicBatcher
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.queue import RequestQueue
@@ -41,9 +51,61 @@ from paddle_tpu.serving.request import (
 __all__ = ["ServingEngine"]
 
 
+class _ReplicaBreaker:
+    """Per-replica circuit breaker: closed -> (K consecutive batch
+    failures) -> open -> (cooldown) -> half_open probe -> closed on
+    success / open again on failure. Only batch-level outcomes drive it;
+    per-request isolation failures are attributed to the request, not
+    the replica."""
+
+    def __init__(self, threshold, cooldown_s):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.consecutive = 0
+        self.opened_at = None
+        self._lock = threading.Lock()
+
+    def gate(self):
+        """Dispatch decision: ('dispatch' | 'probe' | 'wait', wait_s)."""
+        with self._lock:
+            if self.state == "closed":
+                return "dispatch", 0.0
+            if self.state == "half_open":
+                return "probe", 0.0
+            remaining = self.cooldown_s - (time.perf_counter() - self.opened_at)
+            if remaining > 0:
+                return "wait", remaining
+            self.state = "half_open"
+            return "probe", 0.0
+
+    def record_failure(self):
+        with self._lock:
+            self.consecutive += 1
+            if self.state == "half_open":
+                self.state = "open"
+                self.opened_at = time.perf_counter()
+                return "breaker_reopened"
+            if self.state == "closed" and self.consecutive >= self.threshold:
+                self.state = "open"
+                self.opened_at = time.perf_counter()
+                return "breaker_opened"
+            return None
+
+    def record_success(self):
+        with self._lock:
+            self.consecutive = 0
+            if self.state == "half_open":
+                self.state = "closed"
+                self.opened_at = None
+                return "breaker_closed"
+            return None
+
+
 class ServingEngine:
     def __init__(self, config_or_predictor, lattice=None, num_replicas=1,
-                 queue_depth=256, max_wait_ms=5.0):
+                 queue_depth=256, max_wait_ms=5.0, breaker_threshold=3,
+                 breaker_cooldown_s=1.0):
         from paddle_tpu.inference.predictor import Predictor
 
         if isinstance(config_or_predictor, Predictor):
@@ -88,6 +150,11 @@ class ServingEngine:
             feed_specs={n: s for n, (s, _) in self._feed_specs.items()},
             fetch_specs=fetch_specs,
         )
+        self._breakers = [
+            _ReplicaBreaker(breaker_threshold, breaker_cooldown_s)
+            if breaker_threshold and breaker_threshold > 0 else None
+            for _ in self._replicas
+        ]
         self._metrics = ServingMetrics()
         self._cond = threading.Condition(self._queue.lock)
         self._workers = []
@@ -116,7 +183,7 @@ class ServingEngine:
         self._started = True
         for i, rep in enumerate(self._replicas):
             t = threading.Thread(
-                target=self._worker, args=(rep,),
+                target=self._worker, args=(rep, self._breakers[i]),
                 name=f"serving-worker-{i}", daemon=True,
             )
             t.start()
@@ -231,8 +298,24 @@ class ServingEngine:
         return min(max(per_batch * max(batches, 1.0), 0.005), 5.0)
 
     # -- worker loop -------------------------------------------------------
-    def _worker(self, replica):
+    def _worker(self, replica, breaker=None):
         while True:
+            probing = False
+            # quarantine gate (bypassed while draining: every queued
+            # request deserves an answer attempt on shutdown)
+            if breaker is not None and not self._stop:
+                verdict, wait_s = breaker.gate()
+                if verdict == "wait":
+                    with self._cond:
+                        # deadlines keep expiring while quarantined — a
+                        # single-replica engine must still reject dead
+                        # requests at their deadline, not after cooldown
+                        for r in self._queue.expire():
+                            self._reject_expired(r)
+                        if not self._stop:
+                            self._cond.wait(timeout=min(wait_s, 0.1))
+                    continue
+                probing = verdict == "probe"
             with self._cond:
                 for r in self._queue.expire():
                     self._reject_expired(r)
@@ -246,7 +329,9 @@ class ServingEngine:
                         )
                     )
                     continue
-            self._execute(replica, plan)
+            if probing:
+                self._metrics.incr("breaker_probes")
+            self._execute(replica, plan, breaker)
 
     def _reject_expired(self, request):
         self._metrics.incr("deadline_missed")
@@ -256,18 +341,30 @@ class ServingEngine:
         ))
         self._metrics.observe_request(request)
 
-    def _execute(self, replica, plan):
+    def _breaker_event(self, event):
+        if event:
+            self._metrics.incr(event)
+
+    def _execute(self, replica, plan, breaker=None):
         t0 = time.perf_counter()
         try:
             feeds = self._batcher.assemble(plan)
             with profiler.RecordEvent("serving::batch_run"):
+                faults.fire("serving.run_batch")
                 outputs = replica.run_batch(feeds)
         except Exception:
             # one request poisoned the batch (bad buffer, runtime fault):
             # isolate by re-running each request alone at its own lattice
-            # point (still warmed — no retrace) so only the poison fails
+            # point (still warmed — no retrace) so only the poison fails.
+            # The breaker counts the batch-level outcome — K consecutive
+            # of these quarantine the replica.
+            self._metrics.incr("batch_failures")
+            if breaker is not None:
+                self._breaker_event(breaker.record_failure())
             self._isolate(replica, plan)
             return
+        if breaker is not None:
+            self._breaker_event(breaker.record_success())
         self._metrics.observe_batch(plan, time.perf_counter() - t0)
         for req, res in zip(plan.requests,
                             self._batcher.scatter(plan, outputs)):
@@ -284,6 +381,7 @@ class ServingEngine:
             try:
                 feeds = self._batcher.assemble(single)
                 with profiler.RecordEvent("serving::isolated_run"):
+                    faults.fire("serving.run_batch")
                     outputs = replica.run_batch(feeds)
             except Exception as e:
                 self._metrics.incr("failed")
@@ -306,9 +404,14 @@ class ServingEngine:
         cs = self._base.cache_stats()
         hits = cs["hits"] - self._warm_base["hits"]
         misses = cs["misses"] - self._warm_base["misses"]
+        breakers = [b.state for b in self._breakers if b is not None]
         return self._metrics.snapshot(extra={
             "queue_depth": self._queue.depth(),
             "num_replicas": len(self._replicas),
+            "breaker_states": breakers,
+            "breaker_open_replicas": sum(
+                1 for s in breakers if s != "closed"
+            ),
             "batch_buckets": list(self._lattice.batch_sizes),
             "seq_buckets": (list(self._lattice.seq_lens)
                             if self._lattice.seq_lens else None),

@@ -61,6 +61,9 @@ class ServingMetrics:
         "submitted", "admitted", "rejected", "rejected_queue_full",
         "rejected_shutdown", "rejected_invalid", "deadline_missed",
         "completed", "failed", "batches", "batched_rows", "padded_rows",
+        # replica circuit breaker (engine.py): quarantine/probe lifecycle
+        "batch_failures", "breaker_opened", "breaker_probes",
+        "breaker_closed", "breaker_reopened",
     )
 
     def __init__(self):

@@ -132,6 +132,134 @@ def test_heartbeat_monitor_detects_lost_worker():
         srv.stop()
 
 
+def _run_ckpt_worker(tmp_path, ckdir, fault_spec, steps=2):
+    """Subprocess that trains `steps` steps with blocking per-step saves
+    under a fault schedule — the real-crash (os._exit) counterpart of the
+    in-process raise-based tests in test_resilience.py."""
+    script = os.path.join(str(tmp_path), "ckpt_worker.py")
+    with open(script, "w") as f:
+        f.write(
+            """
+import os, sys
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+ckdir, steps = sys.argv[1], int(sys.argv[2])
+main, startup = Program(), Program()
+with program_guard(main, startup):
+    x = fluid.data("x", shape=[-1, 8])
+    y = fluid.data("y", shape=[-1, 1])
+    pred = fluid.layers.fc(x, size=1, num_flatten_dims=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+rng = np.random.RandomState(7)
+feed = {"x": rng.randn(8, 8).astype("float32"),
+        "y": rng.randn(8, 1).astype("float32")}
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    ck = AutoCheckpoint(exe, main, ckdir, save_interval_steps=1)
+    start = ck.resume()
+    for step in range(start, steps):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        ck.save(step, blocking=True)
+    ck.close()
+print("WORKER_DONE", start)
+"""
+        )
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault_spec is not None:
+        import json
+
+        env["PADDLE_TPU_FAULTS"] = json.dumps(fault_spec)
+    else:
+        env.pop("PADDLE_TPU_FAULTS", None)
+    return subprocess.run(
+        [sys.executable, script, ckdir, str(steps)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_kill_between_state_write_and_latest_pointer(tmp_path):
+    """A worker is HARD-KILLED (os._exit, no cleanup) between writing
+    state.npz and updating `latest`: the pointer is the commit point, so
+    a restarted worker resumes from the previous valid checkpoint."""
+    from paddle_tpu.incubate.checkpoint import load_checkpoint, verify_checkpoint
+
+    ckdir = str(tmp_path / "ck")
+    proc = _run_ckpt_worker(
+        tmp_path, ckdir,
+        [{"site": "checkpoint.before_latest", "action": "kill",
+          "at_step": 1}],
+        steps=2,
+    )
+    assert proc.returncode == 43, proc.stdout + proc.stderr
+    with open(os.path.join(ckdir, "latest")) as f:
+        assert f.read().strip() == "ckpt_0"  # step-1 save never committed
+    assert verify_checkpoint(os.path.join(ckdir, "ckpt_1"))[0] == 1
+    with fluid.scope_guard(fluid.Scope()):
+        assert load_checkpoint(ckdir) == 1  # resumes AFTER ckpt_0
+    # ... and the restarted worker replays to completion from there
+    proc2 = _run_ckpt_worker(tmp_path, ckdir, None, steps=2)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "WORKER_DONE 1" in proc2.stdout
+    with open(os.path.join(ckdir, "latest")) as f:
+        assert f.read().strip() == "ckpt_1"
+
+
+def test_kill_mid_state_write_then_corrupted_latest(tmp_path):
+    """Two stacked failures: a kill DURING the state write (torn tmp dir)
+    followed by on-disk corruption of the `latest` target; resume must
+    quarantine the corrupt entry and fall back to the older valid one."""
+    from paddle_tpu.incubate.checkpoint import load_checkpoint
+    from paddle_tpu.resilience import corrupt_file
+
+    ckdir = str(tmp_path / "ck")
+    proc = _run_ckpt_worker(
+        tmp_path, ckdir,
+        [{"site": "checkpoint.io", "action": "kill", "at_step": 2}],
+        steps=3,
+    )
+    assert proc.returncode == 43, proc.stdout + proc.stderr
+    assert os.path.isdir(os.path.join(ckdir, "ckpt_2.tmp"))  # torn debris
+    # now the newest COMMITTED checkpoint rots on disk
+    corrupt_file(os.path.join(ckdir, "ckpt_1", "state.npz"))
+    with fluid.scope_guard(fluid.Scope()):
+        assert load_checkpoint(ckdir) == 1  # walked back to ckpt_0
+    assert any(".corrupt" in d for d in os.listdir(ckdir))
+    proc2 = _run_ckpt_worker(tmp_path, ckdir, None, steps=3)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "WORKER_DONE 1" in proc2.stdout
+    assert not os.path.isdir(os.path.join(ckdir, "ckpt_2.tmp"))  # gc'd
+
+
+def test_chaos_train_full_acceptance():
+    """The chaos acceptance bar (tools/chaos_train.py, non-smoke scale):
+    one injected worker kill + one corrupted newest checkpoint under the
+    GangSupervisor -> auto-restart within budget, resume from the newest
+    valid checkpoint, final parameters bit-identical to an uninterrupted
+    run resumed from that same checkpoint."""
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PADDLE_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_train.py"),
+         "--steps", "20", "--interval", "4", "--kill-step", "11"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "CHAOS_OK" in proc.stdout
+
+
 def test_kill_a_worker_job_survives():
     """PS job with 2 trainers; SIGKILL one mid-run: the server stays up,
     the survivor finishes its steps, and the heartbeat table shows the
