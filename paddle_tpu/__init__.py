@@ -47,6 +47,7 @@ from paddle_tpu import trainer_desc
 from paddle_tpu import device_worker
 from paddle_tpu import contrib
 from paddle_tpu import metrics
+from paddle_tpu import observability
 from paddle_tpu import profiler
 from paddle_tpu import debugger
 from paddle_tpu import fleet
@@ -62,11 +63,14 @@ class FetchHandler:
     python/paddle/fluid/executor.py:406). Subclass and override handler();
     handler receives {fetch_name: value} built from the train_from_dataset
     fetch_list (var_dict is accepted for reference API parity — fetches are
-    selected by fetch_list here, not by this mapping)."""
+    selected by fetch_list here, not by this mapping). ``background=True``
+    moves delivery onto an observability.FetchHandlerMonitor thread so the
+    cadence holds even when single steps outlast period_secs."""
 
-    def __init__(self, var_dict=None, period_secs=60):
+    def __init__(self, var_dict=None, period_secs=60, background=False):
         self.var_dict = var_dict or {}
         self.period_secs = period_secs
+        self.background = background
 
     def handler(self, fetch_vars):
         import numpy as _np

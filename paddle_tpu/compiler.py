@@ -20,9 +20,15 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from paddle_tpu.core.executor import _interpret_block, plan_step
+from paddle_tpu.core.executor import (
+    _CACHE_HITS,
+    _CACHE_MISSES,
+    _interpret_block,
+    plan_step,
+)
 from paddle_tpu.core.scope import global_scope
-from paddle_tpu.parallel.env import make_mesh
+from paddle_tpu.observability.tracer import trace_scope
+from paddle_tpu.parallel.env import make_mesh, shard_map as _shard_map
 from paddle_tpu.utils.enforce import EnforceError, enforce
 from paddle_tpu.utils.flags import flags
 
@@ -318,10 +324,13 @@ class CompiledProgram:
                         f"dgc accumulator {n} has shape {cur}, "
                         f"expected {declared} or {(n_batch,) + declared}"
                     )
+        fresh_compile = entry is None
         if entry is None:
-            donated, readonly, written, live = plan_step(
-                block, feed_names, fetch_names, scope, flags.use_donation
-            )
+            _CACHE_MISSES.inc()
+            with trace_scope("compiled_program::plan", ops=len(block.ops)):
+                donated, readonly, written, live = plan_step(
+                    block, feed_names, fetch_names, scope, flags.use_donation
+                )
             # shapes below come from scope vars — all of them must exist
             # BEFORE the entry is built, or a poisoned entry gets cached
             absent = [n for n in donated + readonly if not scope.has_var(n)]
@@ -401,7 +410,7 @@ class CompiledProgram:
                             for n in names
                         )
 
-                    return jax.shard_map(
+                    return _shard_map(
                         local_step,
                         mesh=mesh,
                         in_specs=(
@@ -465,6 +474,8 @@ class CompiledProgram:
                 tuple(feed_shardings),
             )
             self._cache[key] = entry
+        else:
+            _CACHE_HITS.inc()
         compiled, donated, readonly, written, scope_shardings = entry[:5]
         missing = [n for n in donated + readonly if not scope.has_var(n)]
         if missing:
@@ -493,7 +504,9 @@ class CompiledProgram:
             warnings.simplefilter("ignore")
             # mesh context: nested-shard_map ops (pipeline_stack) find the
             # mesh during tracing, which happens inside this first call
-            with mesh_context(mesh):
+            span = ("compiled_program::trace_compile_execute"
+                    if fresh_compile else "compiled_program::execute")
+            with mesh_context(mesh), trace_scope(span):
                 fetches, updates = compiled(
                     feed_vals, donated_vals, readonly_vals, rng_key
                 )

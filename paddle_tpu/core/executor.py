@@ -32,9 +32,25 @@ from paddle_tpu.core.ir import Program
 from paddle_tpu.core.places import CPUPlace, TPUPlace
 from paddle_tpu.core.backward import resolve_op_def as get_op_def
 from paddle_tpu.core.scope import global_scope
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import sanitizer as obs_sanitizer
+from paddle_tpu.observability.tracer import trace_scope
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.utils.enforce import EnforceError
 from paddle_tpu.utils.flags import flags
+
+# always-on executor telemetry (one scrape shows compile-cache behavior
+# next to serving stats and supervisor events); counter inc is the only
+# per-step registry cost on the hot compiled path
+_CACHE_HITS = obs_metrics.registry().counter(
+    "executor_cache_hits_total", "compiled-step cache hits"
+)
+_CACHE_MISSES = obs_metrics.registry().counter(
+    "executor_cache_misses_total", "compiled-step cache misses (traces)"
+)
+_COMPILE_SECONDS = obs_metrics.registry().histogram(
+    "executor_compile_seconds", "trace+compile latency on cache miss"
+)
 
 # op types handled structurally by the interpreter (they run sub-blocks);
 # `recurrent` is NOT here: it is a regular op whose lowering scans its
@@ -305,9 +321,11 @@ class Executor:
         ]
 
         block = program.global_block()
-        feed_arrays = {
-            name: self._to_device(value, block, name) for name, value in feed.items()
-        }
+        with trace_scope("executor::feed", nfeeds=len(feed)):
+            feed_arrays = {
+                name: self._to_device(value, block, name)
+                for name, value in feed.items()
+            }
 
         if flags.check_nan_inf or flags.benchmark:
             return self._run_interpreted(
@@ -384,6 +402,16 @@ class Executor:
         step = 0
         last = None
         last_handled = _time.monotonic()
+        # background=True on the FetchHandler moves delivery off the
+        # training loop onto a period-driven monitor thread (reference:
+        # FetchHandlerMonitor) — a long epoch reports on schedule even
+        # when single steps are slow
+        monitor = None
+        if fetch_list and fetch_handler is not None and getattr(
+                fetch_handler, "background", False):
+            from paddle_tpu.observability.fetcher import FetchHandlerMonitor
+
+            monitor = FetchHandlerMonitor(fetch_handler).start()
         # lookahead iteration ONLY for programs with in-graph remote tables
         # (distributed_embedding): the NEXT batch's ids are announced before
         # the current step runs, so the PS pull overlaps device compute —
@@ -397,39 +425,51 @@ class Executor:
         it = iter(dataset._iter_batches())
         feed = next(it, None)
         nxt = None
-        while feed is not None:
-            if lookahead:
-                nxt = next(it, None)
-                if nxt is not None:
-                    from paddle_tpu.distributed import lookup as _rl
+        try:
+            while feed is not None:
+                if lookahead:
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        from paddle_tpu.distributed import lookup as _rl
 
-                    _rl.prefetch_for_program(program, nxt)
-            out = worker.run_batch(
-                self, program, feed, fetch_list=fetch_list, scope=scope
-            )
-            last = out
-            if fetch_list and fetch_handler is not None:
-                # time-based callback cadence (reference: FetchHandlerMonitor
-                # wakes every period_secs, executor.py:406) with a step
-                # fallback so short runs still observe fetches
-                now = _time.monotonic()
-                if (
-                    now - last_handled >= fetch_handler.period_secs
-                    or step % print_period == 0
-                ):
+                        _rl.prefetch_for_program(program, nxt)
+                out = worker.run_batch(
+                    self, program, feed, fetch_list=fetch_list, scope=scope
+                )
+                last = out
+                if fetch_list and fetch_handler is not None:
                     names = [
-                        f if isinstance(f, str) else f.name for f in fetch_list
+                        f if isinstance(f, str) else f.name
+                        for f in fetch_list
                     ]
-                    fetch_handler.handler(dict(zip(names, out)))
-                    last_handled = now
-            elif fetch_list and (debug or step % print_period == 0):
-                msgs = [
-                    f"{info}={np.asarray(v).reshape(-1)[:1][0]:.6f}"
-                    for info, v in zip(fetch_info, out)
-                ]
-                print(f"step {step}: " + ", ".join(msgs))
-            step += 1
-            feed = nxt if lookahead else next(it, None)
+                    if monitor is not None:
+                        # background monitor owns the cadence; the loop
+                        # only publishes the newest values (one dict swap)
+                        monitor.update(dict(zip(names, out)))
+                    else:
+                        # in-loop cadence (reference: FetchHandlerMonitor
+                        # wakes every period_secs, executor.py:406) with a
+                        # step fallback so short runs still observe fetches
+                        now = _time.monotonic()
+                        if (
+                            now - last_handled >= fetch_handler.period_secs
+                            or step % print_period == 0
+                        ):
+                            fetch_handler.handler(dict(zip(names, out)))
+                            last_handled = now
+                elif fetch_list and (debug or step % print_period == 0):
+                    msgs = [
+                        f"{info}={np.asarray(v).reshape(-1)[:1][0]:.6f}"
+                        for info, v in zip(fetch_info, out)
+                    ]
+                    print(f"step {step}: " + ", ".join(msgs))
+                step += 1
+                feed = nxt if lookahead else next(it, None)
+        finally:
+            # a mid-epoch raise must not leak the monitor's daemon thread;
+            # the final tick delivers the last published fetch either way
+            if monitor is not None:
+                monitor.stop()
         worker.finish()
         return last
 
@@ -506,10 +546,13 @@ class Executor:
         )
         key = (program._uid, program._version, feed_sig, tuple(fetch_names))
         entry = self._cache.get(key)
+        fresh_compile = entry is None
         if entry is None:
-            donated, readonly, written_persistable, ops = plan_step(
-                block, feed_names, fetch_names, scope, flags.use_donation
-            )
+            _CACHE_MISSES.inc()
+            with trace_scope("executor::plan", ops=len(block.ops)):
+                donated, readonly, written_persistable, ops = plan_step(
+                    block, feed_names, fetch_names, scope, flags.use_donation
+                )
 
             num_mb = getattr(program, "_num_microbatches", 0)
             if num_mb and num_mb > 1:
@@ -540,6 +583,8 @@ class Executor:
             )
             entry = (compiled, donated, readonly, written_persistable)
             self._cache[key] = entry
+        else:
+            _CACHE_HITS.inc()
 
         compiled, donated, readonly, written_persistable = entry
         missing = [n for n in donated + readonly if not scope.has_var(n)]
@@ -555,17 +600,34 @@ class Executor:
         # steps skip the per-param device_put loop entirely — the step outputs
         # written back below are already committed device arrays.
         dev = self.place.jax_device()
-        feed_vals = tuple(feed_arrays[n] for n in sorted(feed_arrays))
-        donated_vals = tuple(
-            self._committed(scope, n, dev, store=False) for n in donated
-        )
-        readonly_vals = tuple(self._committed(scope, n, dev) for n in readonly)
-        rng_key = self._next_rng_key(program)
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")  # donation warnings on CPU backend
-            fetches, updates = compiled(
-                feed_vals, donated_vals, readonly_vals, rng_key
+        with trace_scope("executor::commit_inputs"):
+            feed_vals = tuple(feed_arrays[n] for n in sorted(feed_arrays))
+            donated_vals = tuple(
+                self._committed(scope, n, dev, store=False) for n in donated
             )
+            readonly_vals = tuple(
+                self._committed(scope, n, dev) for n in readonly
+            )
+        rng_key = self._next_rng_key(program)
+        # first call on a fresh entry runs jax tracing + XLA compile; a
+        # separate span name keeps compile time out of the execute track
+        if fresh_compile:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            with trace_scope("executor::trace_compile_execute"), \
+                    warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fetches, updates = compiled(
+                    feed_vals, donated_vals, readonly_vals, rng_key
+                )
+            _COMPILE_SECONDS.observe(_time.perf_counter() - t0)
+        else:
+            with trace_scope("executor::execute"), warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # donation warnings on CPU
+                fetches, updates = compiled(
+                    feed_vals, donated_vals, readonly_vals, rng_key
+                )
         for name, val in zip(written_persistable, updates):
             if val is not None:
                 # write back to the scope the variable LIVES in (reference
@@ -577,7 +639,8 @@ class Executor:
                 target = scope._find_owner(name) or scope
                 target._set_verified(name, val, dev)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            with trace_scope("executor::fetch", nfetch=len(fetches)):
+                return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     # ------------------------------------------------------------------
@@ -634,7 +697,8 @@ class Executor:
                             if hasattr(v, "block_until_ready"):
                                 v.block_until_ready()
             else:
-                outs = op_def.lowering()(ins, op_attrs)
+                with trace_scope("op::" + op.type, cat="op"):
+                    outs = op_def.lowering()(ins, op_attrs)
             for slot, names in op.outputs.items():
                 if slot not in outs:
                     continue
@@ -645,15 +709,11 @@ class Executor:
                     if val is None:
                         continue
                     env[name] = val
-                    if flags.check_nan_inf and jnp.issubdtype(
-                        jnp.asarray(val).dtype, jnp.floating
-                    ):
-                        if not bool(jnp.all(jnp.isfinite(val))):
-                            raise EnforceError(
-                                f"NaN/Inf in output {name}",
-                                op_type=op.type,
-                                op_callstack=op.attrs.get("op_callstack"),
-                            )
+                    if flags.check_nan_inf:
+                        # sanitizer mode (reference: nan_inf_utils_detail.cc):
+                        # names the op, the output var, value stats, and the
+                        # user callstack that built the op
+                        obs_sanitizer.check_output(op, name, val)
         for name, val in env.items():
             var = block._find_var_recursive(name)
             if var is not None and var.persistable:

@@ -434,12 +434,19 @@ class Predictor:
         """AOT-compile the pruned program for one input-shape bucket
         (reference: the predictor's first-run engine build; here it's an
         explicit jax .lower().compile() so serving never retraces)."""
+        from paddle_tpu.observability import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
         with self._cache_lock:
             hit = self._cache.get(sig)
             if hit is not None:
                 self._cache_stats["hits"] += 1
+                reg.counter("predictor_cache_hits_total",
+                            "AOT executable cache hits").inc()
                 return hit
             self._cache_stats["misses"] += 1
+            reg.counter("predictor_cache_misses_total",
+                        "AOT executable cache misses (compiles)").inc()
         import time as _time
 
         import jax
@@ -485,8 +492,11 @@ class Predictor:
                 .compile()
             )
         profiler.incr_counter("predictor.aot_compiles")
+        dt = _time.perf_counter() - t0
+        reg.histogram("predictor_compile_seconds",
+                      "AOT bucket compile latency").observe(dt)
         with self._cache_lock:
-            self._cache_stats["compile_s"] += _time.perf_counter() - t0
+            self._cache_stats["compile_s"] += dt
             self._cache[sig] = (executable, scope_names)
         return self._cache[sig]
 
@@ -503,12 +513,15 @@ class Predictor:
         the cache-signature format the warmup/bucket machinery matches."""
         import jax
 
+        from paddle_tpu.observability.tracer import trace_scope
+
         sig = tuple((v.shape, str(v.dtype)) for v in feed_vals)
         executable, scope_names = self._compiled(sig)
         dev = self._place.jax_device()
-        feed_dev = [jax.device_put(v, dev) for v in feed_vals]
-        weights = [self._scope.find_var(n) for n in scope_names]
-        return executable(tuple(feed_dev), tuple(weights))
+        with trace_scope("predictor::execute", cat="serving"):
+            feed_dev = [jax.device_put(v, dev) for v in feed_vals]
+            weights = [self._scope.find_var(n) for n in scope_names]
+            return executable(tuple(feed_dev), tuple(weights))
 
     # -- batched serving (paddle_tpu/serving drives these) -----------------
     def run_batch(self, feeds):

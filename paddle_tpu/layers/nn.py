@@ -116,8 +116,21 @@ def fc(
     )
     dtype = input.dtype
     input_shape = input.shape
+    enforce(
+        input_shape is not None,
+        f"fc input '{input.name}' has no inferred shape, so the weight "
+        "size is unknown at build time. Stack fc on layers that propagate "
+        "shape, or set the var's .shape explicitly",
+    )
+    feature_dims = list(input_shape[num_flatten_dims:])
+    enforce(
+        all(int(d) > 0 for d in feature_dims),
+        f"fc input '{input.name}' flattened feature dims {feature_dims} "
+        "contain a dynamic -1 dim; fc needs static feature dims (choose "
+        "num_flatten_dims so only leading dims are dynamic)",
+    )
     in_features = 1
-    for d in input_shape[num_flatten_dims:]:
+    for d in feature_dims:
         in_features *= d
     w = helper.create_parameter(
         helper.param_attr, shape=[in_features, size], dtype=dtype
@@ -383,7 +396,33 @@ def layer_norm(
     dtype = input.dtype
     import math
 
-    norm_shape = [int(math.prod(input.shape[begin_norm_axis:]))]
+    in_shape = list(input.shape) if input.shape is not None else None
+    enforce(
+        in_shape is not None,
+        "layer_norm input has no inferred shape; build it from layers "
+        "that propagate shape (fluid.data, fc, elementwise ops)",
+    )
+    if begin_norm_axis < 0:
+        begin_norm_axis += len(in_shape)
+    enforce(
+        0 < begin_norm_axis < len(in_shape),
+        f"begin_norm_axis {begin_norm_axis} out of range for input rank "
+        f"{len(in_shape)}",
+    )
+    norm_dims = in_shape[begin_norm_axis:]
+    if scale or shift:
+        # the scale/bias parameter is sized by the normalized region —
+        # a dynamic (-1) dim there has no buildable parameter shape
+        enforce(
+            all(int(d) > 0 for d in norm_dims),
+            f"layer_norm normalizes over dims {norm_dims} "
+            f"(begin_norm_axis={begin_norm_axis}) which contain a dynamic "
+            "-1 dim, so the Scale/Bias parameter size is unknown at build "
+            "time. Normalize over trailing static dims (e.g. "
+            "begin_norm_axis=-1 for the feature axis) or pass "
+            "scale=False, shift=False",
+        )
+    norm_shape = [int(math.prod(norm_dims))]
     inputs = {"X": [input.name]}
     if scale:
         s = helper.create_parameter(
@@ -407,6 +446,14 @@ def layer_norm(
         {"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
         {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
     )
+    # layer_norm is shape-preserving: guarantee the output shape even when
+    # abstract evaluation could not run (dynamic dims), so fc and friends
+    # stacked on top can always read .shape at build time
+    if out.shape is None:
+        out.shape = tuple(in_shape)
+    if mean.shape is None:
+        mean.shape = tuple(in_shape[:begin_norm_axis])
+        var.shape = tuple(in_shape[:begin_norm_axis])
     return helper.append_activation(out)
 
 

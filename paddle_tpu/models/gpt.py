@@ -32,6 +32,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel.ring import ring_attention_local
+
+from paddle_tpu.parallel.env import shard_map as _shard_map
 from paddle_tpu.parallel.moe import moe_ffn_local
 from paddle_tpu.parallel.pipeline import pipeline_apply, split_microbatches
 
@@ -292,7 +294,7 @@ def build_train_step(cfg, mesh, num_microbatches=2, lr=1e-3, b1=0.9, b2=0.95,
         return loss, grads
 
     data_spec = P("data", "seq")
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(specs, data_spec, data_spec),

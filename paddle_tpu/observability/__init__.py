@@ -1,0 +1,82 @@
+"""Unified observability substrate: spans, metrics, sanitizer, logging.
+
+One telemetry layer for the whole framework (SURVEY §5.1, §5.2, §5.5 —
+the reference's RecordEvent/DeviceTracer/timeline.py/FLAGS_check_nan_inf/
+FetchHandler stack, rebuilt TPU-native):
+
+* ``tracer``    — thread-aware span tracer (``trace_scope``) with a
+  Chrome-trace JSON exporter; open any run in chrome://tracing/Perfetto.
+* ``metrics``   — typed counters/gauges/bucketed histograms in one
+  registry with Prometheus-style text exposition (``scrape_text``).
+* ``sanitizer`` — the FLAGS_check_nan_inf interpreter mode: every op
+  output checked, violations named with the op and its user callstack.
+* ``logger``    — rate-limited structured logging + ``log_event`` (one
+  call fans out to the log, an instant trace event, and a counter).
+* ``fetcher``   — background periodic fetchers for long training loops
+  (FetchHandlerMonitor) and registry scrapes (PeriodicMetricsDump).
+
+The legacy surfaces (``paddle_tpu.profiler``, ``serving.metrics``,
+``resilience.supervisor`` events) are thin shims over this layer, so
+serving stats, gang-restart events, and compile-cache hit rates all land
+in ONE timeline and ONE scrape.
+"""
+
+from paddle_tpu.observability.tracer import (
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    export_chrome_trace,
+    get_tracer,
+    instant,
+    trace_scope,
+    tracing,
+    tracing_enabled,
+)
+from paddle_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+    scrape_text,
+)
+from paddle_tpu.observability.logger import (
+    RateLimitedLogger,
+    get_logger,
+    log_event,
+)
+from paddle_tpu.observability.sanitizer import (
+    NanInfError,
+    check_output,
+    sanitize_nan_inf,
+)
+from paddle_tpu.observability.fetcher import (
+    FetchHandlerMonitor,
+    PeriodicMetricsDump,
+)
+
+__all__ = [
+    "Tracer",
+    "trace_scope",
+    "instant",
+    "tracing",
+    "tracing_enabled",
+    "enable_tracing",
+    "disable_tracing",
+    "export_chrome_trace",
+    "get_tracer",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "scrape_text",
+    "RateLimitedLogger",
+    "get_logger",
+    "log_event",
+    "NanInfError",
+    "check_output",
+    "sanitize_nan_inf",
+    "FetchHandlerMonitor",
+    "PeriodicMetricsDump",
+]

@@ -1,0 +1,135 @@
+"""Background periodic fetchers for long-running loops.
+
+``FetchHandlerMonitor`` is the reference's FetchHandlerMonitor analog
+(reference: python/paddle/fluid/executor.py:406, trainer_factory.py):
+a daemon thread that wakes every ``handler.period_secs`` and delivers the
+most recent fetched values to the handler — decoupled from step cadence,
+so a slow dataset epoch still reports on schedule. The training loop
+publishes values via ``update()``; the monitor never touches the scope
+mid-step (the whole-block XLA design has no consistent mid-step scope to
+read — published fetches ARE the consistent snapshots).
+
+``PeriodicMetricsDump`` scrapes the metrics registry on a period to a
+file or callback — the flat-file analog of a Prometheus pull for rigs
+with no scraper.
+"""
+
+import threading
+
+from paddle_tpu.observability import metrics as _metrics
+
+__all__ = ["FetchHandlerMonitor", "PeriodicMetricsDump"]
+
+
+class _PeriodicThread:
+    """Shared machinery: daemon thread firing ``_tick`` every period;
+    stop() wakes it immediately and optionally fires once more."""
+
+    def __init__(self, period_secs):
+        self.period_secs = float(period_secs)
+        self._wake = threading.Event()
+        self._stopping = False
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._run, name=type(self).__name__, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            self._wake.wait(timeout=self.period_secs)
+            if self._stopping:
+                return
+            self._wake.clear()
+            self._tick()
+
+    def stop(self, final_tick=True, timeout=5.0):
+        if self._thread is None:
+            return
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout)
+        self._thread = None
+        if final_tick:
+            self._tick()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class FetchHandlerMonitor(_PeriodicThread):
+    """Delivers the latest published fetch dict to ``handler.handler``
+    every ``handler.period_secs`` seconds, on a background thread.
+
+        monitor = FetchHandlerMonitor(handler).start()
+        for batch in loop:
+            out = step(batch)
+            monitor.update({"loss": out[0]})
+        monitor.stop()          # fires one final delivery
+    """
+
+    def __init__(self, handler, period_secs=None):
+        super().__init__(period_secs if period_secs is not None
+                         else getattr(handler, "period_secs", 60))
+        self.handler = handler
+        self._lock = threading.Lock()
+        self._latest = None
+        self.deliveries = 0
+
+    def update(self, fetch_vars):
+        """Publish the newest fetched values (called from the training
+        loop each step; cheap — one dict swap under a lock)."""
+        with self._lock:
+            self._latest = dict(fetch_vars)
+
+    def _tick(self):
+        with self._lock:
+            latest = self._latest
+            self._latest = None
+        if latest is None:
+            return
+        try:
+            self.handler.handler(latest)
+            self.deliveries += 1
+        except Exception:
+            # a user handler must not kill the monitor (nor the loop)
+            from paddle_tpu.observability.logger import get_logger
+
+            get_logger("observability.fetcher").exception(
+                "fetch handler raised; continuing"
+            )
+
+
+class PeriodicMetricsDump(_PeriodicThread):
+    """Write the registry's Prometheus exposition to ``path`` (or call
+    ``fn(text)``) every ``period_secs``. The final scrape fires on
+    stop(), so short runs still leave one complete dump behind."""
+
+    def __init__(self, path_or_fn, period_secs=15.0, registry=None):
+        super().__init__(period_secs)
+        self._target = path_or_fn
+        self._registry = registry or _metrics.registry()
+        self.dumps = 0
+
+    def _tick(self):
+        text = self._registry.to_text()
+        if callable(self._target):
+            self._target(text)
+        else:
+            tmp = f"{self._target}.tmp-{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(text)
+            import os
+
+            os.replace(tmp, self._target)
+        self.dumps += 1

@@ -1,0 +1,88 @@
+"""NaN/Inf sanitizer for the interpreted executor path.
+
+The FLAGS_check_nan_inf analog (reference: paddle/fluid/framework/
+details/nan_inf_utils_detail.cc, hooked into op dispatch at
+operator.cc:1029): in interpreted execution every op output is checked
+for non-finite floats; the first violation raises an EnforceError naming
+the op type, the offending output variable, basic value statistics, and
+the op's recorded *user* Python callstack — the line of model code that
+built the bad op, not the executor internals.
+
+The compiled path is one fused XLA computation, so per-op checking only
+exists interpreted — the same graph-vs-dygraph trade the reference makes.
+Enable via ``FLAGS_check_nan_inf`` / ``fluid.set_flags`` or scoped:
+
+    with observability.sanitize_nan_inf():
+        exe.run(main, feed=..., fetch_list=[loss])   # per-op checked
+"""
+
+import contextlib
+
+import jax.numpy as jnp
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.utils.enforce import EnforceError
+
+__all__ = ["check_output", "sanitize_nan_inf", "NanInfError"]
+
+
+class NanInfError(EnforceError):
+    """A sanitized op produced NaN/Inf. ``op_type`` and ``var_name`` are
+    machine-readable; the message carries the user callstack."""
+
+    def __init__(self, message, op_type=None, op_callstack=None,
+                 var_name=None):
+        super().__init__(message, op_type=op_type, op_callstack=op_callstack)
+        self.var_name = var_name
+
+
+def _stats(arr):
+    """Small diagnostic summary; concrete arrays only (the interpreted
+    path guarantees that)."""
+    nan = int(jnp.isnan(arr).sum())
+    inf = int(jnp.isinf(arr).sum())
+    finite = arr[jnp.isfinite(arr)]
+    lo = float(finite.min()) if finite.size else float("nan")
+    hi = float(finite.max()) if finite.size else float("nan")
+    return nan, inf, lo, hi
+
+
+def check_output(op, name, val):
+    """Check one op output; raises NanInfError on the first non-finite
+    float value. Non-float outputs are skipped (ids, masks)."""
+    arr = jnp.asarray(val)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return
+    reg = _metrics.registry()
+    reg.counter("sanitizer_checks_total",
+                "op outputs checked by the NaN/Inf sanitizer").inc()
+    if bool(jnp.all(jnp.isfinite(arr))):
+        return
+    reg.counter("sanitizer_violations_total",
+                "op outputs containing NaN/Inf",
+                labels={"op": op.type}).inc()
+    nan, inf, lo, hi = _stats(arr)
+    finite_part = ("no finite values" if lo != lo
+                   else f"finite range [{lo:g}, {hi:g}]")
+    raise NanInfError(
+        f"NaN/Inf in output {name} of op '{op.type}' "
+        f"(shape {tuple(arr.shape)}, dtype {arr.dtype}: "
+        f"{nan} NaN, {inf} Inf, {finite_part})",
+        op_type=op.type,
+        op_callstack=op.attrs.get("op_callstack"),
+        var_name=name,
+    )
+
+
+@contextlib.contextmanager
+def sanitize_nan_inf():
+    """Scoped FLAGS_check_nan_inf: Executor.run inside the block takes the
+    interpreted per-op path with every output checked."""
+    from paddle_tpu.utils.flags import flags
+
+    old = flags.check_nan_inf
+    flags.check_nan_inf = True
+    try:
+        yield
+    finally:
+        flags.check_nan_inf = old

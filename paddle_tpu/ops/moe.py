@@ -20,7 +20,9 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
-from paddle_tpu.ops.common import first
+
+from paddle_tpu.parallel.env import shard_map as _shard_map
+from paddle_tpu.ops.common import first, vma_names
 from paddle_tpu.utils.enforce import EnforceError
 
 _ACTS = {
@@ -65,7 +67,7 @@ def _moe_ffn(ins, attrs):
     n = 1
     if mesh is not None and axis in mesh.axis_names:
         n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
-    if n > 1 and getattr(jax.typeof(xt), "vma", None):
+    if n > 1 and vma_names(xt):
         raise EnforceError(
             "moe_ffn cannot run inside an already-manual region (e.g. a "
             "pipeline_stack body); place the MoE layer on the outer program"
@@ -98,7 +100,7 @@ def _moe_ffn(ins, attrs):
             )
             return y, aux
 
-        y, aux = jax.shard_map(
+        y, aux = _shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis, None), P(), P(axis), P(axis), P(axis), P(axis)),

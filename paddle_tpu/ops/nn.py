@@ -16,6 +16,7 @@ from paddle_tpu.ops.common import (
     maybe,
     normalize_padding,
     rng_key,
+    vma_names,
 )
 from paddle_tpu.utils.enforce import EnforceError
 
@@ -414,7 +415,7 @@ def _sdpa_seq_parallel(ins, attrs):
     if sizes.get(axis, 1) <= 1:
         return None
     q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
-    if getattr(jax.typeof(q), "vma", None):
+    if vma_names(q):
         raise EnforceError(
             "seq_parallel scaled_dot_product_attention cannot run inside an "
             "already-manual region (e.g. a pipeline_stack body); shard the "

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.ops.common import vma_names
+
 try:  # pallas TPU backend is absent on some CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
@@ -113,7 +115,7 @@ def _sds(shape, dtype, *refs):
     checker rejects un-annotated out_shapes."""
     vma = frozenset()
     for r in refs:
-        vma |= getattr(jax.typeof(r), "vma", None) or frozenset()
+        vma |= vma_names(r)
     if vma:
         try:
             return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
@@ -451,7 +453,7 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     # closed_call caching bug (KeyError in cached_primitive_lowerings), so
     # off-TPU under shard_map use the numerically-identical jnp path; the
     # real chip always runs the Pallas kernel
-    if interpret and (getattr(jax.typeof(q), "vma", None) or frozenset()):
+    if interpret and vma_names(q):
         return _jnp_attention(q, k, v, bias, float(sm_scale), bool(causal))
     S = q.shape[2]
     bq = min(block_q, S)

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.ops.common import vma_names
+
 try:  # pragma: no cover - absent on CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 except Exception:  # pragma: no cover
@@ -37,7 +39,7 @@ def sparse_row_update(param, uniq_ids, merged_rows, interpret=None):
     uniq_ids [N] int32, merged_rows [N, D]. Returns the updated param."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    vma = getattr(jax.typeof(param), "vma", None) or frozenset()
+    vma = vma_names(param)
     if pltpu is None or (interpret and vma):
         return param.at[uniq_ids].add(merged_rows.astype(param.dtype))
     n, d = merged_rows.shape

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.ops.common import vma_names
+
 try:  # pragma: no cover - absent on CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
@@ -50,7 +52,7 @@ def blocked_topk_abs(x, k, block=131072, interpret=None):
     n = x.shape[0]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    vma = getattr(jax.typeof(x), "vma", None) or frozenset()
+    vma = vma_names(x)
     if (interpret and vma) or n <= 2 * k or n <= block:
         mag = jnp.abs(x)
         top_v, top_i = jax.lax.top_k(mag, k)

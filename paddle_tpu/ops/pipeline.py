@@ -23,6 +23,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core.registry import register_op
+
+from paddle_tpu.parallel.env import shard_map as _shard_map
 from paddle_tpu.utils.enforce import EnforceError
 
 
@@ -121,10 +123,11 @@ def _pipeline_stack(ins, attrs):
         )
         return outs.reshape(x.shape)
 
-    out = jax.shard_map(
+    out = _shard_map(
         sharded_fn,
         mesh=mesh,
         in_specs=(x_spec, P(stage_axis), in_param_specs, ex_specs),
         out_specs=x_spec,
+        body_has_pallas=True,  # stage bodies may lower sdpa through Pallas
     )(x, layer_ids, tuple(stacked), tuple(ex.values()))
     return {"Out": [out]}

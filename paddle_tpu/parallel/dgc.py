@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from paddle_tpu.parallel.env import shard_map as _shard_map
 
 
 def dgc_exchange_local(grad, residual, k, axis_name):
@@ -65,7 +66,7 @@ def dgc_allreduce(mesh, grads, residuals, sparsity=0.999, axis_name="data"):
                 new_r.reshape(r[0].shape)[None],
             )
 
-        return jax.shard_map(
+        return _shard_map(
             fn,
             mesh=mesh,
             in_specs=(P(axis_name), P(axis_name)),

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from paddle_tpu.parallel.env import shard_map as _shard_map
 
 
 def replicate_for_localsgd(params, n_replicas):
@@ -54,12 +55,15 @@ def localsgd_step_fn(grad_fn, optimizer_update, axis_name="data",
         # collective every step, erasing the 1/k bandwidth saving that is
         # the whole point; the predicate is replicated (derived from the
         # shared step counter) so all shards take the same branch
+        # pvary re-marks the (replicated) mean as axis-varying so both
+        # branches carry the same device-variance type under shard_map;
+        # older jax has no pvary (and no vma types to reconcile) — the
+        # mean is used as-is there
+        pvary = getattr(lax, "pvary", lambda x, _axes: x)
         synced = lax.cond(
             do_sync,
-            # pvary re-marks the (replicated) mean as axis-varying so both
-            # branches carry the same device-variance type under shard_map
             lambda ps: jax.tree.map(
-                lambda p: lax.pvary(lax.pmean(p, axis_name), axis_name), ps
+                lambda p: pvary(lax.pmean(p, axis_name), axis_name), ps
             ),
             lambda ps: ps,
             new_p,
@@ -98,7 +102,7 @@ def localsgd_train(mesh, params, opt_state, grad_fn, optimizer_update,
 
     spec_p = jax.tree.map(lambda _: P(axis_name), stacked)
     spec_b = jax.tree.map(lambda _: P(axis_name), batches)
-    run_sharded = jax.shard_map(
+    run_sharded = _shard_map(
         run,
         mesh=mesh,
         in_specs=(spec_p, P(), spec_b),

@@ -19,14 +19,17 @@ from jax import lax
 
 def _vary(x, axis):
     """pvary x over `axis` unless it already varies over it."""
-    try:
-        if axis in jax.typeof(x).vma:
-            return x
-    except AttributeError:
-        pass
+    # inline typeof/get_aval compat (ops.common.vma_names would pull the
+    # whole op library into this low-level module)
+    typeof = getattr(jax, "typeof", None)
+    aval = typeof(x) if typeof is not None else jax.core.get_aval(x)
+    if axis in (getattr(aval, "vma", None) or frozenset()):
+        return x
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axis, to="varying")
-    return lax.pvary(x, (axis,))
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis,))
+    return x  # pre-vma jax: nothing to re-mark
 
 
 def pipeline_apply(block_fn, stacked_params, x_mb, stage_axis,

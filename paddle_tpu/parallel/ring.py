@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from paddle_tpu.parallel.env import shard_map as _shard_map
 
 
 def _online_step(q, k_blk, v_blk, acc, m, l, scale, mask):
@@ -93,6 +94,6 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
     fn = functools.partial(
         ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+from paddle_tpu.parallel.env import shard_map as _shard_map
 
 
 def _full_attention(q, k, v, scale, causal):
@@ -53,6 +54,6 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None,
     fn = functools.partial(
         ulysses_attention_local, axis_name=seq_axis, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

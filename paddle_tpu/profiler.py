@@ -12,12 +12,22 @@ sorted report). TPU translation:
   executor path (profile_ops below); the whole-block compiled path is ONE
   XLA computation, so per-op host timing only exists in interpreted mode —
   the same trade the reference makes between graph and dygraph profiling.
+
+This module is now a thin shim over `paddle_tpu.observability`: every
+RecordEvent lands as a span on the tracer (when tracing is on — any run
+exports to chrome://tracing) and as a `profiler_event_seconds` histogram
+in the metrics registry; every `incr_counter` mirrors into
+`profiler_counter_total{name=...}`. The sorted-report API and the
+enable/disable gate keep their historical semantics.
 """
 
 import contextlib
 import os
 import time
 from collections import defaultdict
+
+from paddle_tpu.observability import metrics as _obs_metrics
+from paddle_tpu.observability import tracer as _obs_tracer
 
 __all__ = [
     "RecordEvent",
@@ -37,20 +47,45 @@ _counters = defaultdict(int)
 _enabled = False
 _trace_dir = None
 
+# registry mirrors created through this module (reset_profiler resets them)
+_counter_series = {}
+_hist_series = {}
+
+
+def _event_histogram(name):
+    h = _hist_series.get(name)
+    if h is None:
+        h = _hist_series[name] = _obs_metrics.registry().histogram(
+            "profiler_event_seconds", "RecordEvent span durations",
+            labels={"event": name},
+        )
+    return h
+
 
 class RecordEvent:
     """RAII host span (reference: profiler.h:205). Usable as context manager
-    or decorator; nests freely."""
+    or decorator; nests freely. Emits to the observability tracer whenever
+    tracing is enabled (independent of the profiler gate) and aggregates
+    into the sorted report when the profiler is enabled."""
+
+    __slots__ = ("name", "_t0", "_span")
 
     def __init__(self, name):
         self.name = name
         self._t0 = None
+        self._span = None
 
     def __enter__(self):
+        if _obs_tracer._TRACER.enabled:
+            self._span = _obs_tracer.trace_scope(self.name, cat="event")
+            self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            self._span = None
         if not _enabled:
             return False
         dt = time.perf_counter() - self._t0
@@ -59,7 +94,18 @@ class RecordEvent:
         rec[1] += dt
         rec[2] = max(rec[2], dt)
         rec[3] = min(rec[3], dt)
+        _event_histogram(self.name).observe(dt)
         return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with RecordEvent(self.name):
+                return fn(*a, **kw)
+
+        return wrapped
 
 
 def record_event(name):
@@ -70,9 +116,17 @@ def incr_counter(name, n=1):
     """Monotonic named counter (occurrence metric with no duration —
     e.g. serving admissions/rejections/batch rows). Gated on the same
     enable switch as RecordEvent; counters land in the report's counter
-    section and get_counters()."""
+    section, get_counters(), and the metrics registry
+    (`profiler_counter_total{name=...}`)."""
     if _enabled:
         _counters[name] += n
+        c = _counter_series.get(name)
+        if c is None:
+            c = _counter_series[name] = _obs_metrics.registry().counter(
+                "profiler_counter_total", "profiler occurrence counters",
+                labels={"name": name},
+            )
+        c.inc(n)
 
 
 def get_counters():
@@ -110,6 +164,10 @@ def stop_profiler(sorted_key="total", profile_path=None):
 def reset_profiler():
     _events.clear()
     _counters.clear()
+    for series in _counter_series.values():
+        series.reset()
+    for series in _hist_series.values():
+        series.reset()
 
 
 @contextlib.contextmanager

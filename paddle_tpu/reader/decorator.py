@@ -258,14 +258,30 @@ def robust(reader, max_skips=16, max_restarts=4, backoff_s=0.0,
     and the error is re-raised (never a silent truncation); use a
     class-based iterator for true skip-past-bad-record semantics.
     `backoff_s` sleeps before each recovery for readers whose failures
-    are time-transient (e.g. remote storage)."""
+    are time-transient (e.g. remote storage).
+
+    Skip logging is rate-limited through the observability layer: the
+    first `log_first_n` skips log individually, the rest are counted
+    silently (``reader_skipped_records_total`` in the metrics registry
+    keeps the live rate), and one summary line reports totals when the
+    epoch ends — a 10%-bad dataset does not turn the log into noise."""
     log = logging.getLogger("paddle_tpu.reader.robust")
+    log_first_n = 8
 
     def _recreate(position):
         return itertools.islice(reader(), position, None)
 
     def data_reader():
         import inspect
+
+        from paddle_tpu.observability import registry
+        from paddle_tpu.observability.logger import RateLimitedLogger
+
+        limited = RateLimitedLogger(log, max_records=log_first_n)
+        skip_counter = registry().counter(
+            "reader_skipped_records_total",
+            "records skipped by fluid.io.robust readers",
+        )
 
         consumed = 0
         skips = 0
@@ -280,6 +296,7 @@ def robust(reader, max_skips=16, max_restarts=4, backoff_s=0.0,
                 sample = next(it)
             except StopIteration:
                 if last_error is None or not mortal:
+                    limited.summarize(what="skipped records")
                     return
                 # the previous raise killed a generator: StopIteration
                 # here is death, not end-of-data — restart past the bad
@@ -289,6 +306,7 @@ def robust(reader, max_skips=16, max_restarts=4, backoff_s=0.0,
                         "reader died %d times at record ~%d; raising",
                         restarts + 1, consumed + skips,
                     )
+                    limited.summarize(what="skipped records")
                     raise last_error
                 restarts += 1
                 last_error = None
@@ -297,12 +315,14 @@ def robust(reader, max_skips=16, max_restarts=4, backoff_s=0.0,
                 it = _recreate(consumed + skips)
             except retry_on as e:
                 skips += 1
+                skip_counter.inc()
                 if skips > max_skips:
                     log.error(
                         "reader exceeded max_skips=%d; re-raising", max_skips
                     )
+                    limited.summarize(what="skipped records")
                     raise
-                log.warning(
+                limited.warning(
                     "skipping bad record %d (skip %d/%d): %s: %s",
                     consumed + skips, skips, max_skips,
                     type(e).__name__, e,

@@ -8,10 +8,12 @@ heartbeat-declared hang, terminate the gang, validate the checkpoint
 chain (quarantining corrupt entries so workers resume from the newest
 VALID checkpoint, incubate/checkpoint.py), and relaunch every worker —
 under a restart budget with backoff between attempts. Every decision is
-recorded as a structured event (``supervisor.events``) and mirrored into
-profiler counters (``resilience.rank_exit`` / ``resilience.hang`` /
-``resilience.restart`` / ``resilience.gang_ok`` /
-``resilience.gang_failed``).
+recorded as a structured event (``supervisor.events``) and fanned out
+through the observability layer: an instant event on the tracer (visible
+in the chrome timeline), a ``resilience_events_total{kind=...}`` counter
+in the metrics registry, and the legacy profiler counters
+(``resilience.rank_exit`` / ``resilience.hang`` / ``resilience.restart``
+/ ``resilience.gang_ok`` / ``resilience.gang_failed``).
 
 Workers announce liveness by calling ``heartbeat_tick()`` once per step;
 the supervisor injects ``PADDLE_RESILIENCE_HEARTBEAT_DIR`` so the helper
@@ -27,7 +29,7 @@ import os
 import tempfile
 import time
 
-from paddle_tpu import profiler
+from paddle_tpu import observability, profiler
 
 __all__ = ["GangSupervisor", "GangFailedError", "heartbeat_tick",
            "HEARTBEAT_DIR_ENV"]
@@ -92,6 +94,12 @@ class GangSupervisor:
     def _emit(self, kind, **fields):
         ev = dict(kind=kind, time=time.time(), **fields)
         self.events.append(ev)
+        observability.registry().counter(
+            "resilience_events_total", "gang supervisor decisions",
+            labels={"kind": kind},
+        ).inc()
+        observability.instant(f"resilience.{kind}", cat="resilience",
+                              **fields)
         profiler.incr_counter(f"resilience.{kind}")
         log.warning("supervisor: %s %s", kind, fields)
         return ev

@@ -20,6 +20,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability.tracer import trace_scope
 from paddle_tpu.serving.request import RejectedError
 
 __all__ = ["BucketLattice", "DynamicBatcher", "BatchPlan"]
@@ -266,6 +267,11 @@ class DynamicBatcher:
         """Build the padded feed dict for one plan. Per-request assembly
         failures raise RequestError-compatible exceptions upward; the
         engine isolates them (a bad request must not fail batchmates)."""
+        with trace_scope("serving::batch_form", cat="serving",
+                         rows=plan.real_rows, bucket=plan.bucket_rows):
+            return self._assemble(plan)
+
+    def _assemble(self, plan):
         first = plan.requests[0].inputs
         feeds = {}
         for name, proto in first.items():

@@ -1,59 +1,30 @@
-"""Serving metrics: always-on counters + latency reservoirs.
+"""Serving metrics: always-on registry-backed counters + latency histograms.
 
-Two sinks, one instrumentation point. The engine records into this
-module's always-on structures (a service must answer `stats()` whether
-or not anyone is profiling), and every recording is mirrored into
-profiler.py's event/counter machinery so a `with profiler.profiler():`
-session shows serving spans (queue wait, batch run) and counters next to
-the framework's own events — the same RecordEvent stream the reference
-used for op dispatch.
+One instrumentation point, three sinks. The engine records into the
+process-global observability registry (a service must answer `stats()`
+and a Prometheus scrape whether or not anyone is profiling), every
+recording is mirrored into profiler.py's event/counter machinery so a
+`with profiler.profiler():` session shows serving counters next to the
+framework's own events, and the engine's spans (queue wait, batch run)
+ride the tracer. Each ServingMetrics instance is one `engine=<label>`
+label set, so two engines in a process scrape as two series while each
+engine's `stats()` stays exact.
+
+Latency percentiles come from bucketed histograms (p50/p95/p99 by
+linear interpolation inside the target bucket) — O(buckets) memory at
+any traffic level, where the old ring-buffer reservoir held 4096
+samples per series.
 """
 
+import itertools
 import threading
 
 from paddle_tpu import profiler
+from paddle_tpu.observability import metrics as obs_metrics
 
 __all__ = ["ServingMetrics"]
 
-_RESERVOIR = 4096  # newest-N latency window per series
-
-
-class _Latency:
-    """Windowed latency series: count/total over all samples, percentile
-    over the newest `_RESERVOIR` (ring buffer — recent behavior is what
-    an SLO dashboard wants)."""
-
-    __slots__ = ("count", "total", "ring", "pos")
-
-    def __init__(self):
-        self.count = 0
-        self.total = 0.0
-        self.ring = []
-        self.pos = 0
-
-    def add(self, seconds):
-        self.count += 1
-        self.total += seconds
-        if len(self.ring) < _RESERVOIR:
-            self.ring.append(seconds)
-        else:
-            self.ring[self.pos] = seconds
-            self.pos = (self.pos + 1) % _RESERVOIR
-
-    def percentile(self, p):
-        if not self.ring:
-            return 0.0
-        data = sorted(self.ring)
-        k = min(len(data) - 1, max(0, int(round((p / 100.0) * (len(data) - 1)))))
-        return data[k]
-
-    def snapshot(self, prefix):
-        return {
-            f"{prefix}_count": self.count,
-            f"{prefix}_avg_s": self.total / max(self.count, 1),
-            f"{prefix}_p50_s": self.percentile(50),
-            f"{prefix}_p99_s": self.percentile(99),
-        }
+_ENGINE_SEQ = itertools.count()
 
 
 class ServingMetrics:
@@ -66,59 +37,89 @@ class ServingMetrics:
         "breaker_closed", "breaker_reopened",
     )
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = {name: 0 for name in self.COUNTERS}
-        self._queue_wait = _Latency()
-        self._run = _Latency()
-        self._total = _Latency()
-        self._occupancy_sum = 0.0
+    def __init__(self, engine_label=None, registry=None):
+        self._registry = registry or obs_metrics.registry()
+        self.engine_label = (engine_label
+                            or f"engine-{next(_ENGINE_SEQ)}")
+        labels = {"engine": self.engine_label}
+        self._counts = {
+            name: self._registry.counter(
+                f"serving_{name}_total", f"serving {name} count",
+                labels=labels,
+            )
+            for name in self.COUNTERS
+        }
+        self._queue_wait = self._registry.histogram(
+            "serving_queue_wait_seconds",
+            "submit-to-dispatch wait", labels=labels,
+        )
+        self._run = self._registry.histogram(
+            "serving_run_seconds", "batch execution latency", labels=labels,
+        )
+        self._total = self._registry.histogram(
+            "serving_latency_seconds", "submit-to-finish latency",
+            labels=labels,
+        )
+        # float sum feeding avg_batch_occupancy; a Counter because it only
+        # grows (sum of per-batch occupancies in (0, 1])
+        self._occupancy_sum = self._registry.counter(
+            "serving_batch_occupancy_sum",
+            "sum of per-batch row occupancy", labels=labels,
+        )
+        # batches/batched_rows/occupancy must move together for the
+        # derived averages in snapshot() to be consistent
+        self._batch_lock = threading.Lock()
+        # a ServingMetrics instance is one engine LIFETIME: re-creating an
+        # engine under a reused label must start from zero (the registry
+        # series are get-or-create, so without this a restart would resume
+        # the previous engine's totals)
+        for series in list(self._counts.values()) + [
+            self._queue_wait, self._run, self._total, self._occupancy_sum,
+        ]:
+            series.reset()
 
     def incr(self, name, n=1):
-        with self._lock:
-            self._counts[name] += n
+        self._counts[name].inc(n)
         profiler.incr_counter(f"serving.{name}", n)
 
     def observe_batch(self, plan, run_seconds):
-        with self._lock:
-            self._counts["batches"] += 1
-            self._counts["batched_rows"] += plan.real_rows
-            self._counts["padded_rows"] += plan.bucket_rows - plan.real_rows
-            self._occupancy_sum += plan.occupancy
-            self._run.add(run_seconds)
+        with self._batch_lock:
+            self._counts["batches"].inc()
+            self._counts["batched_rows"].inc(plan.real_rows)
+            self._counts["padded_rows"].inc(plan.bucket_rows - plan.real_rows)
+            self._occupancy_sum.inc(plan.occupancy)
+        self._run.observe(run_seconds)
         profiler.incr_counter("serving.batches")
         profiler.incr_counter("serving.batched_rows", plan.real_rows)
 
     def observe_request(self, request):
         """Called at completion: queue-wait + end-to-end latency."""
         finish = request.response.finish_time
-        with self._lock:
-            if request.dispatch_time is not None:
-                self._queue_wait.add(
-                    request.dispatch_time - request.submit_time
-                )
-            if finish is not None:
-                self._total.add(finish - request.submit_time)
+        if request.dispatch_time is not None:
+            self._queue_wait.observe(
+                request.dispatch_time - request.submit_time
+            )
+        if finish is not None:
+            self._total.observe(finish - request.submit_time)
 
     def count(self, name):
-        with self._lock:
-            return self._counts[name]
+        return self._counts[name].value
 
     def run_avg_s(self):
-        """O(1) mean batch-run latency (no percentile sorts — safe on
+        """O(1) mean batch-run latency (no percentile math — safe on
         the admission hot path)."""
-        with self._lock:
-            return self._run.total / max(self._run.count, 1)
+        return self._run.avg
 
     def snapshot(self, extra=None):
-        with self._lock:
-            out = dict(self._counts)
-            batches = max(out["batches"], 1)
-            out["avg_batch_occupancy"] = self._occupancy_sum / batches
-            out["avg_batch_rows"] = out["batched_rows"] / batches
-            out.update(self._queue_wait.snapshot("queue_wait"))
-            out.update(self._run.snapshot("run"))
-            out.update(self._total.snapshot("latency"))
+        with self._batch_lock:
+            out = {name: c.value for name, c in self._counts.items()}
+            occupancy_sum = self._occupancy_sum.value
+        batches = max(out["batches"], 1)
+        out["avg_batch_occupancy"] = occupancy_sum / batches
+        out["avg_batch_rows"] = out["batched_rows"] / batches
+        out.update(self._queue_wait.snapshot("queue_wait"))
+        out.update(self._run.snapshot("run"))
+        out.update(self._total.snapshot("latency"))
         if extra:
             out.update(extra)
         return out

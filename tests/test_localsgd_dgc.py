@@ -11,7 +11,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.parallel.env import make_mesh
+from paddle_tpu.parallel.env import make_mesh, shard_map
 from paddle_tpu.parallel.dgc import dgc_allreduce
 from paddle_tpu.parallel.localsgd import localsgd_train
 
@@ -247,7 +247,7 @@ def test_ir_dgc_sparse_wire_is_all_gather_of_topk(rng):
             )
         return outs["ParamOut"][0], outs["UOut"][0], outs["VOut"][0]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(), P("data"), P("data"), P("data"), P(), P()),
         out_specs=(P(), P("data"), P("data")),
