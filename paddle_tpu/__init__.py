@@ -56,6 +56,7 @@ from paddle_tpu import serving
 from paddle_tpu import passes
 from paddle_tpu import analysis
 from paddle_tpu import resilience
+from paddle_tpu import dataio
 
 
 class FetchHandler:

@@ -422,7 +422,22 @@ class Executor:
         lookahead = bool(
             getattr(getattr(program, "program", program), "_remote_tables", None)
         )
-        it = iter(dataset._iter_batches())
+        host_feed = lookahead or bool(
+            getattr(prog_obj, "_sparse_tables", None)
+        )
+        if host_feed:
+            # PS paths read feed ids on the HOST (PSWorker.run / the
+            # lookahead pull): keep the raw iterator — device-staging
+            # first would force a device->host copy per batch
+            it = iter(dataset._iter_batches())
+        else:
+            # dataio double-buffer: batch N+1 is device_put while batch N
+            # computes (the buffered_reader.cc overlap)
+            from paddle_tpu.dataio.prefetch import DevicePrefetcher
+
+            it = iter(DevicePrefetcher(dataset._iter_batches(), depth=2,
+                                       device=self.place.jax_device(),
+                                       name="train_from_dataset"))
         feed = next(it, None)
         nxt = None
         try:

@@ -1,0 +1,46 @@
+"""TPU-native data engine: deterministic multi-worker input pipelines.
+
+The fifth subsystem (SURVEY §5.7 — the reference's Dataset/data_feed.cc
++ buffered_reader.cc input plane, rebuilt with determinism and
+resumability as first-class properties):
+
+* ``source``   — deterministic sharded sources: per-rank epoch shards as
+  a pure function of (seed, epoch) via a local ``random.Random``.
+* ``engine``   — ``DataEngine``: a worker pool with round-robin
+  reassembly, so the emitted order is independent of worker timing;
+  plus ``parallel_map_ordered``, the same pool as a reusable map.
+* ``prefetch`` — ``DevicePrefetcher``: bounded double-buffer of
+  ``jax.device_put`` batches, sharding-aware for data-parallel meshes.
+* ``state``    — checkpointable iterator position (epoch, shard cursor,
+  RNG state, emitted-batch count) riding ``incubate/checkpoint.py``
+  manifests, so ``resume()`` restores data position exactly.
+
+DataLoader (``from_generator(num_workers=...)``) and
+``Dataset.set_num_workers`` ride this layer; everything reports
+``dataio::`` spans, queue-depth gauges, and producer/consumer wait
+histograms through the observability registry, and source reads are a
+``dataio.read`` fault site for the resilience harness.
+"""
+
+from paddle_tpu.dataio.engine import DataEngine, parallel_map_ordered
+from paddle_tpu.dataio.prefetch import DevicePrefetcher
+from paddle_tpu.dataio.source import FileSource, ListSource, ShardedSource
+from paddle_tpu.dataio.state import (
+    STATE_KEY,
+    IteratorState,
+    decode_state,
+    encode_state,
+)
+
+__all__ = [
+    "DataEngine",
+    "parallel_map_ordered",
+    "DevicePrefetcher",
+    "ShardedSource",
+    "ListSource",
+    "FileSource",
+    "IteratorState",
+    "STATE_KEY",
+    "encode_state",
+    "decode_state",
+]

@@ -1,0 +1,158 @@
+"""Device prefetch: bounded double-buffer of device-resident batches.
+
+Reference: paddle/fluid/operators/reader/buffered_reader.cc keeps two
+in-flight GPU copies on a dedicated CUDA stream so the H2D of batch N+1
+overlaps the compute of batch N. The TPU-native equivalent needs no
+stream management: ``jax.device_put`` dispatch is asynchronous, so a
+producer thread that stages the NEXT batch while the training loop runs
+the current one gets the same overlap; the bounded queue is the
+double-buffer (depth 2 by default — deeper only buys memory pressure).
+
+Sharding-aware placement: given a data-parallel mesh and the batch axis
+name, each array is placed with ``NamedSharding(mesh, P(batch_axis))``
+so every host stages ONLY its shard of the global batch (arrays whose
+leading dim does not divide across the axis are replicated instead).
+Without a mesh, arrays land on the default (or an explicit) device.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.dataio.engine import _abortable_put
+from paddle_tpu.observability import registry, trace_scope
+
+__all__ = ["DevicePrefetcher"]
+
+_END = object()
+
+
+class DevicePrefetcher:
+    """Iterate `batches` (dicts of arrays, or bare arrays) with a
+    background thread that device-puts `depth` batches ahead. Producer
+    exceptions re-raise in the consumer at the position they occurred;
+    abandoning iteration unblocks and stops the producer.
+
+    Checkpointing a prefetched engine: the producer thread consumes the
+    wrapped iterable up to `depth` batches AHEAD of the training loop,
+    so snapshotting the ENGINE's position directly would record batches
+    still sitting in the queue (resume would skip them). The prefetcher
+    therefore proxies checkpoint state itself — it pairs every staged
+    batch with the source's state at that point and exposes the pair
+    belonging to the last YIELDED batch. Attach the PREFETCHER, not the
+    engine:
+
+        pre = DevicePrefetcher(engine, depth=2)
+        ckpt = AutoCheckpoint(exe, prog, dirname, data_state=pre)
+    """
+
+    def __init__(self, batches, depth=2, mesh=None, batch_axis=None,
+                 device=None, name="prefetch"):
+        self._batches = batches
+        self._last_state = None  # source state as of the last YIELDED batch
+        self._depth = max(1, int(depth))
+        self._mesh = mesh
+        self._batch_axis = batch_axis
+        self._device = device
+        self._name = name
+        reg = registry()
+        self._depth_gauge = reg.gauge(
+            "dataio_queue_depth", "items buffered in pipeline queues",
+            labels={"pipeline": name, "queue": "prefetch"},
+        )
+        self._consumer_wait = reg.histogram(
+            "dataio_consumer_wait_seconds",
+            "time the consumer spent blocked waiting for the next result",
+            labels={"pipeline": name},
+        )
+        if mesh is not None and batch_axis is not None:
+            self._axis_size = mesh.shape[batch_axis]
+        else:
+            self._axis_size = None
+
+    # -- placement ---------------------------------------------------------
+    def _put_one(self, value):
+        arr = value if isinstance(value, jax.Array) else np.asarray(value)
+        if self._axis_size is not None:
+            if arr.ndim >= 1 and arr.shape[0] % self._axis_size == 0:
+                spec = PartitionSpec(self._batch_axis)
+            else:  # not batch-shaped along the axis: replicate
+                spec = PartitionSpec()
+            return jax.device_put(arr, NamedSharding(self._mesh, spec))
+        if self._device is not None:
+            return jax.device_put(arr, self._device)
+        return jax.device_put(arr)
+
+    def _stage(self, batch):
+        with trace_scope("dataio::device_put", cat="dataio"):
+            if isinstance(batch, dict):
+                return {k: self._put_one(v) for k, v in batch.items()}
+            if isinstance(batch, (list, tuple)):
+                return type(batch)(self._put_one(v) for v in batch)
+            return self._put_one(batch)
+
+    # -- checkpointable-state proxy ----------------------------------------
+    def state_dict(self):
+        """Source position as of the last batch the CONSUMER received —
+        not the producer's read-ahead position. Before any batch is
+        yielded, falls through to the source's current state."""
+        if self._last_state is not None:
+            return self._last_state
+        getter = getattr(self._batches, "state_dict", None)
+        return getter() if getter is not None else None
+
+    def load_state_dict(self, d):
+        self._batches.load_state_dict(d)
+        self._last_state = None
+
+    # -- iteration ---------------------------------------------------------
+    def __iter__(self):
+        q = queue.Queue(maxsize=self._depth)
+        err = []
+        stop = threading.Event()
+        get_state = getattr(self._batches, "state_dict", None)
+
+        def produce():
+            try:
+                for batch in self._batches:
+                    # snapshot the source position the moment the batch
+                    # left it: this pair is what state_dict() exposes
+                    # once the batch reaches the consumer
+                    st = get_state() if get_state is not None else None
+                    if not _abortable_put(q, (self._stage(batch), st),
+                                          stop):
+                        return
+            except BaseException as e:
+                err.append(e)
+            finally:
+                _abortable_put(q, _END, stop)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name=f"{self._name}-prefetch")
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                self._consumer_wait.observe(time.perf_counter() - t0)
+                self._depth_gauge.set(q.qsize())
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    return
+                batch, st = item
+                if st is not None:
+                    self._last_state = st
+                yield batch
+        finally:
+            stop.set()
+            while not q.empty():  # unblock producer, drop device buffers
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

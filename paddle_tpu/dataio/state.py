@@ -1,0 +1,101 @@
+"""Checkpointable iterator state: the data-position half of a checkpoint.
+
+PR 3 made parameter state crash-consistent, but a restored job still
+replayed or skipped data because the input iterator's position was not
+part of training state (the reference has the same hole: Dataset/
+data_feed.cc keep cursors in C++ channel objects that io.py never
+serializes). This module defines the schema — epoch, shard cursor, RNG
+state, emitted-batch count — plus the codec that rides the existing
+`incubate/checkpoint.py` manifests: the state is serialized to a JSON
+blob stored as a uint8 array under ``STATE_KEY`` inside ``state.npz``,
+so it inherits the per-array CRC32, the whole-file CRC, the atomic
+rename, and the corrupt-walkback behavior for free.
+"""
+
+import json
+
+import numpy as np
+
+__all__ = [
+    "STATE_KEY",
+    "STATE_VERSION",
+    "IteratorState",
+    "encode_state",
+    "decode_state",
+]
+
+# array name inside state.npz; dunder-prefixed so it can never collide
+# with a program variable name (verifier rejects those)
+STATE_KEY = "__dataio_state__"
+STATE_VERSION = 1
+
+
+class IteratorState:
+    """Plain data-position record.
+
+    epoch            current epoch number (0-based)
+    cursor           samples of THIS RANK's epoch shard already consumed
+                     by emitted batches (skipped records count: the
+                     cursor is a position in shard order, not a count of
+                     good samples)
+    emitted_batches  lifetime batch count across epochs (monotonic)
+    seed             base seed the per-epoch orders derive from
+    world / rank     shard geometry the cursor is valid under
+    rng              reserved: the engine derives every draw from
+                     (seed, epoch, idx), so no live generator state
+                     exists to save; custom sources that DO keep one can
+                     round-trip it here (JSON-serializable form)
+    """
+
+    def __init__(self, epoch=0, cursor=0, emitted_batches=0, seed=0,
+                 world=1, rank=0, rng=None):
+        self.epoch = int(epoch)
+        self.cursor = int(cursor)
+        self.emitted_batches = int(emitted_batches)
+        self.seed = int(seed)
+        self.world = int(world)
+        self.rank = int(rank)
+        self.rng = rng
+
+    def to_dict(self):
+        return {
+            "version": STATE_VERSION,
+            "epoch": self.epoch,
+            "cursor": self.cursor,
+            "emitted_batches": self.emitted_batches,
+            "seed": self.seed,
+            "world": self.world,
+            "rank": self.rank,
+            "rng": self.rng,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        version = d.get("version", STATE_VERSION)
+        if version > STATE_VERSION:
+            raise ValueError(
+                f"dataio state version {version} is newer than this "
+                f"build understands ({STATE_VERSION})"
+            )
+        return cls(
+            epoch=d.get("epoch", 0),
+            cursor=d.get("cursor", 0),
+            emitted_batches=d.get("emitted_batches", 0),
+            seed=d.get("seed", 0),
+            world=d.get("world", 1),
+            rank=d.get("rank", 0),
+            rng=d.get("rng"),
+        )
+
+
+def encode_state(d):
+    """dict -> uint8 ndarray of JSON bytes (an npz-storable array, so the
+    checkpoint manifest CRCs it like any parameter)."""
+    raw = json.dumps(d, sort_keys=True).encode("utf-8")
+    return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+def decode_state(arr):
+    """uint8 ndarray (or bytes) of JSON -> dict."""
+    raw = bytes(np.asarray(arr, dtype=np.uint8).tobytes())
+    return json.loads(raw.decode("utf-8"))

@@ -13,6 +13,7 @@ back as padded [B, maxlen] arrays plus a `<name>.lens` int64 vector
 
 import ctypes
 import os
+import threading
 
 import numpy as np
 
@@ -217,6 +218,7 @@ class DatasetBase:
     def __init__(self):
         self._batch_size = 1
         self._thread_num = 1
+        self._num_workers = 0
         self._filelist = []
         self._slots = []
         self._feed = None
@@ -227,6 +229,13 @@ class DatasetBase:
         self._pad_to = {}
         self._truncated_rows = {}
         self._warned_truncate = set()
+        self._truncate_lock = threading.Lock()
+        # the feed backend is a stateful cursor; passes may be driven
+        # from pipeline threads (num_workers / DevicePrefetcher), so
+        # access is lock-serialized and generation-stamped: starting a
+        # new pass invalidates any still-running producer of the old one
+        self._feed_lock = threading.Lock()
+        self._pass_gen = 0
 
     def truncated_row_counts(self):
         """Per-slot count of rows whose tokens were dropped by pad_to
@@ -239,6 +248,14 @@ class DatasetBase:
 
     def set_thread(self, thread_num):
         self._thread_num = thread_num
+
+    def set_num_workers(self, num_workers):
+        """Pad/assemble batches on the dataio ordered worker pool
+        (reference: data_feed.cc's parse threads). Batch ORDER is
+        unchanged — round-robin reassembly makes output order independent
+        of worker timing — only the numpy padding/bucketing work runs
+        concurrently with the training step."""
+        self._num_workers = int(num_workers)
 
     def set_filelist(self, filelist):
         self._filelist = list(filelist)
@@ -288,44 +305,77 @@ class DatasetBase:
             self._loaded = True
 
     # -- iteration ---------------------------------------------------------
+    def _assemble_batch(self, raw):
+        """Pad/bucket one raw batch ([(arr, lens)] per slot) into the feed
+        dict. Pure numpy — safe on the worker pool; the truncation
+        bookkeeping is the only shared state and sits under a lock."""
+        out = {}
+        for s, (arr, lens) in zip(self._slots, raw):
+            if s.length < 0:
+                want = self._pad_to.get(s.name)
+                if want is None:
+                    # bucket to next pow2 so step shapes stabilize
+                    want = 1 << max(int(np.ceil(np.log2(arr.shape[1]))), 0)
+                if arr.shape[1] < want:
+                    arr = np.pad(arr, [(0, 0), (0, want - arr.shape[1])])
+                elif arr.shape[1] > want:
+                    # truncation drops real tokens — make the data loss
+                    # visible (once per slot) instead of silent
+                    with self._truncate_lock:
+                        self._truncated_rows[s.name] = self._truncated_rows.get(
+                            s.name, 0
+                        ) + int(np.sum(lens > want))
+                        first = s.name not in self._warned_truncate
+                        self._warned_truncate.add(s.name)
+                    if first:
+                        import warnings
+
+                        warnings.warn(
+                            f"slot '{s.name}': batch length {arr.shape[1]} "
+                            f"exceeds pad_to={want}; truncating (tokens are "
+                            "dropped — raise pad_to to keep them). "
+                            "Truncated-row counts accumulate in "
+                            "dataset.truncated_row_counts()."
+                        )
+                    arr = arr[:, :want]
+            out[s.name] = arr
+            if self._emit_lengths and s.length < 0:
+                out[s.name + ".lens"] = np.minimum(lens, arr.shape[1])
+        return out
+
     def _iter_batches(self, drop_last=None):
         self._load()
         feed = self._feed
         drop = self._drop_last if drop_last is None else drop_last
-        feed.begin_pass(self._batch_size, drop)
-        while feed.next_batch() > 0:
-            out = {}
-            for i, s in enumerate(self._slots):
-                arr, lens = feed.batch_arrays(i)
-                if s.length < 0:
-                    want = self._pad_to.get(s.name)
-                    if want is None:
-                        # bucket to next pow2 so step shapes stabilize
-                        want = 1 << max(int(np.ceil(np.log2(arr.shape[1]))), 0)
-                    if arr.shape[1] < want:
-                        arr = np.pad(arr, [(0, 0), (0, want - arr.shape[1])])
-                    elif arr.shape[1] > want:
-                        # truncation drops real tokens — make the data loss
-                        # visible (once per slot) instead of silent
-                        self._truncated_rows[s.name] = self._truncated_rows.get(
-                            s.name, 0
-                        ) + int(np.sum(lens > want))
-                        if s.name not in self._warned_truncate:
-                            self._warned_truncate.add(s.name)
-                            import warnings
+        with self._feed_lock:
+            self._pass_gen += 1
+            gen = self._pass_gen
+            feed.begin_pass(self._batch_size, drop)
 
-                            warnings.warn(
-                                f"slot '{s.name}': batch length {arr.shape[1]} "
-                                f"exceeds pad_to={want}; truncating (tokens are "
-                                "dropped — raise pad_to to keep them). "
-                                "Truncated-row counts accumulate in "
-                                "dataset.truncated_row_counts()."
-                            )
-                        arr = arr[:, :want]
-                out[s.name] = arr
-                if self._emit_lengths and s.length < 0:
-                    out[s.name + ".lens"] = np.minimum(lens, arr.shape[1])
-            yield out
+        def raw_batches():
+            # the backend cursor is stateful, so raw extraction stays
+            # serial (one atomic next_batch+copy per lock hold); the
+            # numpy pad/assemble work is what parallelizes. A producer
+            # thread left over from an ABANDONED pass sees the bumped
+            # generation and stops instead of corrupting the new cursor.
+            while True:
+                with self._feed_lock:
+                    if gen != self._pass_gen:
+                        return  # superseded by a newer pass
+                    if feed.next_batch() <= 0:
+                        return
+                    raw = [feed.batch_arrays(i)
+                           for i in range(len(self._slots))]
+                yield raw
+
+        from paddle_tpu.dataio.engine import parallel_map_ordered
+
+        # num_workers=0 runs the pool's synchronous path — identical
+        # ordering/error contract and the same spans/metrics
+        yield from parallel_map_ordered(
+            raw_batches(), self._assemble_batch, self._num_workers,
+            name="dataset",
+        )
 
     def get_memory_data_size(self):
         return self._feed.size() if self._feed else 0

@@ -35,6 +35,7 @@ import zlib
 import numpy as np
 
 from paddle_tpu.core.scope import global_scope
+from paddle_tpu.dataio.state import STATE_KEY, decode_state, encode_state
 from paddle_tpu.io import array_crc32
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.retry import RetryPolicy
@@ -198,10 +199,18 @@ def newest_valid_checkpoint(dirname, quarantine=True, level="file"):
     return None
 
 
-def load_checkpoint(dirname, scope=None):
+def load_checkpoint(dirname, scope=None, data_state=None):
     """Restore the newest VALID checkpoint into the scope, walking back
     past corrupt/torn entries (quarantining them); returns the step
-    AFTER the checkpointed one (0 when nothing valid exists)."""
+    AFTER the checkpointed one (0 when nothing valid exists).
+
+    `data_state` (anything with load_state_dict(), e.g. a
+    dataio.DataEngine) additionally restores the input-iterator position
+    the checkpoint recorded under the ``__dataio_state__`` array — the
+    parameter half and the data half of training state come back from
+    the SAME verified manifest, so a resumed run neither replays nor
+    skips samples. Checkpoints written without data state leave the
+    iterator untouched (legacy behavior)."""
     scope = scope or global_scope()
     for name in _candidates(dirname):
         d = os.path.join(dirname, name)
@@ -210,8 +219,11 @@ def load_checkpoint(dirname, scope=None):
         except CheckpointCorruptError as e:
             _quarantine(d, str(e))
             continue
+        blob = arrays.pop(STATE_KEY, None)
         for n, a in arrays.items():
             scope.set(n, a)
+        if data_state is not None and blob is not None:
+            data_state.load_state_dict(decode_state(blob))
         return step + 1
     return 0
 
@@ -228,13 +240,14 @@ class AutoCheckpoint:
     """
 
     def __init__(self, exe, program, dirname, save_interval_steps=100,
-                 max_to_keep=3, scope=None, retry=None):
+                 max_to_keep=3, scope=None, retry=None, data_state=None):
         self._exe = exe
         self._program = program
         self._dir = dirname
         self._interval = int(save_interval_steps)
         self._keep = int(max_to_keep)
         self._scope = scope
+        self._data_state = data_state
         self._thread = None
         self._lock = threading.Lock()
         self._last_error = None
@@ -330,6 +343,13 @@ class AutoCheckpoint:
             v = scope.find_var(n)
             if v is not None:
                 snap[n] = np.asarray(v)
+        if self._data_state is not None:
+            # the iterator position is snapshotted at the SAME instant as
+            # the parameters, and rides the manifest (per-array CRC,
+            # atomic rename) like any other array
+            st = self._data_state.state_dict()
+            if st is not None:  # e.g. a prefetcher over a stateless source
+                snap[STATE_KEY] = encode_state(st)
         # one async writer at a time; a newer save supersedes a pending one
         self._join()
         if self._last_error is not None:
@@ -376,13 +396,22 @@ class AutoCheckpoint:
             self._thread.join()
             self._thread = None
 
+    def attach_data_state(self, provider):
+        """Register a checkpointable iterator (state_dict/load_state_dict,
+        e.g. dataio.DataEngine): subsequent saves snapshot its position
+        and resume() restores it."""
+        self._data_state = provider
+        return self
+
     # -- resume ----------------------------------------------------------
     def resume(self):
         """Restore the newest VALID checkpoint into the scope (verifying
         CRCs, walking back past corrupt/torn entries and quarantining
         them as *.corrupt); returns the step AFTER the checkpointed one
-        (0 on a fresh start)."""
-        return load_checkpoint(self._dir, scope=self._scope or global_scope())
+        (0 on a fresh start). An attached data_state gets its iterator
+        position restored from the same checkpoint."""
+        return load_checkpoint(self._dir, scope=self._scope or global_scope(),
+                               data_state=self._data_state)
 
     def close(self):
         """Join the async writer and SURFACE its failure (a failed last

@@ -1,43 +1,59 @@
-"""DataLoader: background-prefetching iterator feeding device memory.
+"""DataLoader: multi-worker batch assembly + device-prefetching iterator.
 
 Reference: python/paddle/fluid/reader.py — DataLoader.from_generator :168
 backed by a C++ blocking queue (reader/lod_tensor_blocking_queue.h) with
 double-buffer prefetch to GPU (reader/buffered_reader.cc). TPU-native
-equivalent: a bounded host queue drained by the training loop, with each
-batch asynchronously `jax.device_put` ahead of use — device transfer overlaps
-the current step's compute (XLA dispatch is async), which is the
-double-buffer effect without explicit CUDA streams.
+equivalent: the host pipeline rides ``paddle_tpu/dataio`` —
+``num_workers`` batches are assembled concurrently by the deterministic
+ordered worker pool (round-robin reassembly: output order is independent
+of worker timing), and ``DevicePrefetcher`` stages each batch with
+``jax.device_put`` ahead of use so device transfer overlaps the current
+step's compute (the double-buffer effect without explicit CUDA streams).
+
+Fed batches are validated against their feed vars here (dtype/shape by
+name, data_feeder.check_feed_array) — a mismatched feed fails at the
+loader with the variable named instead of as an opaque downstream XLA
+error.
 """
 
-import queue
-import threading
-
-import numpy as np
-
-import jax
-
-from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.data_feeder import DataFeeder, check_feed_array
 from paddle_tpu.utils.enforce import enforce
 
 __all__ = ["DataLoader", "PyReader"]
 
-_END = object()
-
 
 class _GeneratorLoader:
-    def __init__(self, feed_list, capacity, return_list):
+    def __init__(self, feed_list, capacity, return_list, num_workers=0):
         self._feed_list = feed_list
         self._capacity = capacity
         self._return_list = return_list
+        self._num_workers = int(num_workers)
         self._reader = None
         self._places = None
         self._feeder = None
         self._batch_reader = None
+        self._sample_transform = None
+
+    def _var_specs(self):
+        """(name, dtype, shape) per feed var; dtype/shape None for bare
+        string entries (no declaration to check against)."""
+        specs = []
+        for v in self._feed_list:
+            if isinstance(v, str):
+                specs.append((v, None, None))
+            else:
+                specs.append((v.name, v.dtype, v.shape))
+        return specs
 
     # -- configuration (reference: reader.py set_sample_generator etc.) ----
-    def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None, sample_transform=None):
+        """`sample_transform` (optional) is a per-sample preprocess
+        (decode/augment) applied on the worker pool when num_workers > 0
+        — the CPU-bound stage tools/bench_input.py measures."""
         from paddle_tpu.reader import decorator
 
+        self._sample_transform = sample_transform
         self.set_sample_list_generator(
             decorator.batch(reader, batch_size, drop_last=drop_last), places
         )
@@ -45,26 +61,54 @@ class _GeneratorLoader:
 
     def set_sample_list_generator(self, reader, places=None):
         feeder = DataFeeder(self._feed_list)
+        transform = self._sample_transform
+        num_workers = self._num_workers
+
+        def assemble(samples):
+            if transform is not None:
+                samples = [transform(s) for s in samples]
+            return feeder.feed(samples)
 
         def batch_reader():
-            for samples in reader():
-                yield feeder.feed(samples)
+            from paddle_tpu.dataio.engine import parallel_map_ordered
+
+            # num_workers=0 runs the pool's synchronous path: same
+            # ordering/error contract, same spans and queue metrics
+            yield from parallel_map_ordered(
+                reader(), assemble, num_workers, name="dataloader",
+            )
 
         self._batch_reader = batch_reader
         self._places = places
         return self
 
     def set_batch_generator(self, reader, places=None):
-        names = [
-            v if isinstance(v, str) else v.name for v in self._feed_list
-        ]
+        specs = self._var_specs()
+        names = [s[0] for s in specs]
+
+        def check(batch):
+            if not isinstance(batch, dict):
+                batch = dict(zip(names, batch))
+            missing = [n for n in names if n not in batch]
+            enforce(
+                not missing,
+                f"fed batch is missing feed variable(s) {missing}; "
+                f"expected {names}",
+            )
+            # validate declared vars in place; keys beyond the feed list
+            # (auxiliary feeds) pass through untouched
+            out = dict(batch)
+            for n, dtype, shape in specs:
+                if dtype is not None or shape is not None:
+                    out[n] = check_feed_array(n, batch[n], dtype, shape)
+            return out
 
         def batch_reader():
-            for batch in reader():
-                if isinstance(batch, dict):
-                    yield batch
-                else:
-                    yield dict(zip(names, batch))
+            from paddle_tpu.dataio.engine import parallel_map_ordered
+
+            yield from parallel_map_ordered(
+                reader(), check, self._num_workers, name="dataloader",
+            )
 
         self._batch_reader = batch_reader
         self._places = places
@@ -72,56 +116,23 @@ class _GeneratorLoader:
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self):
+        from paddle_tpu.dataio.prefetch import DevicePrefetcher
+
         enforce(self._batch_reader is not None, "no generator set on DataLoader")
-        q = queue.Queue(maxsize=self._capacity)
-        err = []
-        stop = threading.Event()
-
-        def _put(item):
-            # bounded put that aborts when the consumer abandoned iteration —
-            # otherwise the producer blocks forever holding `capacity`
-            # device-resident batches
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def produce():
-            try:
-                for feed in self._batch_reader():
-                    # async H2D: device transfer of batch N overlaps step N-1
-                    dev = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
-                    if not _put(dev):
-                        return
-            except BaseException as e:
-                err.append(e)
-            finally:
-                _put(_END)
-
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
         names = [v if isinstance(v, str) else v.name for v in self._feed_list]
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    if err:
-                        raise err[0]
-                    return
-                if self._return_list:
-                    yield [item[n] for n in names]
-                else:
-                    yield item
-        finally:
-            stop.set()
-            while not q.empty():  # unblock producer, drop device buffers
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+        # async H2D double buffer: device transfer of batch N overlaps
+        # step N-1 (the producer thread device-puts ahead)
+        # distinct pipeline label: the pool's reassembly wait and the
+        # training loop's prefetch wait are different stalls
+        prefetcher = DevicePrefetcher(
+            self._batch_reader(), depth=self._capacity,
+            name="dataloader-prefetch",
+        )
+        for item in prefetcher:
+            if self._return_list:
+                yield [item[n] for n in names]
+            else:
+                yield item
 
 
 class DataLoader:
@@ -133,13 +144,16 @@ class DataLoader:
         iterable=True,
         return_list=False,
         use_multiprocess=False,
+        num_workers=0,
     ):
         """Reference: python/paddle/fluid/reader.py:168. use_double_buffer /
         use_multiprocess are accepted for parity: prefetch is always on (the
-        producer thread device-puts ahead), and multiprocessing is
-        unnecessary for numpy-producing readers under the GIL-releasing
-        device transfer."""
-        return _GeneratorLoader(feed_list or [], capacity, return_list)
+        producer thread device-puts ahead). `num_workers > 0` assembles
+        batches on the dataio ordered worker pool — same batch order as
+        num_workers=0 (round-robin reassembly), more throughput when the
+        per-batch work (sample_transform + numpy stacking) is CPU-bound."""
+        return _GeneratorLoader(feed_list or [], capacity, return_list,
+                                num_workers=num_workers)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
@@ -156,8 +170,9 @@ class PyReader(_GeneratorLoader):
     """Non-iterable start/reset flavor (reference: reader.py:971 PyReader)."""
 
     def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
-                 iterable=True, return_list=False):
-        super().__init__(feed_list or [], capacity, return_list)
+                 iterable=True, return_list=False, num_workers=0):
+        super().__init__(feed_list or [], capacity, return_list,
+                         num_workers=num_workers)
         self._iter = None
 
     def decorate_sample_list_generator(self, reader, places=None):

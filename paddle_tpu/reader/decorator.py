@@ -55,19 +55,26 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
-    """Pool-based shuffling (reference: decorator.py shuffle)."""
+def shuffle(reader, buf_size, seed=None):
+    """Pool-based shuffling (reference: decorator.py shuffle).
+
+    `seed` makes the shuffle deterministic via a LOCAL
+    ``random.Random(seed)`` — fresh per iteration, so every epoch (and
+    every rerun) of a seeded reader replays the identical order, and
+    nothing perturbs or reads the module-global RNG. Default (seed=None)
+    keeps the reference behavior: the process-global ``random`` state."""
 
     def data_reader():
+        rng = random if seed is None else random.Random(seed)
         buf = []
         for e in reader():
             buf.append(e)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
-            random.shuffle(buf)
+            rng.shuffle(buf)
             yield from buf
 
     return data_reader

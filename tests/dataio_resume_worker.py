@@ -1,0 +1,96 @@
+"""Subprocess worker for the crash-resume determinism test.
+
+Trains a tiny linear model over a DataEngine-fed stream with
+AutoCheckpoint carrying the iterator position (data_state=engine). Every
+emitted batch is appended to a log file as
+``<tag> <global_batch_index> <sha256(x|y)> <loss>`` so the parent test
+can compare streams bit-for-bit. ``--kill-at-step N`` SIGKILLs the
+process right after step N (mid-epoch, after that step's checkpoint
+decision) — the crash the resume run recovers from.
+"""
+
+import argparse
+import hashlib
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.dataio import DataEngine, ListSource
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+N_SAMPLES = 64
+BATCH = 8
+
+
+def transform(i, rng):
+    # deterministic per-sample features + a derived-rng augmentation so
+    # the stream also proves the (seed, epoch, idx) rng contract
+    x = (np.full(4, float(i), dtype=np.float32) * 0.01
+         + np.float32(rng.random() * 1e-3))
+    return (x, np.array([x.sum()], dtype=np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckdir", required=True)
+    ap.add_argument("--log", required=True)
+    ap.add_argument("--tag", required=True)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--num-workers", type=int, default=2)
+    ap.add_argument("--save-interval", type=int, default=3)
+    ap.add_argument("--kill-at-step", type=int, default=-1)
+    args = ap.parse_args()
+
+    source = ListSource(list(range(N_SAMPLES)), seed=args.seed)
+    engine = DataEngine(source, transform=transform, batch_size=BATCH,
+                        drop_last=True, num_workers=args.num_workers)
+
+    main_p, startup = Program(), Program()
+    with program_guard(main_p, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        feeder = fluid.DataFeeder([x, y])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ck = AutoCheckpoint(exe, main_p, args.ckdir,
+                        save_interval_steps=args.save_interval,
+                        data_state=engine)
+    step = ck.resume()
+
+    with open(args.log, "a") as logf:
+        while engine.epoch < args.epochs:
+            for batch in engine:
+                feed = feeder.feed(batch)
+                out = exe.run(main_p, feed=feed, fetch_list=[loss])
+                h = hashlib.sha256()
+                h.update(np.ascontiguousarray(feed["x"]).tobytes())
+                h.update(np.ascontiguousarray(feed["y"]).tobytes())
+                logf.write(f"{args.tag} {engine.emitted_batches - 1} "
+                           f"{h.hexdigest()} {float(out[0][0]):.10e}\n")
+                logf.flush()
+                # blocking: the checkpoint (params + data position) must
+                # be durable before the injected kill can hit
+                ck.maybe_save(step, blocking=True)
+                if step == args.kill_at_step:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                step += 1
+    ck.close()
+    print(f"DONE step={step} emitted={engine.emitted_batches}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
