@@ -42,8 +42,12 @@ __all__ = ["Diagnostic", "verify_program", "verify_shardings"]
 _STRUCTURAL_OPS = frozenset({"while", "conditional_block", "feed", "fetch"})
 
 #: sub-blocks whose reads resolve through op-private state the IR doesn't
-#: express (StaticRNN memories) — use-before-def is not decidable there
-_OPAQUE_SUB_BLOCK_OPS = frozenset({"recurrent", "recurrent_grad"})
+#: express (StaticRNN memories; pipeline_stack binds its stage body's
+#: inputs — h_in, per-stage params — from the stacked tensors at run
+#: time) — use-before-def is not decidable there
+_OPAQUE_SUB_BLOCK_OPS = frozenset(
+    {"recurrent", "recurrent_grad", "pipeline_stack", "pipeline_stack_grad"}
+)
 
 
 class Diagnostic:

@@ -324,9 +324,7 @@ class CompiledProgram:
                         f"dgc accumulator {n} has shape {cur}, "
                         f"expected {declared} or {(n_batch,) + declared}"
                     )
-        fresh_compile = entry is None
         if entry is None:
-            _CACHE_MISSES.inc()
             with trace_scope("compiled_program::plan", ops=len(block.ops)):
                 donated, readonly, written, live = plan_step(
                     block, feed_names, fetch_names, scope, flags.use_donation
@@ -380,61 +378,67 @@ class CompiledProgram:
                             "with FLAGS_dgc_sparse_exchange=0"
                         )
 
-                def step(feed_vals, donated_vals, readonly_vals, rng_key):
-                    def local_step(feed_vals, donated_vals, readonly_vals,
-                                   rng_key):
-                        # decorrelate per-shard stochastic ops (dropout)
-                        rng_key = jax.random.fold_in(
-                            rng_key, lax.axis_index(batch_axis)
-                        )
-                        env = dict(zip(feed_names, feed_vals))
-                        env.update(zip(donated, donated_vals))
-                        env.update(zip(readonly, readonly_vals))
-                        with dgc_axis_context(batch_axis):
-                            _interpret_block(block, env, rng_key, ops=live)
-                        # scalar float fetches (losses/metrics of the local
-                        # shard) are cross-shard means; non-scalars were
-                        # rejected at entry build (the local view here
-                        # cannot tell a scalar from a batch shard)
-                        fetches = []
-                        for n in fetch_names:
-                            val = env[n]
-                            if "float" in str(val.dtype):
-                                val = lax.pmean(val, batch_axis)
-                            fetches.append(val)
-                        return fetches, [env.get(n) for n in written]
+                def make_step(blk, plan):
+                    (p_feed, p_fetch, p_donated, p_readonly, p_written,
+                     p_live) = plan
 
-                    def state_spec(names):
-                        return tuple(
-                            P(batch_axis) if n in dgc_state else P()
-                            for n in names
-                        )
+                    def step(feed_vals, donated_vals, readonly_vals, rng_key):
+                        def local_step(feed_vals, donated_vals,
+                                       readonly_vals, rng_key):
+                            # decorrelate per-shard stochastic ops (dropout)
+                            rng_key = jax.random.fold_in(
+                                rng_key, lax.axis_index(batch_axis)
+                            )
+                            env = dict(zip(p_feed, feed_vals))
+                            env.update(zip(p_donated, donated_vals))
+                            env.update(zip(p_readonly, readonly_vals))
+                            with dgc_axis_context(batch_axis):
+                                _interpret_block(blk, env, rng_key,
+                                                 ops=p_live)
+                            # scalar float fetches (losses/metrics of the
+                            # local shard) are cross-shard means;
+                            # non-scalars were rejected at entry build (the
+                            # local view here cannot tell a scalar from a
+                            # batch shard)
+                            fetches = []
+                            for n in p_fetch:
+                                val = env[n]
+                                if "float" in str(val.dtype):
+                                    val = lax.pmean(val, batch_axis)
+                                fetches.append(val)
+                            return fetches, [env.get(n) for n in p_written]
 
-                    return _shard_map(
-                        local_step,
-                        mesh=mesh,
-                        in_specs=(
-                            tuple(feed_specs),
-                            state_spec(donated),
-                            state_spec(readonly),
-                            P(),
-                        ),
-                        out_specs=(
-                            [P()] * len(fetch_names),
-                            list(state_spec(written)),
-                        ),
-                        # vma checking is off: param updates are invariant
-                        # by construction (the sparse exchange all_gathers
-                        # identical (idx, value) sets on every shard)
-                        check_vma=False,
-                    )(feed_vals, donated_vals, readonly_vals, rng_key)
+                        def state_spec(names):
+                            return tuple(
+                                P(batch_axis) if n in dgc_state else P()
+                                for n in names
+                            )
+
+                        return _shard_map(
+                            local_step,
+                            mesh=mesh,
+                            in_specs=(
+                                tuple(feed_specs),
+                                state_spec(p_donated),
+                                state_spec(p_readonly),
+                                P(),
+                            ),
+                            out_specs=(
+                                [P()] * len(p_fetch),
+                                list(state_spec(p_written)),
+                            ),
+                            # vma checking is off: param updates are
+                            # invariant by construction (the sparse exchange
+                            # all_gathers identical (idx, value) sets on
+                            # every shard)
+                            check_vma=False,
+                        )(feed_vals, donated_vals, readonly_vals, rng_key)
+
+                    return step
             else:
-                def step(feed_vals, donated_vals, readonly_vals, rng_key):
-                    env = dict(zip(feed_names, feed_vals))
-                    env.update(zip(donated, donated_vals))
-                    env.update(zip(readonly, readonly_vals))
-                    _interpret_block(block, env, rng_key, ops=live)
-                    return [env[n] for n in fetch_names], [env.get(n) for n in written]
+                # default step body (core/lowering.py) is exactly the
+                # non-dgc form
+                make_step = None
             scope_names = donated + readonly
             if self._param_rules is not None or self._param_overrides:
                 scope_shardings = derive_shardings(
@@ -463,20 +467,27 @@ class CompiledProgram:
                 None,
                 [scope_shardings.get(n) for n in written],
             )
-            compiled = jax.jit(
-                step,
-                in_shardings=in_shardings,
+            from paddle_tpu.core import lowering
+
+            entry, source = lowering.lower_step(
+                self._program, scope, feed_sig, fetch_names,
+                donate=flags.use_donation, make_step=make_step,
+                plan=(donated, readonly, written, live),
+                mesh=mesh, in_shardings=in_shardings,
                 out_shardings=out_shardings,
-                donate_argnums=((1,) if donated else ()),
+                extra_fingerprint=(("dgc", dgc_sparse),),
+                label="compiled_program",
             )
-            entry = (
-                compiled, donated, readonly, written, scope_shardings,
-                tuple(feed_shardings),
-            )
+            entry.meta["scope_shardings"] = scope_shardings
+            entry.meta["feed_shardings"] = tuple(feed_shardings)
+            if source == "trace":
+                _CACHE_MISSES.inc()
             self._cache[key] = entry
         else:
             _CACHE_HITS.inc()
-        compiled, donated, readonly, written, scope_shardings = entry[:5]
+        compiled = entry.fn
+        donated, readonly, written = entry.donated, entry.readonly, entry.written
+        scope_shardings = entry.meta["scope_shardings"]
         missing = [n for n in donated + readonly if not scope.has_var(n)]
         if missing:
             raise EnforceError(
@@ -485,7 +496,7 @@ class CompiledProgram:
             )
         feed_vals = tuple(
             _to_global(feed_arrays[n], sh)
-            for n, sh in zip(feed_names, entry[5])
+            for n, sh in zip(feed_names, entry.meta["feed_shardings"])
         )
         # commit scope inputs to their mesh shardings so first-step vs
         # steady-state layouts match — same fix as Executor._run_compiled
@@ -505,11 +516,12 @@ class CompiledProgram:
             # mesh context: nested-shard_map ops (pipeline_stack) find the
             # mesh during tracing, which happens inside this first call
             span = ("compiled_program::trace_compile_execute"
-                    if fresh_compile else "compiled_program::execute")
+                    if not entry.executed else "compiled_program::execute")
             with mesh_context(mesh), trace_scope(span):
                 fetches, updates = compiled(
                     feed_vals, donated_vals, readonly_vals, rng_key
                 )
+        entry.executed = True
         for name, val in zip(written, updates):
             if val is not None:
                 # owner-targeted (see Executor._run_compiled write-back)

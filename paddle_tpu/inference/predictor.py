@@ -259,11 +259,14 @@ class Predictor:
             if config.ir_optim():
                 self._analyze()
             self._cache = {}
-            self._cache_stats = {"hits": 0, "misses": 0, "compile_s": 0.0}
+            self._cache_stats = {"hits": 0, "misses": 0, "compile_s": 0.0,
+                                 "persistent_hits": 0}
             # clones run in concurrent serving workers; counter updates
-            # and cache writes need the shared lock (compiles don't hold
-            # it — a rare duplicate compile is cheaper than serializing)
+            # and cache writes need the shared lock (compiles run outside
+            # it — the shared lowering single-flights duplicate compiles
+            # for the same signature instead of serializing everything)
             self._cache_lock = threading.Lock()
+        self._rng0 = None
         self._inputs = {}
         self._outputs = {}
         block = self._program.global_block()
@@ -431,9 +434,14 @@ class Predictor:
         return True
 
     def _compiled(self, sig):
-        """AOT-compile the pruned program for one input-shape bucket
-        (reference: the predictor's first-run engine build; here it's an
-        explicit jax .lower().compile() so serving never retraces)."""
+        """AOT-compile the pruned program for one input-shape bucket,
+        through the shared lowering (core/lowering.py): mandatory verifier
+        pass, process-wide + persistent compile cache, and single-flight
+        dedupe — N clones warming the same bucket concurrently share ONE
+        compile instead of racing N duplicate traces (the lock-free
+        duplicate-compile window this replaces multiplied under replica
+        warmup). The serving hot path still calls a fixed AOT executable:
+        committed same-layout args, no per-call jit dispatch."""
         from paddle_tpu.observability import metrics as obs_metrics
 
         reg = obs_metrics.registry()
@@ -446,66 +454,70 @@ class Predictor:
                 return hit
             self._cache_stats["misses"] += 1
             reg.counter("predictor_cache_misses_total",
-                        "AOT executable cache misses (compiles)").inc()
+                        "AOT executable cache misses (bucket lookups that "
+                        "went to the shared lowering)").inc()
         import time as _time
 
-        import jax
-
-        from paddle_tpu.core.executor import _interpret_block, plan_step
-
-        block = self._program.global_block()
-        donated, readonly, _w, live = plan_step(
-            block, self._feed_names, self._fetch_names, self._scope,
-            use_donation=False,
-        )
-        scope_names = donated + readonly
-        feed_names, fetch_names = self._feed_names, self._fetch_names
-
-        def fn(feed_vals, scope_vals):
-            env = dict(zip(feed_names, feed_vals))
-            env.update(zip(scope_names, scope_vals))
-            _interpret_block(block, env, jax.random.PRNGKey(0), ops=live)
-            return [env[n] for n in fetch_names]
-
-        dev = self._place.jax_device()
-        feed_structs = tuple(
-            jax.ShapeDtypeStruct(s, d) for s, d in sig
-        )
-        weight_structs = tuple(
-            jax.ShapeDtypeStruct(
-                np.shape(self._scope.find_var(n)),
-                getattr(
-                    self._scope.find_var(n),
-                    "dtype",
-                    np.asarray(self._scope.find_var(n)).dtype,
-                ),
-            )
-            for n in scope_names
-        )
         from paddle_tpu import profiler
+        from paddle_tpu.core import lowering
 
+        feed_sig = tuple(
+            (n, tuple(s), str(d)) for n, (s, d) in zip(self._feed_names, sig)
+        )
         t0 = _time.perf_counter()
         with profiler.RecordEvent("predictor::aot_compile"):
-            executable = (
-                jax.jit(fn)
-                .lower(feed_structs, weight_structs)
-                .compile()
+            entry, source = lowering.lower_step(
+                self._program, self._scope, feed_sig, self._fetch_names,
+                donate=False, label="predictor",
             )
-        profiler.incr_counter("predictor.aot_compiles")
+            executable = entry.aot_compile(
+                lowering.abstract_signature(entry, feed_sig, self._scope)
+            )
         dt = _time.perf_counter() - t0
-        reg.histogram("predictor_compile_seconds",
-                      "AOT bucket compile latency").observe(dt)
+        if source == "trace":
+            # only the single-flight leader counts a compile; waiters,
+            # memory-tier hits, and persistent-cache loads don't
+            profiler.incr_counter("predictor.aot_compiles")
+            reg.histogram("predictor_compile_seconds",
+                          "AOT bucket compile latency").observe(dt)
+        elif source == "disk":
+            profiler.incr_counter("predictor.persistent_cache_hits")
         with self._cache_lock:
-            self._cache_stats["compile_s"] += dt
-            self._cache[sig] = (executable, scope_names)
+            if source == "trace":
+                self._cache_stats["compile_s"] += dt
+            elif source == "disk":
+                self._cache_stats["persistent_hits"] += 1
+            self._cache[sig] = (executable, entry.scope_names)
         return self._cache[sig]
 
     def cache_stats(self):
         """Compile-cache counters, shared across clones: {hits, misses,
-        compile_s}. A warmed serving fleet holds misses constant while
-        hits grow — the hit-rate metric ServingEngine.stats() reports."""
+        compile_s, persistent_hits}. A warmed serving fleet holds misses
+        constant while hits grow — the hit-rate metric
+        ServingEngine.stats() reports; persistent_hits counts buckets a
+        cold replica loaded from PADDLE_TPU_CACHE_DIR instead of
+        compiling."""
         with self._cache_lock:
             return dict(self._cache_stats)
+
+    def _rng_arg(self):
+        # the lowered step takes the rng key as an argument (shared 4-arg
+        # contract); inference programs are deterministic, so one
+        # committed zero key serves every call. MUST be built with the
+        # same flags-aware construction as lowering._rng_abstract (the
+        # AOT executable's input aval): under FLAGS_rng_impl != threefry
+        # a plain PRNGKey would be a dtype mismatch on every request.
+        if self._rng0 is None:
+            import jax
+
+            from paddle_tpu.utils.flags import flags
+
+            if flags.rng_impl != "threefry":
+                key = jax.random.key(0, impl=flags.rng_impl)
+            else:
+                key = jax.random.PRNGKey(0)
+            self._rng0 = jax.device_put(key, self._place.jax_device())
+        return self._rng0
 
     def _execute_feeds(self, feed_vals):
         """Shared execution tail for run()/run_batch(): signature,
@@ -521,7 +533,10 @@ class Predictor:
         with trace_scope("predictor::execute", cat="serving"):
             feed_dev = [jax.device_put(v, dev) for v in feed_vals]
             weights = [self._scope.find_var(n) for n in scope_names]
-            return executable(tuple(feed_dev), tuple(weights))
+            fetches, _updates = executable(
+                tuple(feed_dev), (), tuple(weights), self._rng_arg()
+            )
+            return fetches
 
     # -- batched serving (paddle_tpu/serving drives these) -----------------
     def run_batch(self, feeds):

@@ -323,7 +323,9 @@ def build_train_step(cfg, mesh, num_microbatches=2, lr=1e-3, b1=0.9, b2=0.95,
         v = jax.tree_util.tree_unflatten(tree, [n[2] for n in new])
         return (params, m, v, t), loss
 
-    jit_step = jax.jit(step, donate_argnums=(0,))
+    from paddle_tpu.core.lowering import jit_compile
+
+    jit_step = jit_compile(step, donate_argnums=(0,))
 
     def init_state(rng):
         params = init_params(rng, cfg)

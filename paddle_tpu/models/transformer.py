@@ -531,7 +531,9 @@ def make_beam_decoder(cfg, beam_size=4, max_len=None, length_penalty=0.6):
             jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0],
         )
 
-    return jax.jit(decode)
+    from paddle_tpu.core.lowering import jit_compile
+
+    return jit_compile(decode)
 
 
 class BucketedBeamTranslator:

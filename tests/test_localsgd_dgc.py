@@ -258,7 +258,9 @@ def test_ir_dgc_sparse_wire_is_all_gather_of_topk(rng):
         jnp.zeros((8, 1, dim)), jnp.zeros((8, 1, dim)),
         jnp.asarray(0.1), jnp.asarray(100.0),
     )
-    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    from paddle_tpu.core.lowering import jit_compile
+
+    hlo = jit_compile(fn).lower(*args).compile().as_text()
     assert "all-gather" in hlo, "sparse exchange must all_gather (idx, vals)"
     # k = ceil(1024 * 0.001) = 1 -> gathered buffers are tiny; the dense
     # gradient itself (f32[1024] per shard) must NOT be all-reduced

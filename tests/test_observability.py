@@ -484,6 +484,12 @@ def test_executor_cache_counters(rng):
 
 
 def test_executor_spans_cover_compile_and_execute(tracer, rng):
+    # the compile cache is content-addressed and process-wide: an
+    # identical program lowered by an earlier test would be served from
+    # the memory tier (no trace span) — start cold
+    from paddle_tpu.core import compile_cache
+
+    compile_cache.clear_memory_cache()
     main, startup = Program(), Program()
     with program_guard(main, startup):
         x = fluid.data("x", shape=[-1, 4])

@@ -60,8 +60,10 @@ def main():
         def loss_unfused(q, k, v):
             return (unfused(q, k, v, causal) ** 2).sum()
 
-        grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        grad_unfused = jax.jit(jax.grad(loss_unfused, argnums=(0, 1, 2)))
+        from paddle_tpu.core.lowering import jit_compile
+
+        grad_flash = jit_compile(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        grad_unfused = jit_compile(jax.grad(loss_unfused, argnums=(0, 1, 2)))
 
         # attention FLOPs fwd+bwd ~ 2 matmuls fwd + 5 bwd (dq,dk,dv,dp,recompute)
         flops = 7 * 2 * B * H * S * S * D * (0.5 if causal else 1.0)

@@ -75,6 +75,14 @@ def main():
         hlo.tiny_bert_parallel_text((2, 4), ("data", "model"),
                                     MEGATRON_RULES)
     )
+    lowered, donated, _main = hlo.adam_mlp_step_lowered()
+    report["adam_donation"] = {
+        "donated_inputs": len(donated),
+        "aliased_args": len(hlo.stablehlo_donated_args(lowered.as_text())),
+        "unfused_adam_chain_ops": len(
+            hlo.unfused_adam_chain_ops(lowered.compile().as_text())
+        ),
+    }
     print(json.dumps(report, indent=1))
 
 
