@@ -146,6 +146,7 @@ class CompiledProgram:
         self._param_rules = None      # pattern -> spec table (sharding.py)
         self._param_overrides = None  # exact name -> spec
         self._input_specs = None      # feed name -> spec (default: batch on 'data')
+        self._spec_layout = None      # SpecLayout registry (spec_layout.py)
 
     @property
     def program(self):
@@ -177,6 +178,7 @@ class CompiledProgram:
         param_rules=None,
         param_specs=None,
         input_specs=None,
+        spec_layout=None,
     ):
         """Generic SPMD compilation over an n-D mesh: DP (batch on 'data'),
         Megatron TP (params matched by `param_rules`/`param_specs` sharded on
@@ -185,13 +187,36 @@ class CompiledProgram:
         through the whole traced block and inserts the ICI collectives —
         the TPU-native answer to the reference's per-strategy graph builders
         (reference: paddle/fluid/framework/ir/multi_devices_graph_pass/
-        multi_devices_graph_pass.h:39-182, one C++ builder per strategy)."""
+        multi_devices_graph_pass.h:39-182, one C++ builder per strategy).
+
+        ``spec_layout`` routes parameter placement through the canonical
+        sharding layer (parallel/spec_layout.py): every parameter gets a
+        role-derived PartitionSpec (embeddings, column/row matmuls, norm
+        scales, optimizer slots inheriting their parent), ``param_specs``
+        still wins as exact per-var overrides, and the layout fingerprint
+        joins the compile-cache program fingerprint. ``True`` means "the
+        default registry"."""
         self._is_data_parallel = True
         self._loss_name = loss_name
         self._mesh = mesh if mesh is not None else make_mesh()
         self._param_rules = param_rules
         self._param_overrides = param_specs
         self._input_specs = input_specs
+        if spec_layout is True:
+            from paddle_tpu.parallel.spec_layout import SpecLayout
+
+            spec_layout = SpecLayout()
+        if spec_layout is not None and param_rules is not None:
+            # one placement authority: a pattern table alongside the
+            # registry would be silently ignored — refuse instead (exact
+            # per-var pins belong in param_specs / layout.override())
+            raise EnforceError(
+                "with_parallel: pass either spec_layout (the role "
+                "registry) or param_rules (a pattern table), not both; "
+                "use param_specs or SpecLayout.override() for exact "
+                "per-var placements"
+            )
+        self._spec_layout = spec_layout
         return self
 
     # ------------------------------------------------------------------
@@ -440,7 +465,19 @@ class CompiledProgram:
                 # non-dgc form
                 make_step = None
             scope_names = donated + readonly
-            if self._param_rules is not None or self._param_overrides:
+            layout_sig = None
+            if self._spec_layout is not None:
+                # canonical sharding layer: role-derived specs for every
+                # scope input, exact param_specs layered on top
+                scope_shardings = self._spec_layout.derive_shardings(
+                    self._program,
+                    scope_names,
+                    [np.shape(scope.find_var(n)) for n in scope_names],
+                    mesh,
+                    overrides=self._param_overrides,
+                )
+                layout_sig = self._spec_layout.fingerprint()
+            elif self._param_rules is not None or self._param_overrides:
                 scope_shardings = derive_shardings(
                     scope_names,
                     [np.shape(scope.find_var(n)) for n in scope_names],
@@ -475,6 +512,7 @@ class CompiledProgram:
                 plan=(donated, readonly, written, live),
                 mesh=mesh, in_shardings=in_shardings,
                 out_shardings=out_shardings,
+                layout_sig=layout_sig,
                 extra_fingerprint=(("dgc", dgc_sparse),),
                 label="compiled_program",
             )

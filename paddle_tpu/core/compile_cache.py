@@ -148,15 +148,22 @@ def program_fingerprint(
     donate=True,
     mesh=None,
     sharding_sig=None,
+    layout_sig=None,
     extra=(),
 ):
     """Content-addressed identity of one lowered step.
 
     ``feed_sig``/``scope_sig`` are (name, shape, dtype) tuples;
-    ``sharding_sig`` any JSON-able description of the partition specs.
-    The jax version and backend are always mixed in: a version bump or a
-    backend switch invalidates every persisted entry (fall back to
-    retrace — never a wrong answer from a stale module)."""
+    ``sharding_sig`` any JSON-able description of the partition specs;
+    ``layout_sig`` the SpecLayout registry fingerprint when placement
+    came from the canonical sharding layer (parallel/spec_layout.py) —
+    editing a role's spec must retrace even though the per-step
+    sharding_sig already covers the RESOLVED specs (the layout also owns
+    future placement of vars this step does not touch, and two processes
+    with the same layout must agree on the fingerprint without resolving
+    first). The jax version and backend are always mixed in: a version
+    bump or a backend switch invalidates every persisted entry (fall
+    back to retrace — never a wrong answer from a stale module)."""
     import jax
 
     from paddle_tpu.utils.flags import flags
@@ -174,6 +181,12 @@ def program_fingerprint(
         "backend": jax.default_backend(),
         "extra": list(extra),
     }
+    if layout_sig is not None:
+        # added only when a registry drives placement, so fingerprints of
+        # layout-less lowerings (everything the persistent tier holds)
+        # are byte-identical to pre-registry revisions — a deploy of this
+        # code does not cold-miss an existing PADDLE_TPU_CACHE_DIR
+        payload["layout"] = layout_sig
     h = hashlib.sha256()
     h.update(program.to_bytes())
     h.update(b"\0")

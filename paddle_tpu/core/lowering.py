@@ -207,6 +207,7 @@ def lower_step(
     mesh=None,
     in_shardings=None,
     out_shardings=None,
+    layout_sig=None,
     extra_fingerprint=(),
     use_cache=True,
     persist=None,
@@ -258,6 +259,7 @@ def lower_step(
     fingerprint = compile_cache.program_fingerprint(
         program, feed_sig, fetch_names, scope_sig,
         donate=with_donation, mesh=mesh, sharding_sig=sharding_sig,
+        layout_sig=layout_sig,
         extra=(label.split(":", 1)[0],) + tuple(extra_fingerprint),
     )
 

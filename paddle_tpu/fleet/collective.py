@@ -34,6 +34,10 @@ class DistributedStrategy(BuildStrategy):
         self.param_rules = None      # Megatron-style TP rule table
         self.param_specs = None      # exact name -> PartitionSpec
         self.input_specs = None      # feed name -> PartitionSpec
+        # canonical sharding layer (parallel/spec_layout.py): a SpecLayout
+        # instance, or True for the default role registry — every param
+        # gets a role-derived spec; param_specs stay exact overrides
+        self.spec_layout = None
         # feature toggles, applied as program rewrites in minimize()
         self.use_amp = False
         self.amp_lists = None
@@ -90,6 +94,7 @@ class CollectiveOptimizer(DistributedOptimizer):
             param_rules=strategy.param_rules,
             param_specs=strategy.param_specs,
             input_specs=strategy.input_specs,
+            spec_layout=strategy.spec_layout,
         )
         fleet._main_program = compiled
         return optimize_ops, params_grads

@@ -4,3 +4,4 @@ from paddle_tpu.parallel.env import (
     make_mesh,
     ParallelEnv,
 )
+from paddle_tpu.parallel.spec_layout import Role, SpecLayout

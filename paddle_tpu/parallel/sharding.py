@@ -11,6 +11,16 @@ operand and inserts the all-reduces/all-gathers over ICI itself.
 A rule table is an ordered list of (regex, spec) pairs; first match wins —
 the same shape as the reference's AMP white/black lists
 (reference: python/paddle/fluid/contrib/mixed_precision/fp16_lists.py).
+
+NOTE: the CANONICAL placement path since PR 7 is the role registry in
+parallel/spec_layout.py (`CompiledProgram.with_parallel(spec_layout=...)`)
+— it derives a spec for EVERY parameter from the program IR, so nothing
+silently stays replicated (a replicated param whose grad is computed
+sharded pays a full weight-sized all-gather per step; MEGATRON_RULES
+left pos/type embeddings and task heads in exactly that state, the old
+tests/test_hlo.py tolerated failure). The registry builds on this
+module's `check_spec` validation and `_slot_parent` accumulator
+resolution; pattern tables remain for explicit, surgical layouts.
 """
 
 import re

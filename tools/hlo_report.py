@@ -7,9 +7,18 @@ The steps are lowered through the SAME shared builders the test gates use
 (paddle_tpu/utils/hlo.py), so the committed evidence cannot drift from the
 asserted computation. PROFILE.md links the committed snapshot.
 
-Usage: python tools/hlo_report.py   (~4 min on the CPU rig)
+Round 7 adds per-collective byte accounting (op kind x largest value the
+collective materializes) for the SpecLayout-registry tp and dp x fsdp x tp
+steps, with the MEGATRON_RULES lowering kept as the positive control —
+the committed HLO_EVIDENCE_r07.json records that registry-placed steps
+move ZERO full-parameter-shaped operands and stay activation-bounded
+while the old rule table pays weight-sized gathers.
+
+Usage: python tools/hlo_report.py [--out HLO_EVIDENCE_rNN.json]
+       (~4 min on the CPU rig)
 """
 
+import argparse
 import json
 import os
 import sys
@@ -44,6 +53,7 @@ def dot_census(txt):
 def main():
     from paddle_tpu.parallel.sharding import MEGATRON_RULES
 
+    out = parse_args().out  # fail fast on bad args, before ~4 min of work
     report = {}
     flash = hlo.bert_train_step_text(
         True, seq_len=S, vocab=VOCAB, max_pred=P
@@ -83,7 +93,66 @@ def main():
             hlo.unfused_adam_chain_ops(lowered.compile().as_text())
         ),
     }
-    print(json.dumps(report, indent=1))
+    report["spec_layout_r07"] = spec_layout_section()
+    text = json.dumps(report, indent=1)
+    print(text)
+    if out:
+        with open(out, "w") as f:
+            f.write(text + "\n")
+
+
+def spec_layout_section():
+    """Collective byte accounting for the canonical-sharding-layer steps
+    (what tests/test_hlo.py's registry gates assert, at the same
+    collision-free geometry: seq 24 so no activation shape equals a
+    parameter shape)."""
+    from paddle_tpu.parallel.sharding import MEGATRON_RULES
+    from paddle_tpu.parallel.spec_layout import SpecLayout
+
+    geo = dict(seq_len=24, max_pred=20, with_param_shapes=True)
+    sec = {"geometry": {"batch": 8, "seq_len": 24, "max_pred": 20}}
+
+    def account(txt, shapes, tag):
+        rep = hlo.collective_byte_report(txt)
+        sec[f"collectives_{tag}"] = hlo.count_collectives(txt)
+        sec[f"collective_bytes_{tag}"] = rep
+        sec[f"weight_shaped_collectives_{tag}"] = len(
+            hlo.weight_shaped_collectives(txt, shapes)
+        )
+        largest = 0
+        for s in shapes:
+            n = 4
+            for d in s:
+                n *= int(d)
+            largest = max(largest, n)
+        sec.setdefault("param_full_bytes", {
+            "largest": largest,
+            "shapes": sorted(list(s) for s in shapes),
+        })
+
+    txt, shapes = hlo.tiny_bert_parallel_text(
+        (2, 4), ("data", "model"), spec_layout=SpecLayout(), **geo
+    )
+    account(txt, shapes, "tp_registry")
+    txt, shapes = hlo.tiny_bert_parallel_text(
+        (2, 2, 2), ("data", "fsdp", "model"), spec_layout=SpecLayout(),
+        **geo
+    )
+    account(txt, shapes, "dp_fsdp_tp_registry")
+    # positive control: the PR-4-era rule table still pays weight-sized
+    # gathers for the params it leaves replicated — proves the detector
+    txt, shapes = hlo.tiny_bert_parallel_text(
+        (2, 4), ("data", "model"), param_rules=MEGATRON_RULES, **geo
+    )
+    account(txt, shapes, "megatron_control")
+    return sec
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this path")
+    return p.parse_args()
 
 
 if __name__ == "__main__":
