@@ -57,6 +57,7 @@ from paddle_tpu import passes
 from paddle_tpu import analysis
 from paddle_tpu import resilience
 from paddle_tpu import dataio
+from paddle_tpu import embedding
 
 
 class FetchHandler:

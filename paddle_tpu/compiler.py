@@ -230,11 +230,13 @@ class CompiledProgram:
         scope = scope or global_scope()
         fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
         from paddle_tpu.passes import (
+            apply_deferred_sharded_embedding_rewrite,
             apply_deferred_sparse_rewrite,
             resolve_tensor_array_indices,
         )
 
         apply_deferred_sparse_rewrite(self._program)
+        apply_deferred_sharded_embedding_rewrite(self._program)
         resolve_tensor_array_indices(self._program)
         block = self._program.global_block()
         mesh = self._mesh

@@ -617,11 +617,13 @@ class Executor:
     # ------------------------------------------------------------------
     def _run_compiled(self, program, feed_arrays, fetch_names, scope, return_numpy):
         from paddle_tpu.passes import (
+            apply_deferred_sharded_embedding_rewrite,
             apply_deferred_sparse_rewrite,
             resolve_tensor_array_indices,
         )
 
         apply_deferred_sparse_rewrite(program)
+        apply_deferred_sharded_embedding_rewrite(program)
         resolve_tensor_array_indices(program)
         block = program.global_block()
         feed_names = sorted(feed_arrays)
@@ -746,8 +748,12 @@ class Executor:
     def _run_interpreted(self, program, feed_arrays, fetch_names, scope, return_numpy):
         """Per-op debug path with NaN/Inf checking
         (reference: paddle/fluid/framework/details/nan_inf_utils_detail.cc)."""
-        from paddle_tpu.passes import resolve_tensor_array_indices
+        from paddle_tpu.passes import (
+            apply_deferred_sharded_embedding_rewrite,
+            resolve_tensor_array_indices,
+        )
 
+        apply_deferred_sharded_embedding_rewrite(program)
         resolve_tensor_array_indices(program)
         block = program.global_block()
         env = dict(feed_arrays)

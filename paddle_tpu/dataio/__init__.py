@@ -25,6 +25,7 @@ histograms through the observability registry, and source reads are a
 from paddle_tpu.dataio.engine import DataEngine, parallel_map_ordered
 from paddle_tpu.dataio.prefetch import DevicePrefetcher
 from paddle_tpu.dataio.source import FileSource, ListSource, ShardedSource
+from paddle_tpu.dataio.sparse import make_sparse_batch_transform, pad_slot
 from paddle_tpu.dataio.state import (
     STATE_KEY,
     IteratorState,
@@ -34,6 +35,8 @@ from paddle_tpu.dataio.state import (
 
 __all__ = [
     "DataEngine",
+    "make_sparse_batch_transform",
+    "pad_slot",
     "parallel_map_ordered",
     "DevicePrefetcher",
     "ShardedSource",

@@ -1,0 +1,75 @@
+"""Sparse CTR batch assembly: the ``sparse_batch`` sample transform.
+
+A click-log record carries VARIABLE-length feature-id lists per slot
+(the reference feeds these as LoDTensors; data_feed.cc's
+MultiSlotDataFeed parses exactly this layout). XLA wants fixed shapes,
+so the transform ports the reference's slot layout to a dense
+(ids, weights, dense) triple per slot:
+
+* ids pad to ``ids_per_slot`` by REPEATING the slot's first id — the
+  padding id is one the batch already contains, so the engine's dedup
+  gather (embedding/gather.py) admits no extra unique row for padding;
+* weights carry 1.0 for real ids and 0.0 for padding — the model
+  multiplies the looked-up rows by the weight, so padding contributes
+  exactly 0.0 to the pooled slot embedding (bit-exact against the
+  variable-length math, the serving padding discipline);
+* an EMPTY slot emits ids of 0 with all-zero weights (one dead unique
+  row, zero contribution).
+
+Built for the ordered worker pool: hand the transform to
+``DataLoader.from_generator(num_workers=N).set_sample_generator(...,
+sample_transform=...)`` (or any ``parallel_map_ordered`` stage) and the
+padding/truncation runs on the pool with the engine's deterministic
+ordering guarantees.
+"""
+
+import numpy as np
+
+__all__ = ["make_sparse_batch_transform", "pad_slot"]
+
+
+def pad_slot(ids, ids_per_slot, id_dtype="int64"):
+    """(ids [S], weights [S]) from a variable-length id list: truncate
+    past S, pad by repeating ids[0] at weight 0; empty -> zeros."""
+    s = int(ids_per_slot)
+    ids = list(ids)[:s]
+    n = len(ids)
+    if n == 0:
+        return (np.zeros(s, dtype=id_dtype),
+                np.zeros(s, dtype=np.float32))
+    out = np.full(s, ids[0], dtype=id_dtype)
+    out[:n] = np.asarray(ids, dtype=id_dtype)
+    w = np.zeros(s, dtype=np.float32)
+    w[:n] = 1.0
+    return out, w
+
+
+def make_sparse_batch_transform(slots, ids_per_slot, dense=(),
+                                label="click", id_dtype="int64"):
+    """Per-sample transform for CTR records shaped
+    ``{"slots": {name: [ids...]}, <dense fields...>, label: x}``.
+
+    Returns a tuple in feed order — for each slot name: ids [S],
+    weights [S]; then each dense field as float32; then the label as
+    float32 [1] — matching a feed_list declared in the same order
+    (examples/wide_deep.py). Samples missing a slot get the empty-slot
+    encoding."""
+    slots = list(slots)
+    dense = list(dense)
+
+    def transform(sample):
+        rec_slots = sample.get("slots", {})
+        out = []
+        for name in slots:
+            ids, w = pad_slot(rec_slots.get(name, ()), ids_per_slot,
+                              id_dtype)
+            out.append(ids)
+            out.append(w)
+        for name in dense:
+            out.append(np.asarray(sample[name], dtype=np.float32))
+        out.append(
+            np.asarray([sample[label]], dtype=np.float32)
+        )
+        return tuple(out)
+
+    return transform

@@ -110,11 +110,17 @@ class RemoteLookupContext:
     # -- host callbacks ----------------------------------------------------
     @staticmethod
     def _digest(ids):
-        """Content key for prefetch matching, canonicalized to uint64 — the
-        in-graph callback sees int32 (x64 disabled) while the prefetching
-        driver holds the original int64 feed."""
-        a = np.ascontiguousarray(np.asarray(ids).astype(np.uint64))
-        return (a.shape, hashlib.sha1(a.tobytes()).hexdigest())
+        """Content key for prefetch matching, canonicalized to a FLAT
+        uint64 view: the in-graph callback sees int32 (x64 disabled) and
+        sometimes a squeezed/unsqueezed shape, while the prefetching
+        driver holds the original int64 feed — dtype, memory order, and
+        trailing-1 shape differences must all hash identically or the
+        prefetch silently misses (the rows are content-addressed; the
+        requesting shape is reapplied at delivery in pull())."""
+        a = np.ascontiguousarray(
+            np.asarray(ids).astype(np.uint64).reshape(-1)
+        )
+        return (a.size, hashlib.sha1(a.tobytes()).hexdigest())
 
     def _pull_now(self, name, ids):
         t = self._tables[name]
@@ -147,7 +153,12 @@ class RemoteLookupContext:
                 pulled_at, rows = fut.result()
                 if pulled_at >= fence:
                     self.stats["prefetch_hits"] += 1
-                    return rows
+                    # the future was announced under the DRIVER's shape;
+                    # reshape to the requesting callback's (same content
+                    # by digest, possibly squeezed differently)
+                    return rows.reshape(
+                        tuple(np.shape(ids)) + (rows.shape[-1],)
+                    )
                 # the background pull timed out waiting for the fence and
                 # read PRE-push rows; the pushes landed afterwards, so the
                 # current count looks right but the rows are stale —

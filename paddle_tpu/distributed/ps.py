@@ -126,12 +126,28 @@ class PSClient:
             if isinstance(exc, (ConnectionError, OSError)) and not isinstance(
                 exc, faults.InjectedFault
             ):
-                self._reconnect(server)
+                try:
+                    self._reconnect(server)
+                except OSError:
+                    # server still down: let the policy's bounded backoff
+                    # decide whether another attempt happens — a reconnect
+                    # failure must not abort the retry loop early
+                    pass
 
         if self._retry is None:
             body = exchange()
         else:
-            body = self._retry.call(exchange, on_retry=repair)
+            try:
+                body = self._retry.call(exchange, on_retry=repair)
+            except (ConnectionError, OSError) as e:
+                # a permanently dead PS exhausts the bounded policy; name
+                # the endpoint and the budget instead of surfacing a bare
+                # socket error (or, worse, retrying forever)
+                raise ConnectionError(
+                    f"parameter server {self._eps[server]} unreachable: "
+                    f"cmd={cmd} failed after "
+                    f"{self._retry.max_attempts} attempts ({e})"
+                ) from e
         status = body[0]
         if status != 0:
             raise RuntimeError(

@@ -1,0 +1,39 @@
+"""Sharded embedding engine: hash-partitioned tables over the ep mesh axis.
+
+The reference answers billion-feature sparse models with a parameter-server
+fleet (distributed lookup_table ops + pserver processes; reference:
+paddle/fluid/operators/distributed/parameter_prefetch.cc); this package is
+the TPU-native translation, following the hierarchical-memory embedding
+designs of DLRM (Naumov et al., 2019) and Monolith (Liu et al., 2022):
+
+* ``table.py``  — per-table config, the feature-hash partition over the
+  ``ep`` mesh axis, and the deterministic per-id row initializer (a row's
+  initial value is a pure function of (table seed, id), so a row can
+  materialize lazily at ANY tier, at ANY time, bit-identically).
+* ``gather.py`` — per-step deduplicated gather: unique ids + inverse index
+  computed once per batch, bucketed so each distinct feature id crosses
+  the interconnect once (HLO-evidence helpers included).
+* ``store.py``  — the two-tier store: a host-RAM overflow tier for the
+  cold tail and a device-resident hot-ID cache with LRU admission and
+  write-back eviction, async pull/push riding distributed/lookup.py's
+  retry policy and fault sites.
+
+``layers.sharded_embedding`` is the graph entry point; ``EmbeddingEngine``
+is the host-side driver (``prepare_feed`` per step, ``flush`` before
+reads, checkpoint via ``AutoCheckpoint(extra_state=engine)``).
+"""
+
+from paddle_tpu.embedding.table import TableConfig, hash_shard, init_rows
+from paddle_tpu.embedding.gather import dedup_ids, next_bucket
+from paddle_tpu.embedding.store import EmbeddingEngine, HostStore, STORE_PREFIX
+
+__all__ = [
+    "TableConfig",
+    "hash_shard",
+    "init_rows",
+    "dedup_ids",
+    "next_bucket",
+    "EmbeddingEngine",
+    "HostStore",
+    "STORE_PREFIX",
+]

@@ -1,0 +1,106 @@
+"""Per-step deduplicated gather: unique ids + inverse index, bucketed.
+
+A CTR batch repeats feature ids heavily (the head of the zipfian slot
+distribution appears in most samples). The reference deduplicates inside
+its RPC path (parameter_prefetch.cc merges ids before pulling); here the
+dedup happens ONCE per batch on the host — ``np.unique`` gives the
+sorted unique ids and the inverse index — and the compiled step gathers
+the slab exactly once at the unique slots:
+
+    rows = table[slots]          # [U_pad, D]  — the ONLY table-wide gather
+    out  = rows[inv]             # [B, S, D]   — local fan-out, cache-sized
+
+so each distinct feature id crosses the interconnect once per step, and
+the backward's segment-sum over ``inv`` merges duplicate-id gradients
+before the row scatter (the SelectedRows aggregation, selected_rows.h).
+
+Unique counts vary per batch; ``next_bucket`` pads the slot vector to a
+power-of-two bucket (padding repeats slot[0]: its forward rows are never
+indexed by ``inv`` and its backward segments are zero, so padding is
+bit-invisible). Each bucket is one compile-cache entry — the bounded
+retrace set, exactly the serving batcher's shape discipline.
+
+``stablehlo_table_gathers`` is the evidence scan (test_hlo.py style): it
+parses the lowered step's gather ops and reports, per table-shaped
+operand, how many gathers touch it and how many rows each moves — the
+dedup claim is asserted from the emitted HLO, not trusted.
+"""
+
+import re
+
+import numpy as np
+
+__all__ = ["dedup_ids", "next_bucket", "stablehlo_table_gathers",
+           "dedup_evidence"]
+
+
+def next_bucket(n, min_bucket=8):
+    """Smallest power-of-two >= max(n, min_bucket)."""
+    b = max(int(min_bucket), 1)
+    n = max(int(n), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+def dedup_ids(ids, min_bucket=8, dedup=True):
+    """(uniq u64 [U], slots_pad_len U_pad, inv int32 ids.shape).
+
+    Returns the batch's unique ids (sorted — np.unique order, so the
+    slot vector is deterministic for a given id set), the padded bucket
+    length, and the inverse index mapping every occurrence back to its
+    unique row. ``dedup=False`` is the bench control: every occurrence
+    becomes its own "unique" entry (inv = arange), so the step gathers
+    len(ids) rows — what the dedup saves is measured against this.
+    """
+    arr = np.asarray(ids)
+    flat = arr.reshape(-1).astype(np.uint64)
+    if dedup:
+        uniq, inv = np.unique(flat, return_inverse=True)
+    else:
+        uniq, inv = flat, np.arange(flat.size)
+    u_pad = next_bucket(len(uniq), min_bucket)
+    return uniq, u_pad, inv.reshape(arr.shape).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# HLO evidence (test_hlo.py discipline: properties are read off the
+# emitted computation, never assumed)
+# ---------------------------------------------------------------------------
+
+# StableHLO gather in MLIR generic or pretty form:
+#   %5 = "stablehlo.gather"(%2, %4) <{...}> : (tensor<64x8xf32>, ...) -> tensor<16x8xf32>
+#   %5 = stablehlo.gather %2, %4 ... : (tensor<64x8xf32>, ...) -> tensor<16x8xf32>
+_GATHER_RE = re.compile(
+    r"stablehlo\.(?:gather|dynamic_gather)[^\n]*?:\s*"
+    r"\(tensor<([0-9x]+)x[a-z0-9]+>.*?->\s*tensor<([0-9x]+)x[a-z0-9]+>"
+)
+
+
+def _dims(s):
+    return tuple(int(d) for d in s.split("x") if d)
+
+
+def stablehlo_table_gathers(text, table_shape):
+    """Gathers whose OPERAND is exactly ``table_shape``: list of result
+    shapes (one entry per gather op touching the table)."""
+    want = tuple(int(d) for d in table_shape)
+    out = []
+    for m in _GATHER_RE.finditer(text):
+        if _dims(m.group(1)) == want:
+            out.append(_dims(m.group(2)))
+    return out
+
+
+def dedup_evidence(text, table_shape, n_ids):
+    """{gathers, rows_moved, n_ids, dedup_saves}: the per-table dedup
+    claim from lowered StableHLO — exactly ONE gather reads the table
+    and it moves U_pad < n_ids rows (callers assert both)."""
+    hits = stablehlo_table_gathers(text, table_shape)
+    rows = [s[0] for s in hits if s]
+    return {
+        "gathers": len(hits),
+        "rows_moved": max(rows) if rows else 0,
+        "n_ids": int(n_ids),
+        "dedup_saves": bool(rows) and max(rows) < int(n_ids),
+    }

@@ -1,0 +1,561 @@
+"""Two-tier embedding store: device hot-ID cache over a host-RAM tier.
+
+The DLRM/Monolith memory hierarchy, mapped onto this framework: the full
+table lives in host RAM as hash-sharded (id -> row) maps (the overflow
+tier — rows materialize lazily from the deterministic initializer, so
+the u64 id space costs nothing until touched), while the rows the
+traffic actually hits live in a device-resident slab (`<table>__slab`,
+[capacity, dim], row-sharded over the ep mesh axis) managed by per-shard
+LRU admission. The compiled step reads and UPDATES only the slab
+(ops/sharded_embedding.py); the host tier is reconciled by write-back:
+
+  * admission — a missed id is pulled from the host tier and scattered
+    into its hash-owner shard's slot range of the slab;
+  * eviction  — the per-shard LRU victim's CURRENT device row is read
+    back and pushed to the host tier before its slot is reused;
+  * flush     — every dirty (device-updated, not yet pushed) row is
+    pushed; checkpoints call this first so the host tier is
+    authoritative (incubate/checkpoint.py saves it format-2 per-shard).
+
+That write-back discipline is the bit-exactness contract: a row's value
+is ALWAYS its last trained value, whether it sat on device the whole run
+or bounced through the host tier a thousand times — so lookup results
+(and whole training runs) are bit-identical across cache capacities,
+which tools/bench_embedding.py --smoke asserts.
+
+Pull/push ride distributed/lookup.py's shared retry policy and fire its
+``lookup.pull`` / ``lookup.push`` fault sites, so resilience/faults.py
+schedules written for the PS path exercise this engine unchanged.
+Pushes run on a small pool (async write-back; ``flush`` is the
+barrier); a pull of an id with an in-flight push waits for that push
+first — the ordering that keeps the tiers coherent.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from paddle_tpu.embedding.gather import dedup_ids, next_bucket
+from paddle_tpu.embedding.table import TableConfig
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.resilience import faults
+from paddle_tpu.utils.enforce import EnforceError, enforce
+
+__all__ = ["HostStore", "EmbeddingEngine", "STORE_PREFIX"]
+
+#: checkpoint array-name prefix — names carrying it are engine state, not
+#: scope variables (incubate/checkpoint.py routes them to the engine)
+STORE_PREFIX = "__embedding_store__::"
+
+
+def _with_retry(fn):
+    """Pull/push failure semantics are the PS lookup path's: the shared
+    (swappable) retry policy in distributed/lookup.py."""
+    from paddle_tpu.distributed import lookup as _lookup
+
+    return _lookup._with_retry(fn)
+
+
+class HostStore:
+    """Host-RAM overflow tier: per-ep-shard (id -> float32 row) maps.
+
+    Authoritative for every row NOT currently dirty on device. Absent
+    rows materialize from the deterministic initializer at pull time —
+    the same bytes no matter which tier or process materializes them."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._shards = [dict() for _ in range(cfg.ep)]
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        with self._lock:
+            return sum(len(s) for s in self._shards)
+
+    def pull(self, ids):
+        """[len(ids), dim] rows; fires the ``lookup.pull`` fault site and
+        retries under the shared policy. Returns (rows, n_materialized)."""
+        ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+        owners = self.cfg.shard_of(ids)
+
+        def do_pull():
+            faults.fire("lookup.pull")
+            rows = np.empty((len(ids), self.cfg.dim), dtype=np.float32)
+            with self._lock:
+                absent = [
+                    i for i, (idv, k) in enumerate(zip(ids.tolist(),
+                                                       owners.tolist()))
+                    if idv not in self._shards[k]
+                ]
+                if absent:
+                    # one vectorized init for every absent id (per-id
+                    # init is a pure function, so batching is
+                    # byte-identical to one-at-a-time materialization)
+                    init = self.cfg.init_for(ids[absent])
+                    for j, i in enumerate(absent):
+                        self._shards[owners[i]][int(ids[i])] = init[j]
+                for i, (idv, k) in enumerate(zip(ids.tolist(),
+                                                 owners.tolist())):
+                    rows[i] = self._shards[k][idv]
+            return rows, len(absent)
+
+        return _with_retry(do_pull)
+
+    def push(self, ids, rows):
+        """Overwrite rows (write-back from the device tier); fires the
+        ``lookup.push`` fault site under the shared retry policy."""
+        ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+        rows = np.asarray(rows, dtype=np.float32).reshape(len(ids), -1)
+        owners = self.cfg.shard_of(ids)
+
+        def do_push():
+            faults.fire("lookup.push")
+            with self._lock:
+                for idv, k, row in zip(ids.tolist(), owners.tolist(), rows):
+                    self._shards[k][idv] = row.copy()
+
+        _with_retry(do_push)
+
+    def snapshot_blocks(self):
+        """Per-shard (ids u64 [n_k], rows f32 [n_k, dim]) with ids sorted
+        inside each shard — the deterministic block layout the format-2
+        checkpoint path records."""
+        with self._lock:
+            blocks = []
+            for shard in self._shards:
+                ids = np.fromiter(shard.keys(), dtype=np.uint64,
+                                  count=len(shard))
+                order = np.argsort(ids, kind="stable")
+                ids = ids[order]
+                rows = (
+                    np.stack([shard[i] for i in ids.tolist()])
+                    if len(ids) else
+                    np.zeros((0, self.cfg.dim), dtype=np.float32)
+                )
+                blocks.append((ids, rows))
+            return blocks
+
+    def restore(self, ids, rows):
+        """Rebuild from flat (ids, rows) — re-partitioned by the CURRENT
+        hash config, so an N-shard save restores onto M shards with
+        bit-identical row values."""
+        ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+        rows = np.asarray(rows, dtype=np.float32).reshape(len(ids), -1)
+        owners = self.cfg.shard_of(ids)
+        with self._lock:
+            self._shards = [dict() for _ in range(self.cfg.ep)]
+            for idv, k, row in zip(ids.tolist(), owners.tolist(), rows):
+                self._shards[k][idv] = row.copy()
+
+
+class _TableRuntime:
+    """One table's host-side state: slot map, per-shard LRU, dirty set."""
+
+    def __init__(self, cfg, scope, engine):
+        self.cfg = cfg
+        self.scope = scope
+        self.engine = engine
+        self.store = HostStore(cfg)
+        self._slot = {}                      # id -> slab row index
+        self._lru = [dict() for _ in range(cfg.ep)]   # id -> slot, insert-ordered
+        self._free = [
+            list(range((k + 1) * cfg.cap_per_shard - 1,
+                       k * cfg.cap_per_shard - 1, -1))
+            for k in range(cfg.ep)
+        ]
+        self._dirty = set()
+        self._oldest_dirty = None            # monotonic ts of oldest dirty row
+        self._pending_push = {}              # id -> Future (in-flight write-back)
+        reg = obs_metrics.registry()
+        labels = {"table": cfg.name}
+        self.m_hits = reg.counter(
+            "embedding_cache_hits_total",
+            "batch unique ids found in the device hot cache", labels)
+        self.m_misses = reg.counter(
+            "embedding_cache_misses_total",
+            "batch unique ids pulled from the host tier", labels)
+        self.m_evictions = reg.counter(
+            "embedding_cache_evictions_total",
+            "LRU evictions from the device hot cache", labels)
+        self.m_writebacks = reg.counter(
+            "embedding_writebacks_total",
+            "dirty rows pushed back to the host tier", labels)
+        self.m_prefetch = reg.counter(
+            "embedding_prefetch_materialized_total",
+            "host-tier rows materialized ahead of the batch", labels)
+        self.g_occupancy = reg.gauge(
+            "embedding_cache_occupancy",
+            "rows resident in the device hot cache", labels)
+        self.g_store_rows = reg.gauge(
+            "embedding_store_rows",
+            "rows materialized in the host tier", labels)
+        self.g_staleness = reg.gauge(
+            "embedding_staleness_seconds",
+            "age of the oldest device row not yet written back", labels)
+
+    # -- slab access -------------------------------------------------------
+    def slab_host(self):
+        v = self.scope.find_var(self.cfg.slab_name)
+        enforce(
+            v is not None,
+            f"table {self.cfg.name}: slab var {self.cfg.slab_name!r} not in "
+            "scope (run the startup program before preparing feeds)",
+        )
+        return np.asarray(v)
+
+    def reset_slab(self):
+        self.scope.set(
+            self.cfg.slab_name,
+            np.zeros((self.cfg.capacity, self.cfg.dim), dtype=np.float32),
+        )
+        self._slot.clear()
+        self._dirty.clear()
+        self._oldest_dirty = None
+        self._lru = [dict() for _ in range(self.cfg.ep)]
+        self._free = [
+            list(range((k + 1) * self.cfg.cap_per_shard - 1,
+                       k * self.cfg.cap_per_shard - 1, -1))
+            for k in range(self.cfg.ep)
+        ]
+        self.g_occupancy.set(0)
+        self.g_staleness.set(0)
+
+    # -- the per-step path -------------------------------------------------
+    def lookup(self, ids, dedup=True, train=True):
+        """Resolve a batch: admit misses, evict victims (write-back),
+        return (slots int32 [U_pad], inv int32 ids.shape) feeds."""
+        uniq, u_pad, inv = dedup_ids(ids, self.cfg.min_bucket, dedup)
+        uu = uniq if dedup else np.unique(uniq)
+        curr = set(uu.tolist())
+        owner = dict(zip(uu.tolist(), self.cfg.shard_of(uu).tolist()))
+        miss = [i for i in uu.tolist() if i not in self._slot]
+        miss_set = set(miss)
+        self.m_hits.inc(len(uu) - len(miss))
+        self.m_misses.inc(len(miss))
+
+        if miss:
+            self._wait_pushes(miss)
+            rows, fresh = self.store.pull(miss)
+            # allocate a slot in each id's hash-owner shard, collecting
+            # LRU victims (never a member of the current batch)
+            evicted, evicted_slots = [], []
+            new_slots = []
+            for idv in miss:
+                k = owner[idv]
+                if self._free[k]:
+                    s = self._free[k].pop()
+                else:
+                    victim = next(
+                        (c for c in self._lru[k] if c not in curr), None
+                    )
+                    if victim is None:
+                        raise EnforceError(
+                            f"table {self.cfg.name}: shard {k} needs more "
+                            f"than its {self.cfg.cap_per_shard} cache slots "
+                            "for ONE batch's unique ids — raise capacity "
+                            "or shrink the batch"
+                        )
+                    s = self._lru[k].pop(victim)
+                    del self._slot[victim]
+                    evicted.append(victim)
+                    evicted_slots.append(s)
+                self._slot[idv] = s
+                self._lru[k][idv] = s
+                new_slots.append(s)
+            self.m_evictions.inc(len(evicted))
+
+            slab = np.array(self.slab_host())  # host copy; mutated below
+            if evicted:
+                # write-back BEFORE the slots are reused: the victims'
+                # device values are the authoritative ones
+                dirty_ev = [i for i in evicted if i in self._dirty]
+                if dirty_ev:
+                    ev_slots = [s for i, s in zip(evicted, evicted_slots)
+                                if i in self._dirty]
+                    self._async_push(dirty_ev, slab[ev_slots].copy())
+                    self._dirty.difference_update(dirty_ev)
+            slab[new_slots] = rows
+            self.scope.set(self.cfg.slab_name, slab)
+
+        # LRU touch for hits (misses were appended above)
+        for idv in uu.tolist():
+            if idv not in miss_set:
+                lru = self._lru[owner[idv]]
+                s = lru.pop(idv)
+                lru[idv] = s
+
+        if train:
+            self._dirty.update(curr)
+            if self._oldest_dirty is None:
+                self._oldest_dirty = time.monotonic()
+        self._refresh_gauges()
+
+        slots = np.fromiter(
+            (self._slot[i] for i in uniq.tolist()), dtype=np.int32,
+            count=len(uniq),
+        )
+        if len(slots) < u_pad:
+            pad = slots[0] if len(slots) else np.int32(0)
+            slots = np.concatenate(
+                [slots, np.full(u_pad - len(slots), pad, dtype=np.int32)]
+            )
+        return slots, inv
+
+    def prefetch(self, ids):
+        """Materialize the next batch's missing host-tier rows on the
+        push pool (the async pull): by the time lookup() runs, its
+        store.pull finds them resident. Fires lookup.pull like any pull."""
+        uniq, _u, _inv = dedup_ids(ids, self.cfg.min_bucket, True)
+        miss = [i for i in uniq.tolist() if i not in self._slot]
+        if not miss:
+            return None
+
+        def warm():
+            _rows, fresh = self.store.pull(miss)
+            if fresh:
+                self.m_prefetch.inc(fresh)
+
+        return self.engine._pool.submit(warm)
+
+    # -- write-back --------------------------------------------------------
+    def _async_push(self, ids, rows):
+        self.m_writebacks.inc(len(ids))
+        done = threading.Event()
+
+        def push():
+            done.wait()  # marker registration precedes the write
+            self.store.push(ids, rows)
+            with self.engine._push_lock:
+                for i in ids:
+                    # pop ONLY our own marker: a newer in-flight push for
+                    # the same id must keep its marker or a later pull
+                    # skips its wait and reads a stale row
+                    if self._pending_push.get(i) is fut:
+                        del self._pending_push[i]
+
+        fut = self.engine._pool.submit(push)
+        with self.engine._push_lock:
+            for i in ids:
+                self._pending_push[i] = fut
+        done.set()
+        return fut
+
+    def _wait_pushes(self, ids):
+        """A pull of an id with an in-flight write-back must observe the
+        pushed value — wait for exactly those pushes."""
+        with self.engine._push_lock:
+            futs = {self._pending_push[i] for i in ids
+                    if i in self._pending_push}
+        for f in futs:
+            f.result()
+
+    def flush(self):
+        """Push every dirty device row to the host tier (the barrier the
+        checkpoint save and any external read runs behind). Drains ALL
+        in-flight write-backs first so a snapshot taken after flush()
+        sees every eviction push, not just flush's own."""
+        with self.engine._push_lock:
+            pending = set(self._pending_push.values())
+        for f in pending:
+            f.result()
+        dirty = sorted(self._dirty)
+        if dirty:
+            slab = self.slab_host()
+            slots = [self._slot[i] for i in dirty]
+            fut = self._async_push(dirty, np.array(slab[slots]))
+            fut.result()
+            self._dirty.clear()
+        self._oldest_dirty = None
+        self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        self.g_occupancy.set(len(self._slot))
+        self.g_store_rows.set(len(self.store))
+        if not self._dirty:
+            # eviction write-backs can empty the dirty set without a
+            # flush — an empty set means zero un-written-back rows, so
+            # the staleness clock must not keep running
+            self._oldest_dirty = None
+        self.g_staleness.set(
+            0.0 if self._oldest_dirty is None
+            else time.monotonic() - self._oldest_dirty
+        )
+
+    def stats(self):
+        return {
+            "hits": self.m_hits.value,
+            "misses": self.m_misses.value,
+            "evictions": self.m_evictions.value,
+            "writebacks": self.m_writebacks.value,
+            "occupancy": len(self._slot),
+            "store_rows": len(self.store),
+            "hit_rate": (
+                self.m_hits.value /
+                max(1, self.m_hits.value + self.m_misses.value)
+            ),
+        }
+
+
+class EmbeddingEngine:
+    """Host-side driver for every sharded table of a program.
+
+        engine = EmbeddingEngine(scope=scope)
+        for batch, nxt in pairwise(batches):
+            feed = engine.prepare_feed(main, dict(batch))
+            engine.prefetch(main, nxt)            # optional async pull
+            exe.run(main, feed=feed, ...)
+        engine.flush()                            # before external reads
+
+    Checkpointing: ``AutoCheckpoint(..., extra_state=engine)`` flushes
+    the hot cache and saves the host tier through the format-2 per-shard
+    manifest path; resume restores it bit-identically (N -> M re-hash
+    included) and cold-resets the device cache.
+    """
+
+    def __init__(self, scope=None, push_workers=2):
+        from paddle_tpu.core.scope import global_scope
+
+        self._scope = scope if scope is not None else global_scope()
+        self._tables = {}
+        self._pending_restore = {}   # checkpoint arrays for tables not
+        #                              registered yet (resume() often runs
+        #                              before the first prepare_feed)
+        self._pool = ThreadPoolExecutor(
+            max_workers=push_workers,
+            thread_name_prefix="embedding-push",
+        )
+        self._push_lock = threading.Lock()
+
+    @property
+    def tables(self):
+        return dict(self._tables)
+
+    def register(self, cfg):
+        enforce(
+            cfg.name not in self._tables,
+            f"table {cfg.name!r} already registered",
+        )
+        rt = _TableRuntime(cfg, self._scope, self)
+        self._tables[cfg.name] = rt
+        rt.reset_slab()
+        if self._pending_restore:
+            self._apply_restore(cfg.name, rt)
+        return rt
+
+    def _runtime_for(self, entry):
+        rt = self._tables.get(entry["table_name"])
+        if rt is None:
+            rt = self.register(TableConfig.from_entry(entry))
+        return rt
+
+    # -- the step API ------------------------------------------------------
+    def prepare_feed(self, program, feed, train=True, dedup=True):
+        """Translate each registered table's raw id feed into the
+        (slots, inv) feeds the compiled step consumes. Mutates and
+        returns ``feed``. Must run on the training thread, in step
+        order — cache state advances with the stream."""
+        prog = getattr(program, "program", program)  # unwrap CompiledProgram
+        tables = getattr(prog, "_sharded_tables", None) or {}
+        for tname, entry in tables.items():
+            ids = feed.get(entry["ids"])
+            if ids is None:
+                continue
+            rt = self._runtime_for(entry)
+            slots, inv = rt.lookup(ids, dedup=dedup, train=train)
+            feed[entry["slots"]] = slots
+            feed[entry["inv"]] = inv
+        return feed
+
+    def prefetch(self, program, next_feed):
+        """Announce the NEXT batch's ids: missing host-tier rows
+        materialize on the background pool (the async pull half; pushes
+        are async write-backs)."""
+        prog = getattr(program, "program", program)
+        tables = getattr(prog, "_sharded_tables", None) or {}
+        futs = []
+        for entry in tables.values():
+            ids = next_feed.get(entry["ids"])
+            if ids is None:
+                continue
+            f = self._runtime_for(entry).prefetch(ids)
+            if f is not None:
+                futs.append(f)
+        return futs
+
+    def flush(self):
+        for rt in self._tables.values():
+            rt.flush()
+
+    def stats(self):
+        return {name: rt.stats() for name, rt in self._tables.items()}
+
+    # -- checkpoint protocol (incubate/checkpoint.py extra_state) ----------
+    def owns(self, name):
+        return name.startswith(STORE_PREFIX)
+
+    def checkpoint_arrays(self):
+        """Hot cache flushed first, then the host tier per table as TWO
+        logical arrays (ids u64, rows f32) blocked per ep shard — the
+        format-2 per-shard manifest entries (_ShardSnap), so each shard
+        carries its own CRC and bounds and N -> M restores stitch."""
+        from paddle_tpu.incubate.checkpoint import _ShardSnap
+
+        self.flush()
+        out = {}
+        for name, rt in self._tables.items():
+            blocks = rt.store.snapshot_blocks()
+            sizes = [len(ids) for ids, _rows in blocks]
+            total = sum(sizes)
+            dim = rt.cfg.dim
+            if total == 0:
+                out[STORE_PREFIX + name + "::ids"] = np.zeros(
+                    (0,), dtype=np.uint64)
+                out[STORE_PREFIX + name + "::rows"] = np.zeros(
+                    (0, dim), dtype=np.float32)
+                continue
+            id_blocks, row_blocks, off = [], [], 0
+            for ids, rows in blocks:
+                if not len(ids):
+                    continue
+                id_blocks.append(((off,), (off + len(ids),), ids))
+                row_blocks.append(
+                    ((off, 0), (off + len(ids), dim), rows)
+                )
+                off += len(ids)
+            out[STORE_PREFIX + name + "::ids"] = _ShardSnap(
+                (total,), "uint64", f"ep({rt.cfg.ep})", id_blocks)
+            out[STORE_PREFIX + name + "::rows"] = _ShardSnap(
+                (total, dim), "float32", f"ep({rt.cfg.ep})", row_blocks)
+        return out
+
+    def restore_arrays(self, arrays):
+        """Rebuild each table's host tier from checkpoint arrays (ids
+        re-hashed under the CURRENT ep config — N -> M restores are
+        bit-identical in VALUE space) and cold-reset the device cache:
+        the first batch re-admits its working set from the restored
+        tier, so lookups resume bit-identically. Arrays for tables not
+        registered yet (resume() usually precedes the first
+        prepare_feed) are stashed and applied at registration."""
+        self._pending_restore = dict(arrays)
+        for name, rt in self._tables.items():
+            self._apply_restore(name, rt)
+
+    def _apply_restore(self, name, rt):
+        ids = self._pending_restore.pop(
+            STORE_PREFIX + name + "::ids", None)
+        rows = self._pending_restore.pop(
+            STORE_PREFIX + name + "::rows", None)
+        rt.reset_slab()
+        if ids is None or rows is None:
+            rt.store.restore(
+                np.zeros((0,), np.uint64),
+                np.zeros((0, rt.cfg.dim), np.float32),
+            )
+        else:
+            rt.store.restore(ids, rows)
+        rt._refresh_gauges()
+
+    def close(self):
+        self._pool.shutdown(wait=True)

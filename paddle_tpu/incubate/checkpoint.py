@@ -223,6 +223,141 @@ def _process_index():
         return 0
 
 
+def _process_count():
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _write_shard_file(tmp, sharded, pid, write_index):
+    """Write one host's ``shards_p<pid>.npz`` (+ fsync) and, for
+    non-chief hosts, the ``shards_p<pid>.index.json`` sidecar the chief
+    merges into the manifest: {file: {size, crc32}, sharded: {name:
+    {dtype, shape, spec, shards: [...]}}}. Returns (files_entry,
+    sharded_entries) for the caller's own bookkeeping."""
+    shard_file = f"shards_p{pid}.npz"
+    entries = {}
+    sharded_entries = {}
+    for n, s in sharded.items():
+        shard_list = []
+        for i, (start, stop, data) in enumerate(s.blocks):
+            key = _shard_key(n, i) + f"@p{pid}"
+            entries[key] = data
+            shard_list.append({
+                "file": shard_file,
+                "key": key,
+                "start": list(start),
+                "stop": list(stop),
+                "crc32": array_crc32(data),
+                "nbytes": int(data.nbytes),
+            })
+        sharded_entries[n] = {
+            "dtype": s.dtype,
+            "shape": list(s.shape),
+            "spec": s.spec,
+            "shards": shard_list,
+        }
+    files_entry = {}
+    if entries:
+        sbuf = _io.BytesIO()
+        np.savez(sbuf, **entries)
+        sraw = sbuf.getvalue()
+        with open(os.path.join(tmp, shard_file), "wb") as f:
+            f.write(sraw)
+            f.flush()
+            os.fsync(f.fileno())
+        files_entry[shard_file] = {
+            "size": len(sraw),
+            "crc32": zlib.crc32(sraw) & 0xFFFFFFFF,
+        }
+    if write_index:
+        # the index commits via atomic rename: the chief's merge poll
+        # must never read a half-written json
+        idx = {"files": files_entry, "sharded": sharded_entries}
+        ipath = os.path.join(tmp, f"shards_p{pid}.index.json")
+        with open(ipath + ".tmp", "w") as f:
+            json.dump(idx, f)
+        os.replace(ipath + ".tmp", ipath)
+    return files_entry, sharded_entries
+
+
+def _merge_host_indices(tmp, world, files, sharded_manifest,
+                        timeout=None):
+    """Chief-side merge (PR 7's remaining note): fold every non-chief
+    host's shard index into the manifest, so the manifest names EVERY
+    host's shard file and blocks. A host whose index never appears
+    raises — the save fails loudly instead of committing a manifest
+    that silently thins coverage; a host's shard file that later goes
+    missing fails verify_checkpoint the same way (the manifest lists
+    it)."""
+    if timeout is None:
+        timeout = float(os.environ.get("PADDLE_TPU_CKPT_MERGE_TIMEOUT",
+                                       "120"))
+    deadline = time.monotonic() + timeout
+    for k in range(1, world):
+        ipath = os.path.join(tmp, f"shards_p{k}.index.json")
+        idx = None
+        while idx is None:
+            if os.path.exists(ipath):
+                with open(ipath) as f:
+                    candidate = json.load(f)
+                # the sidecar must describe the npz bytes ON DISK — a
+                # stale index from a crashed earlier attempt at this
+                # step (or a mid-rewrite window) mismatches and keeps
+                # polling until the host republishes (index is renamed
+                # into place AFTER the npz, so a matching pair is a
+                # complete publication)
+                ok = True
+                for fname, finfo in candidate.get("files", {}).items():
+                    fpath = os.path.join(tmp, fname)
+                    if (not os.path.exists(fpath)
+                            or os.path.getsize(fpath) != finfo["size"]):
+                        ok = False
+                        break
+                    with open(fpath, "rb") as f:
+                        crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+                    if crc != finfo["crc32"]:
+                        ok = False
+                        break
+                if ok:
+                    idx = candidate
+                    break
+            if time.monotonic() > deadline:
+                raise CheckpointCorruptError(
+                    f"multi-host checkpoint: host {k}/{world} never "
+                    f"published a consistent "
+                    f"{os.path.basename(ipath)} within {timeout:.0f}s "
+                    "— refusing to commit a manifest with thinned "
+                    "shard coverage"
+                )
+            time.sleep(0.05)
+        files.update(idx.get("files", {}))
+        for name, info in idx.get("sharded", {}).items():
+            cur = sharded_manifest.get(name)
+            if cur is None:
+                sharded_manifest[name] = {
+                    "dtype": info["dtype"],
+                    "shape": list(info["shape"]),
+                    "spec": info.get("spec"),
+                    "shards": list(info["shards"]),
+                }
+                continue
+            if (cur["dtype"] != info["dtype"]
+                    or list(cur["shape"]) != list(info["shape"])):
+                raise CheckpointCorruptError(
+                    f"multi-host checkpoint: host {k} disagrees on "
+                    f"'{name}' ({info['dtype']}{info['shape']} vs "
+                    f"{cur['dtype']}{cur['shape']})"
+                )
+            cur["shards"].extend(info["shards"])
+        # NOTE: the sidecar stays on disk here — write_files runs under
+        # the retry policy, and a retry must be able to re-read it; the
+        # caller removes sidecars after the whole protocol succeeds
+
+
 def _ckpt_step(name):
     tail = name.split("_", 1)[1] if "_" in name else ""
     return int(tail) if tail.isdigit() else None
@@ -432,7 +567,8 @@ def newest_valid_checkpoint(dirname, quarantine=True, level="file"):
     return None
 
 
-def load_checkpoint(dirname, scope=None, data_state=None, shardings=None):
+def load_checkpoint(dirname, scope=None, data_state=None, shardings=None,
+                    extra_state=None):
     """Restore the newest VALID checkpoint into the scope, walking back
     past corrupt/torn entries (quarantining them); returns the step
     AFTER the checkpointed one (0 when nothing valid exists).
@@ -451,7 +587,15 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None):
     (jax.make_array_from_callback) — no full host materialization, and
     the target mesh may factor differently than the saving one (N -> M
     resharding stitches slices from the stored blocks, bit-exactly).
-    Sharded entries without a target sharding assemble to numpy."""
+    Sharded entries without a target sharding assemble to numpy.
+
+    `extra_state` (anything with owns(name)/restore_arrays(dict) plus
+    checkpoint_arrays() on the save side, e.g. an
+    embedding.EmbeddingEngine) claims its namespaced arrays — names
+    carrying a "::" marker are provider state, never scope variables —
+    and restores from them after the scope is populated. With no
+    provider attached, provider arrays are skipped, not leaked into the
+    scope."""
     scope = scope or global_scope()
     shardings = shardings or {}
     for name in _candidates(dirname):
@@ -459,8 +603,14 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None):
         try:
             step, arrays = verify_checkpoint(d, assemble=False)
             blob = arrays.pop(STATE_KEY, None)
-            restored = {}
+            restored, extra = {}, {}
             for n, a in arrays.items():
+                if extra_state is not None and extra_state.owns(n):
+                    extra[n] = a.assemble() if isinstance(a, ShardedArray) \
+                        else a
+                    continue
+                if "::" in n:
+                    continue  # provider namespace, no provider attached
                 if isinstance(a, ShardedArray):
                     sh = shardings.get(n)
                     restored[n] = a.to_jax(sh) if sh is not None \
@@ -474,6 +624,8 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None):
             scope.set(n, a)
         if data_state is not None and blob is not None:
             data_state.load_state_dict(decode_state(blob))
+        if extra_state is not None:
+            extra_state.restore_arrays(extra)
         return step + 1
     return 0
 
@@ -490,7 +642,8 @@ class AutoCheckpoint:
     """
 
     def __init__(self, exe, program, dirname, save_interval_steps=100,
-                 max_to_keep=3, scope=None, retry=None, data_state=None):
+                 max_to_keep=3, scope=None, retry=None, data_state=None,
+                 extra_state=None):
         self._exe = exe
         self._program = program
         self._dir = dirname
@@ -498,6 +651,7 @@ class AutoCheckpoint:
         self._keep = int(max_to_keep)
         self._scope = scope
         self._data_state = data_state
+        self._extra_state = extra_state
         self._thread = None
         self._lock = threading.Lock()
         self._last_error = None
@@ -533,8 +687,21 @@ class AutoCheckpoint:
         sharded = {n: v for n, v in snap.items()
                    if isinstance(v, _ShardSnap)}
 
+        pid, world = _process_index(), _process_count()
+        if pid != 0:
+            # non-chief host: contribute this host's shard file + index
+            # sidecar into the shared tmp dir and stop — the chief owns
+            # state.npz, the (merged) manifest, meta, and the commit
+            def write_host_shards():
+                os.makedirs(tmp, exist_ok=True)
+                _write_shard_file(tmp, sharded, pid, write_index=True)
+
+            self._retry.call(write_host_shards)
+            return
+
         def write_files():
-            shutil.rmtree(tmp, ignore_errors=True)
+            if world == 1:
+                shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp, exist_ok=True)
             # serialize in memory first so the whole-file CRC in the
             # manifest is computed from the exact bytes that hit disk
@@ -555,45 +722,21 @@ class AutoCheckpoint:
             if sharded:
                 # this host's shards, one npz per host (multi-controller
                 # jobs write disjoint files; single-host writes all)
-                shard_file = f"shards_p{_process_index()}.npz"
-                entries = {}
-                for n, s in sharded.items():
-                    shard_list = []
-                    for i, (start, stop, data) in enumerate(s.blocks):
-                        key = _shard_key(n, i)
-                        entries[key] = data
-                        shard_list.append({
-                            "file": shard_file,
-                            "key": key,
-                            "start": list(start),
-                            "stop": list(stop),
-                            "crc32": array_crc32(data),
-                            "nbytes": int(data.nbytes),
-                        })
-                    sharded_manifest[n] = {
-                        "dtype": s.dtype,
-                        "shape": list(s.shape),
-                        "spec": s.spec,
-                        "shards": shard_list,
-                    }
-                sbuf = _io.BytesIO()
-                np.savez(sbuf, **entries)
-                sraw = sbuf.getvalue()
-                with open(os.path.join(tmp, shard_file), "wb") as f:
-                    f.write(sraw)
-                    f.flush()
-                    os.fsync(f.fileno())
-                files[shard_file] = {
-                    "size": len(sraw),
-                    "crc32": zlib.crc32(sraw) & 0xFFFFFFFF,
-                }
+                files_entry, sharded_manifest = _write_shard_file(
+                    tmp, sharded, 0, write_index=False
+                )
+                files.update(files_entry)
+            if world > 1:
+                # fold every other host's shard index into THIS manifest
+                # (each host wrote its own shards_p<k>.npz above)
+                _merge_host_indices(tmp, world, files, sharded_manifest)
             # injected IO failure lands mid-protocol: state written, no
             # manifest yet — a retry restarts write_files from scratch,
             # a kill leaves classic torn-write debris in the .tmp dir
             faults.fire("checkpoint.io", step=step,
                         path=os.path.join(tmp, "state.npz"))
             manifest = {
-                "format": 2 if sharded else 1,
+                "format": 2 if sharded_manifest else 1,
                 "step": step,
                 "arrays": {
                     n: {
@@ -606,7 +749,7 @@ class AutoCheckpoint:
                 "sharded": sharded_manifest,
                 "files": files,
             }
-            if not sharded:
+            if not sharded_manifest:
                 manifest.pop("sharded")
             with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
                 json.dump(manifest, f)
@@ -614,6 +757,14 @@ class AutoCheckpoint:
                 json.dump({"step": step, "time": time.time()}, f)
 
         self._retry.call(write_files)
+        # merged sidecars leave the tree only once every (possibly
+        # retried) write_files pass is done — they are not part of the
+        # committed checkpoint
+        for k in range(1, world):
+            try:
+                os.remove(os.path.join(tmp, f"shards_p{k}.index.json"))
+            except OSError:
+                pass
         faults.fire("checkpoint.before_rename", step=step, path=tmp)
         shutil.rmtree(d, ignore_errors=True)
         os.replace(tmp, d)
@@ -639,6 +790,11 @@ class AutoCheckpoint:
             v = scope.find_var(n)
             if v is not None:
                 snap[n] = snapshot_value(v)
+        if self._extra_state is not None:
+            # e.g. an EmbeddingEngine: flushes its device hot cache to
+            # the authoritative host tier, then hands back the tier as
+            # per-shard _ShardSnap entries (the format-2 manifest path)
+            snap.update(self._extra_state.checkpoint_arrays())
         if self._data_state is not None:
             # the iterator position is snapshotted at the SAME instant as
             # the parameters, and rides the manifest (per-array CRC,
@@ -699,6 +855,14 @@ class AutoCheckpoint:
         self._data_state = provider
         return self
 
+    def attach_extra_state(self, provider):
+        """Register a namespaced state provider (checkpoint_arrays /
+        owns / restore_arrays — e.g. embedding.EmbeddingEngine): saves
+        snapshot its arrays alongside the scope's, resume() hands them
+        back."""
+        self._extra_state = provider
+        return self
+
     # -- resume ----------------------------------------------------------
     def resume(self, shardings=None):
         """Restore the newest VALID checkpoint into the scope (verifying
@@ -710,7 +874,8 @@ class AutoCheckpoint:
         with no full-array host materialization (see load_checkpoint)."""
         return load_checkpoint(self._dir, scope=self._scope or global_scope(),
                                data_state=self._data_state,
-                               shardings=shardings)
+                               shardings=shardings,
+                               extra_state=self._extra_state)
 
     def close(self):
         """Join the async writer and SURFACE its failure (a failed last

@@ -23,6 +23,7 @@ __all__ = [
     "embedding",
     "sparse_embedding",
     "distributed_embedding",
+    "sharded_embedding",
     "scaled_dot_product_attention",
     "moe_ffn",
     "dropout",
@@ -760,6 +761,97 @@ def distributed_embedding(
         "dim": dim,
         "init_range": init_range,
         "optimizer": optimizer,
+    }
+    return out
+
+
+def sharded_embedding(
+    input,
+    embedding_dim,
+    capacity=65536,
+    ep=1,
+    name=None,
+    init_range=0.01,
+    lr=0.1,
+    seed=0,
+    min_bucket=8,
+    vocab_size=None,
+):
+    """Embedding over the two-tier sharded engine (paddle_tpu/embedding/):
+    hot rows live in a device slab row-sharded over the ``ep`` mesh axis,
+    the cold tail overflows to host RAM, and the step gathers the slab
+    ONCE at the batch's deduplicated unique ids. The TPU-native successor
+    to both ``embedding`` (needs a dense [vocab, dim] device table) and
+    ``sparse_embedding`` (round-trips every batch's rows host<->device).
+
+    The graph sees only cache-sized tensors: ``<name>__slots`` (unique
+    slot indices, bucket-padded) and ``<name>__inv`` (occurrence ->
+    unique map), both produced per step by
+    ``EmbeddingEngine.prepare_feed``. The slab trains with its OWN
+    row-sparse SGD at ``lr`` — the deferred ``sharded_embedding_update``
+    pass strips whatever dense optimizer ``minimize`` attached (an Adam
+    step on untouched cached rows would drift them, breaking the
+    engine's cache-size-invariance contract). ``capacity`` must divide
+    evenly by ``ep``; ids span the full u64 space (``vocab_size`` is
+    advisory, like the PS tables)."""
+    from paddle_tpu.core.ir import default_main_program
+    from paddle_tpu.embedding.table import TableConfig
+    from paddle_tpu.initializer import ConstantInitializer
+    from paddle_tpu.layers import tensor as tensor_layers
+    from paddle_tpu.param_attr import ParamAttr
+
+    helper = LayerHelper("sharded_embedding", name=name)
+    tname = name or unique_name.generate("sharded_emb")
+    cfg = TableConfig(
+        tname, embedding_dim, capacity, ep=ep, vocab_size=vocab_size,
+        init_range=init_range, lr=lr, seed=seed, min_bucket=min_bucket,
+    )
+    program = default_main_program()
+    tables = getattr(program, "_sharded_tables", None)
+    if tables is None:
+        tables = program._sharded_tables = {}
+
+    slab = helper.create_parameter(
+        ParamAttr(name=cfg.slab_name,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[cfg.capacity, cfg.dim], dtype="float32",
+    )
+    slots = tensor_layers.data(
+        f"{tname}__slots", shape=[-1], dtype="int32",
+        append_batch_size=False,
+    )
+    ids_shape = [d for d in (input.shape or [-1])]
+    if len(ids_shape) >= 2 and ids_shape[-1] == 1:
+        ids_shape = ids_shape[:-1]
+    idx_shape = [(-1 if d in (-1, None) else d) for d in ids_shape]
+    inv = tensor_layers.data(
+        f"{tname}__inv", shape=idx_shape, dtype="int32",
+        append_batch_size=False,
+    )
+    out = helper.create_variable_for_type_inference("float32")
+    out.shape = idx_shape + [cfg.dim]
+    out.stop_gradient = False
+    helper.append_op(
+        "sharded_embedding_lookup",
+        {"Table": [slab.name], "Slots": [slots.name], "Inv": [inv.name]},
+        {"Out": [out.name]},
+        cfg.to_attrs(),
+    )
+    program._wants_sharded_embedding_update = True
+    tables[tname] = {
+        "table_name": tname,
+        "ids": input.name,
+        "slots": slots.name,
+        "inv": inv.name,
+        "slab": cfg.slab_name,
+        "dim": cfg.dim,
+        "capacity": cfg.capacity,
+        "ep": cfg.ep,
+        "vocab_size": vocab_size,
+        "init_range": cfg.init_range,
+        "lr": cfg.lr,
+        "seed": cfg.seed,
+        "min_bucket": cfg.min_bucket,
     }
     return out
 

@@ -26,5 +26,6 @@ from paddle_tpu.ops import vision_extra  # noqa: F401
 from paddle_tpu.ops import fused  # noqa: F401
 from paddle_tpu.ops import yolo_loss  # noqa: F401
 from paddle_tpu.ops import extras  # noqa: F401
+from paddle_tpu.ops import sharded_embedding  # noqa: F401
 from paddle_tpu.ops import crf  # noqa: F401
 from paddle_tpu.ops import tail  # noqa: F401

@@ -1,0 +1,62 @@
+"""Sharded-embedding ops: dedup slab gather + fused row-sparse update.
+
+The graph half of paddle_tpu/embedding/: the host engine (store.py)
+resolves ids -> hot-cache slots once per batch; these ops only ever see
+cache-sized tensors, so the billion-row table never exists on device.
+
+``sharded_embedding_lookup``'s generic vjp (core/backward.py) would
+materialize a dense [capacity, D] table cotangent and hand it to the
+dense optimizer; the deferred ``sharded_embedding_update`` pass
+(passes.py) fuses grad + optimizer into ``sharded_embedding_sgd`` — the
+same SelectedRows fusion sgd_sparse does for lookup_table, but indexed
+by cache slot and segment-summing over the dedup inverse index first.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.common import first
+
+
+@register_op("sharded_embedding_lookup", nondiff_inputs=("Slots", "Inv"))
+def _sharded_embedding_lookup(ins, attrs):
+    """Out[b, s, :] = Table[Slots[Inv[b, s]], :].
+
+    The first take is the ONLY table-wide gather in the step (the dedup
+    property gather.py asserts from the HLO); the second fans the U_pad
+    unique rows back out to id occurrences — a cache-local move. On an
+    ep mesh the slab is row-sharded P('ep', None) (spec_layout role
+    ``embedding_shard``), so the gather's interconnect traffic is the
+    unique rows, never the slab."""
+    table = first(ins, "Table")
+    slots = first(ins, "Slots").astype(jnp.int32)
+    inv = first(ins, "Inv").astype(jnp.int32)
+    rows = jnp.take(table, slots, axis=0)          # [U_pad, D]
+    out = jnp.take(rows, inv, axis=0)              # ids.shape + [D]
+    return {"Out": [out]}
+
+
+@register_op("sharded_embedding_sgd", nondiff_inputs=("Slots", "Inv"))
+def _sharded_embedding_sgd(ins, attrs):
+    """Fused dedup-grad + SGD row scatter on the hot slab.
+
+    OutGrad [*, U?, D] is the lookup output's cotangent; segment-summing
+    it over Inv merges duplicate-id grads into per-unique-row grads
+    (bucket rows past the true unique count receive zero — padding slots
+    repeat a real slot, and scatter-adding their zero update is a
+    no-op), then one scatter-add applies -lr * rowgrad at the slots.
+    Rows the batch never touched are not read or written — the property
+    behind cache-size-invariant training (store.py)."""
+    table = first(ins, "Table")
+    slots = first(ins, "Slots").astype(jnp.int32)
+    inv = first(ins, "Inv").astype(jnp.int32).reshape(-1)
+    og = first(ins, "OutGrad")
+    d = table.shape[-1]
+    u_pad = slots.shape[0]
+    rowg = (
+        jnp.zeros((u_pad, d), jnp.float32)
+        .at[inv]
+        .add(og.reshape(-1, d).astype(jnp.float32))
+    )
+    upd = (-float(attrs["lr"]) * rowg).astype(table.dtype)
+    return {"TableOut": [table.at[slots].add(upd)]}

@@ -67,6 +67,7 @@ __all__ = ["SpecLayout", "Role", "infer_roles"]
 TP_AXIS_NAMES = ("model", "tp")
 FSDP_AXIS_NAMES = ("fsdp",)
 DATA_AXIS_NAMES = ("data", "dp", "batch")
+EP_AXIS_NAMES = ("ep", "expert")
 
 
 class Role:
@@ -82,9 +83,12 @@ class Role:
     NORM_BIAS = "norm_bias"       # layer/batch-norm shift
     SCALAR = "scalar"             # rank-0/1-of-1 state (beta pows, steps)
     REPLICATED = "replicated"     # the unknown-role fallback
+    #: hot-cache slab of a sharded embedding table (embedding/store.py):
+    #: hash-partitioned rows, canonical placement P('ep', None)
+    EMBEDDING_SHARD = "embedding_shard"
 
     ALL = (EMBEDDING, COLUMN, ROW, BIAS_COLUMN, BIAS_ROW, NORM_SCALE,
-           NORM_BIAS, SCALAR, REPLICATED)
+           NORM_BIAS, SCALAR, REPLICATED, EMBEDDING_SHARD)
 
 
 #: name conventions for column- vs row-parallel dense weights (the
@@ -260,6 +264,12 @@ def infer_roles(program):
                 for n in op.input(_LOOKUP_OPS[t]):
                     if is_param(n):
                         note(resolve(n), Role.EMBEDDING, stacked=True)
+            elif t in ("sharded_embedding_lookup", "sharded_embedding_sgd"):
+                # the engine's hot-cache slab: rows hash-partitioned over
+                # the ep axis (embedding/table.py hash_shard)
+                for n in op.input("Table"):
+                    if is_param(n):
+                        note(resolve(n), Role.EMBEDDING_SHARD, stacked=True)
             elif t in _NORM_OPS:
                 for n in op.input("Scale"):
                     if is_param(n):
@@ -364,6 +374,9 @@ _DEFAULT_ROLE_SPECS = {
     # dim ZeRO-sliced on fsdp
     Role.ROW: [P("tp", "fsdp"), P("tp", None), P(None, "tp"),
                P("fsdp", None)],
+    # hot-cache slab: rows live on their hash-owner ep shard; a mesh
+    # without an ep axis (or an indivisible capacity) replicates
+    Role.EMBEDDING_SHARD: [P("ep", None)],
     Role.BIAS_COLUMN: [P("tp")],
     Role.BIAS_ROW: [P("fsdp"), P()],
     Role.NORM_SCALE: [P()],
@@ -471,6 +484,7 @@ class SpecLayout:
             "tp": _axis_in(axes, TP_AXIS_NAMES),
             "fsdp": _axis_in(axes, FSDP_AXIS_NAMES),
             "data": _axis_in(axes, DATA_AXIS_NAMES),
+            "ep": _axis_in(axes, EP_AXIS_NAMES),
         }, axes
 
     def _fit(self, chain, shape, mesh):

@@ -373,6 +373,123 @@ def _sparse_weight_update_pass(program, ctx):
     return program
 
 
+@register_pass("sharded_embedding_update")
+def _sharded_embedding_update_pass(program, ctx):
+    """Fuse sharded_embedding_lookup_grad + the dense optimizer op into
+    one ``sharded_embedding_sgd`` row-scatter on the hot slab
+    (ops/sharded_embedding.py) — the engine analog of
+    sparse_weight_update. Mandatory where it matches, not opportunistic:
+    a dense optimizer step on the slab touches rows the batch never
+    looked up (Adam moments drift untouched cached rows), which breaks
+    the two-tier engine's cache-size-invariance contract (embedding/
+    store.py) — so a grad the pass CANNOT fuse (extra consumers, grad
+    clip) is a build error, not a silent fallback."""
+    block = program.global_block()
+    slabs = {
+        t["slab"]: t
+        for t in (getattr(program, "_sharded_tables", None) or {}).values()
+    }
+    grad_ops = [
+        op for op in block.ops
+        if op.type == "sharded_embedding_lookup_grad"
+        and op.inputs.get("Table", [None])[0] in slabs
+    ]
+    if not grad_ops:
+        ctx.stats["sharded_embedding_update"] = {"rewritten": 0}
+        return program
+    if (getattr(program, "_num_microbatches", 1) or 1) > 1:
+        raise EnforceError(
+            "sharded_embedding cannot run microbatched: slots/inv feeds "
+            "differ per microbatch while grads accumulate across them"
+        )
+    usedef = build_usedef(block)
+    rewrites = {}  # id(grad_op) -> (grad_op, opt_op)
+    for gop in grad_ops:
+        gname = gop.outputs["Table@GRAD"][0]
+        slab = gop.inputs["Table"][0]
+        cons = usedef.consumers.get(gname, [])
+        ok = (
+            len(cons) == 1
+            and cons[0].inputs.get("Grad", [None])[0] == gname
+            and cons[0].inputs.get("Param", [None])[0] == slab
+        )
+        if not ok:
+            raise EnforceError(
+                f"sharded table slab '{slab}': its gradient must flow "
+                "straight into one optimizer op (the engine's row-sparse "
+                "SGD replaces it). Gradient clip / regularizers / extra "
+                f"consumers are unsupported on sharded tables; consumers: "
+                f"{[c.type for c in cons]}"
+            )
+        rewrites[id(gop)] = (gop, cons[0])
+
+    from paddle_tpu.core.ir import Operator
+
+    opt_ids = {id(opt) for _g, opt in rewrites.values()}
+    new_ops, dropped_vars = [], set()
+    for op in block.ops:
+        if id(op) in opt_ids:
+            # the dense optimizer op: dropped; its private accumulators
+            # (moments, beta pows) become dead vars
+            for slot, names in op.inputs.items():
+                if slot in ("Param", "Grad", "LearningRate"):
+                    continue
+                dropped_vars.update(names)
+            continue
+        if id(op) not in rewrites:
+            new_ops.append(op)
+            continue
+        gop, opt = rewrites[id(op)]
+        gname = gop.outputs["Table@GRAD"][0]
+        slab = gop.inputs["Table"][0]
+        new_ops.append(Operator(
+            block, "sharded_embedding_sgd",
+            {
+                "Table": [slab],
+                "Slots": list(gop.inputs["Slots"]),
+                "Inv": list(gop.inputs["Inv"]),
+                "OutGrad": list(gop.inputs["Out@GRAD"]),
+            },
+            {"TableOut": [slab]},
+            {
+                "lr": slabs[slab]["lr"],
+                "table_name": slabs[slab]["table_name"],
+                "op_role": opt.attrs.get("op_role", 0),
+            },
+        ))
+        dropped_vars.add(gname)
+    block.ops = new_ops
+    # drop vars no remaining op touches (the dense grad + dead slots)
+    still_used = {
+        n for op in block.ops
+        for names in list(op.inputs.values()) + list(op.outputs.values())
+        for n in names
+    }
+    for n in dropped_vars - still_used:
+        block.vars.pop(n, None)
+    program._bump_version()
+    ctx.stats["sharded_embedding_update"] = {"rewritten": len(rewrites)}
+    return program
+
+
+def apply_deferred_sharded_embedding_rewrite(program):
+    """Execution-time hook (the apply_deferred_sparse_rewrite pattern):
+    layers.sharded_embedding marks the program; executors call this
+    before building a compile entry, so the rewrite sees the final op
+    list (backward + optimizer present, microbatching decided)."""
+    if not getattr(program, "_wants_sharded_embedding_update", False):
+        return
+    if not any(
+        op.type == "sharded_embedding_lookup_grad"
+        for op in program.global_block().ops
+    ):
+        # inference program (or minimize not run yet): nothing to fuse;
+        # keep the mark so a later-minimized clone still rewrites
+        return
+    program._wants_sharded_embedding_update = False
+    _PASS_REGISTRY["sharded_embedding_update"](program, PassContext())
+
+
 def apply_deferred_sparse_rewrite(program):
     """Execution-time hook: SGDOptimizer.minimize marks the program instead
     of rewriting it (a wrapping PipelineOptimizer sets _num_microbatches

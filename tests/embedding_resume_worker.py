@@ -1,0 +1,82 @@
+"""Subprocess worker for the sharded-embedding kill-and-resume test.
+
+Trains a Wide&Deep-style model over sharded_embedding tables with
+AutoCheckpoint carrying the engine's host tier (extra_state=engine).
+Every step appends ``<tag> <step> <loss_bits> <ids_digest>`` to a log;
+``--kill-at-step N`` os._exit()s right after step N's checkpoint commits
+— the crash the resume run recovers from through the format-2 shard
+path. The parent test asserts the resumed run's per-step lines equal an
+uninterrupted reference's bit-for-bit.
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.embedding import EmbeddingEngine
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+B, S, D, VOCAB, STEPS = 4, 3, 8, 60, 12
+
+
+def batch_for(step):
+    rng = np.random.RandomState(1000 + step)
+    ids = rng.randint(0, VOCAB, (B, S)).astype("int64")
+    y = rng.randn(B, S, D).astype("float32")
+    return ids, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckdir", required=True)
+    ap.add_argument("--log", required=True)
+    ap.add_argument("--tag", required=True)
+    ap.add_argument("--kill-at-step", type=int, default=-1)
+    args = ap.parse_args()
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        ids = fluid.data("ids", shape=[-1, S], dtype="int64")
+        y = fluid.data("y", shape=[-1, S, D], dtype="float32")
+        emb = fluid.layers.sharded_embedding(
+            ids, D, capacity=24, ep=2, name="t0", init_range=0.05,
+            lr=0.5, seed=3,
+        )
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(emb, y)
+        ))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    eng = EmbeddingEngine()
+    ck = AutoCheckpoint(exe, main_p, args.ckdir, save_interval_steps=1,
+                        max_to_keep=3, extra_state=eng)
+    start = ck.resume()
+    with open(args.log, "a") as logf:
+        for step in range(start, STEPS):
+            idv, yv = batch_for(step)
+            feed = {"ids": idv, "y": yv}
+            eng.prepare_feed(main_p, feed)
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            lval = np.asarray(out[0]).reshape(-1)[0]
+            digest = hashlib.sha256(idv.tobytes()).hexdigest()[:12]
+            print(args.tag, step, f"{float(lval):.17g} {digest}",
+                  file=logf, flush=True)
+            ck.save(step, blocking=True)
+            if step == args.kill_at_step:
+                os._exit(137)  # simulated crash: no flush, no close
+    eng.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

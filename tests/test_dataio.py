@@ -782,3 +782,59 @@ def test_bench_input_smoke_cli(tmp_path):
     names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
     assert "dataio::transform" in names
     assert "dataio::device_put" in names
+
+
+# ---------------------------------------------------------------------------
+# sparse CTR batch assembly (PR 8: dataio/sparse.py)
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_batch_transform_padding_and_weights():
+    from paddle_tpu.dataio import make_sparse_batch_transform, pad_slot
+
+    ids, w = pad_slot([7, 9], 4)
+    assert ids.tolist() == [7, 9, 7, 7]      # pad repeats the first id
+    assert w.tolist() == [1.0, 1.0, 0.0, 0.0]
+    ids, w = pad_slot([], 3)
+    assert ids.tolist() == [0, 0, 0] and w.tolist() == [0.0, 0.0, 0.0]
+    ids, w = pad_slot([1, 2, 3, 4, 5], 3)    # truncation
+    assert ids.tolist() == [1, 2, 3] and w.tolist() == [1.0, 1.0, 1.0]
+
+    tf = make_sparse_batch_transform(["a", "b"], 3, dense=["dx"],
+                                     label="click")
+    out = tf({"slots": {"a": [5], "b": [1, 2, 3, 4]},
+              "dx": [0.5, 0.25], "click": 1.0})
+    a_ids, a_w, b_ids, b_w, dx, click = out
+    assert a_ids.tolist() == [5, 5, 5] and a_w.tolist() == [1.0, 0.0, 0.0]
+    assert b_ids.tolist() == [1, 2, 3] and b_w.tolist() == [1.0] * 3
+    assert dx.dtype == np.float32 and click.tolist() == [1.0]
+    # a sample missing a slot gets the empty encoding
+    out2 = tf({"slots": {"a": [5]}, "dx": [0, 0], "click": 0.0})
+    assert out2[3].tolist() == [0.0, 0.0, 0.0]
+
+
+def test_sparse_batch_transform_on_worker_pool_deterministic():
+    """The transform composed with the ordered pool: same batch stream
+    for 0 and 3 workers (the dataio ordering contract), padding applied
+    per sample on the pool."""
+    from paddle_tpu.dataio import make_sparse_batch_transform, parallel_map_ordered
+
+    tf = make_sparse_batch_transform(["s0"], 4)
+
+    def records():
+        rng = np.random.RandomState(3)
+        for i in range(40):
+            n = rng.randint(1, 5)
+            yield {"slots": {"s0": rng.randint(0, 100, n).tolist()},
+                   "click": float(i % 2)}
+
+    def stream(workers):
+        out = []
+        for val in parallel_map_ordered(
+            records(), tf, workers, name=f"sparse-{workers}"
+        ):
+            out.append(np.concatenate([v.reshape(-1).astype("f")
+                                       for v in val]))
+        return np.stack(out)
+
+    np.testing.assert_array_equal(stream(0), stream(3))

@@ -635,3 +635,179 @@ def test_embedding_is_distributed_transpiles_to_remote():
     finally:
         fleet.stop_worker()
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# reconnect-on-ConnectionError (PR 8 satellite): the retry policy is
+# consulted with its seeded-deterministic backoff, and a permanently dead
+# PS surfaces a clear bounded error instead of retrying forever
+# ---------------------------------------------------------------------------
+
+
+import socket
+import struct
+import threading
+
+from paddle_tpu.resilience.retry import RetryPolicy
+
+
+class _StubPS(threading.Thread):
+    """Minimal Python loopback PS speaking the length-prefixed protocol:
+    answers every RPC with status 0 + 4 zero floats. `drop_next` makes it
+    close the connection right after reading one request (the mid-RPC
+    ConnectionError the client must repair); stop() kills it for the
+    permanently-dead case."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self.endpoint = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        self.requests = 0
+        self.drop_next = 0
+        self._stop = threading.Event()
+        self._conns = []
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        self._srv.close()
+
+    def _serve(self, conn):
+        try:
+            while not self._stop.is_set():
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = conn.recv(4 - len(hdr))
+                    if not chunk:
+                        return
+                    hdr += chunk
+                (blen,) = struct.unpack("<I", hdr)
+                body = b""
+                while len(body) < blen:
+                    body += conn.recv(blen - len(body))
+                self.requests += 1
+                if self.drop_next > 0:
+                    self.drop_next -= 1
+                    conn.close()
+                    return
+                payload = b"\x00" + np.zeros(4, np.float32).tobytes()
+                conn.sendall(struct.pack("<I", len(payload)) + payload)
+        except OSError:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_psclient_reconnects_with_seeded_backoff():
+    """A dropped connection mid-RPC reconnects and resends under the
+    retry policy — and the observed backoff sleeps are exactly the
+    seeded policy's deterministic schedule (the chaos-replay contract)."""
+    srv = _StubPS()
+    srv.start()
+    try:
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                             max_delay_s=0.1, seed=7,
+                             sleep=lambda d: sleeps.append(d))
+        client = PSClient([srv.endpoint], retry=policy)
+        assert np.array_equal(client.pull_dense(1), np.zeros(4, "f"))
+        srv.drop_next = 1
+        assert np.array_equal(client.pull_dense(1), np.zeros(4, "f"))
+        # one retry happened, after exactly the seeded backoff delay
+        assert len(sleeps) == 1
+        ref = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                          max_delay_s=0.1, seed=7)
+        assert sleeps[0] == pytest.approx(ref.delay(1))
+        assert srv.requests == 3  # ok + dropped + resent
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_psclient_dead_server_clear_bounded_error():
+    """Permanently dead PS: the bounded policy exhausts and the error
+    NAMES the endpoint and the attempt budget (no infinite retry, no
+    bare socket error)."""
+    srv = _StubPS()
+    srv.start()
+    sleeps = []
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                         max_delay_s=0.1, seed=7,
+                         sleep=lambda d: sleeps.append(d))
+    client = PSClient([srv.endpoint], retry=policy)
+    assert client.pull_dense(1).shape == (4,)
+    srv.stop()
+    import time as _time
+    _time.sleep(0.3)
+    with pytest.raises(ConnectionError) as ei:
+        client.pull_dense(1)
+    msg = str(ei.value)
+    assert srv.endpoint in msg and "3 attempts" in msg, msg
+    # bounded: exactly max_attempts - 1 backoffs were taken
+    assert len(sleeps) == policy.max_attempts - 1
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# prefetch digest canonicalization (PR 8 satellite): identical id content
+# in a different dtype/shape must HIT the prefetched future
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_digest_canonicalizes_dtype_and_shape():
+    from paddle_tpu.distributed import lookup as lk
+
+    class _FakeClient:
+        def pull_sparse(self, table_id, uniq, dim):
+            return np.stack([np.full(dim, float(i), "f")
+                             for i in uniq.tolist()])
+
+        def push_sparse(self, *a):
+            pass
+
+    ctx = lk.RemoteLookupContext(_FakeClient())
+    ctx.register("t", table_id=1, dim=3)
+    try:
+        # announced as int64 [B, 1] (the raw feed the driver holds)...
+        ids64 = np.array([[5], [9], [5], [2]], dtype=np.int64)
+        ctx.prefetch("t", ids64)
+        import time as _time
+        deadline = _time.monotonic() + 5
+        while ctx._pending and not all(
+            f.done() for _fence, f in ctx._pending.values()
+        ):
+            assert _time.monotonic() < deadline
+            _time.sleep(0.01)
+        # ...pulled by the in-graph callback as int32 [B] (x64 off,
+        # squeezed): same content, must be a prefetch HIT
+        ids32 = ids64.reshape(-1).astype(np.int32)
+        rows = ctx.pull("t", ids32)
+        assert ctx.stats["prefetch_hits"] == 1, ctx.stats
+        assert ctx.stats["pulls"] == 0, ctx.stats
+        assert rows.shape == (4, 3)
+        np.testing.assert_array_equal(rows[:, 0], [5.0, 9.0, 5.0, 2.0])
+        # digest itself: dtype/shape-insensitive, content-sensitive
+        d = lk.RemoteLookupContext._digest
+        assert d(ids64) == d(ids32) == d(np.asfortranarray(ids64))
+        assert d(ids64) != d(ids64[::-1])
+    finally:
+        ctx.close()

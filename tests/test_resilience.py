@@ -886,3 +886,115 @@ def test_chaos_train_smoke_cli():
     assert extra["restarts"] >= 1
     assert extra["quarantined"]
     assert extra["bit_identical_to_reference"] is True
+
+
+# ---------------------------------------------------------------------------
+# multi-host format-2 manifest merge (PR 8 satellite): rank 0 folds every
+# host's shard index into the manifest; a missing host fails LOUDLY at
+# save (index never published) or at verify (file listed but absent)
+# ---------------------------------------------------------------------------
+
+
+def _two_host_shard_snaps(dim=4):
+    """One [4, dim] array split rows 0-1 (host 0) / 2-3 (host 1)."""
+    from paddle_tpu.incubate.checkpoint import _ShardSnap
+
+    full = np.arange(4 * dim, dtype=np.float32).reshape(4, dim)
+    host0 = _ShardSnap((4, dim), "float32", "ep(2)",
+                       [((0, 0), (2, dim), full[:2])])
+    host1 = _ShardSnap((4, dim), "float32", "ep(2)",
+                       [((2, 0), (4, dim), full[2:])])
+    return full, host0, host1
+
+
+def _multihost_save(tmp_path, monkeypatch, write_host1_index=True,
+                    timeout="1"):
+    """Simulate a 2-host save: pre-place host 1's shard file + index in
+    the tmp dir (hosts share the checkpoint FS), then run the rank-0
+    save which must merge host 1's index into the manifest."""
+    from paddle_tpu.incubate import checkpoint as ckpt_mod
+    from paddle_tpu.incubate.checkpoint import _write_shard_file
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fluid.data("x", shape=[-1, 2])
+    full, host0_snap, host1_snap = _two_host_shard_snaps()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        monkeypatch.setattr(ckpt_mod, "_process_count", lambda: 2)
+        monkeypatch.setenv("PADDLE_TPU_CKPT_MERGE_TIMEOUT", timeout)
+        tmp = str(tmp_path / "ckpt_0.tmp")
+        os.makedirs(tmp, exist_ok=True)
+        if write_host1_index:
+            _write_shard_file(tmp, {"big": host1_snap}, 1,
+                              write_index=True)
+        ck = AutoCheckpoint(exe, main, str(tmp_path),
+                            save_interval_steps=1, scope=scope)
+        # rank 0 contributes its own shard of the same array
+        snap = {"w0": np.ones(2, "f"), "big": host0_snap}
+        ck._write(0, snap)
+    return full
+
+
+def test_multihost_manifest_merge_roundtrip(tmp_path, monkeypatch):
+    full = _multihost_save(tmp_path, monkeypatch)
+    d = str(tmp_path / "ckpt_0")
+    with open(os.path.join(d, "manifest.json")) as f:
+        man = json.load(f)
+    assert man["format"] == 2
+    # both hosts' files are manifest-listed with CRCs; the array's
+    # shard list carries blocks from BOTH hosts
+    assert {"shards_p0.npz", "shards_p1.npz"} <= set(man["files"])
+    files = {s["file"] for s in man["sharded"]["big"]["shards"]}
+    assert files == {"shards_p0.npz", "shards_p1.npz"}
+    # the merged index sidecar is not part of the committed checkpoint
+    assert not any(n.endswith(".index.json") for n in os.listdir(d))
+    step, arrays = verify_checkpoint(d)
+    assert step == 0
+    np.testing.assert_array_equal(arrays["big"], full)
+
+
+def test_multihost_missing_host_fails_save_loudly(tmp_path, monkeypatch):
+    with pytest.raises(CheckpointCorruptError, match="host 1/2"):
+        _multihost_save(tmp_path, monkeypatch, write_host1_index=False)
+    # nothing committed: no ckpt_0, no latest pointer
+    assert not os.path.exists(tmp_path / "ckpt_0")
+    assert not os.path.exists(tmp_path / "latest")
+
+
+def test_multihost_lost_shard_file_fails_verification(tmp_path,
+                                                      monkeypatch):
+    """The merged manifest lists host 1's file — losing it after commit
+    is DETECTED, never silently-thinned coverage."""
+    _multihost_save(tmp_path, monkeypatch)
+    d = str(tmp_path / "ckpt_0")
+    os.remove(os.path.join(d, "shards_p1.npz"))
+    with pytest.raises(CheckpointCorruptError, match="shards_p1.npz"):
+        verify_checkpoint(d)
+
+
+def test_nonchief_host_writes_shards_and_index_only(tmp_path,
+                                                    monkeypatch):
+    from paddle_tpu.incubate import checkpoint as ckpt_mod
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        fluid.data("x", shape=[-1, 2])
+    _full, _h0, host1_snap = _two_host_shard_snaps()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        monkeypatch.setattr(ckpt_mod, "_process_index", lambda: 1)
+        monkeypatch.setattr(ckpt_mod, "_process_count", lambda: 2)
+        ck = AutoCheckpoint(exe, main, str(tmp_path),
+                            save_interval_steps=1, scope=scope)
+        ck._write(3, {"w0": np.ones(2, "f"), "big": host1_snap})
+    tmp = tmp_path / "ckpt_3.tmp"
+    assert sorted(os.listdir(tmp)) == ["shards_p1.index.json",
+                                       "shards_p1.npz"]
+    # no manifest, no meta, no rename, no latest — the chief owns those
+    assert not os.path.exists(tmp_path / "ckpt_3")
+    assert not os.path.exists(tmp_path / "latest")
