@@ -17,11 +17,37 @@ consumer. This package is the shared correctness layer over core/ir.py:
                  Diagnostics carrying op callstacks.
   * signatures.py — per-op static signatures (rank/dtype constraints) the
                  verifier checks op descs against.
+  * shapes.py  — whole-program symbolic shape + dtype inference (dynamic
+                 dims survive as named unknowns), with the static AMP
+                 fp32-matmul lint.
+  * sharding.py — GSPMD-style PartitionSpec propagation: which edges force
+                 a collective and how many bytes it moves, before any XLA
+                 compile (the pre-compile collective-cost linter).
+  * memory.py  — liveness-driven peak-HBM-per-device estimation on sharded
+                 sizes, and the donation-safety hard-error gate
+                 (read-after-donate / donated-var-fetched / aliased-twice).
 
 PassManager(verify_each_pass=True) runs the verifier after every pass and
 names the pass that broke an invariant; tools/lint_program.py is the CLI.
 """
 
+from paddle_tpu.analysis.memory import (
+    MemoryReport,
+    check_donation_safety,
+    estimate_peak_hbm,
+)
+from paddle_tpu.analysis.shapes import (
+    ShapeReport,
+    VarInfo,
+    infer_shapes,
+)
+from paddle_tpu.analysis.sharding import (
+    ReshardEvent,
+    ShardingReport,
+    analyze_sharding,
+    collective_budget_diagnostics,
+    weight_sized_events,
+)
 from paddle_tpu.analysis.usedef import (
     UseDefMap,
     build_usedef,
@@ -36,6 +62,17 @@ from paddle_tpu.analysis.verify import (
 )
 
 __all__ = [
+    "MemoryReport",
+    "check_donation_safety",
+    "estimate_peak_hbm",
+    "ShapeReport",
+    "VarInfo",
+    "infer_shapes",
+    "ReshardEvent",
+    "ShardingReport",
+    "analyze_sharding",
+    "collective_budget_diagnostics",
+    "weight_sized_events",
     "UseDefMap",
     "build_usedef",
     "live_ops",

@@ -1,0 +1,1112 @@
+"""Symbolic shape + dtype inference over the whole Program IR.
+
+The reference proves shape discipline in C++ InferShape at op-add time
+(reference: paddle/fluid/framework/shape_inference.h); here the lowering
+rules are jax tracers, so a bad desc only explodes at trace time — deep
+inside jit, far from the op that seeded it. This pass recovers the static
+story: walk every block in order (control-flow sub-blocks folded, like
+usedef.py), seed from var descs / feed shapes, and push shapes + dtypes
+through a per-op propagation table that mirrors each registered lowering's
+semantics (ops/math.py, ops/nn.py, ops/tensor.py).
+
+Dynamic dims survive as *named unknowns* (strings like ``?x.0``): a feed's
+-1 batch dim flows through the matmul chain as the same symbol instead of
+collapsing to "unknown", so a concrete mismatch two ops later is still
+decidable. Mismatches become build-time Diagnostics carrying the op type,
+var name, and user callstack — the same surfacing contract as verify.py.
+
+Also hosts the static half of the AMP HLO gate (tests/test_hlo.py
+test_amp_all_dots_bf16): in a program that casts into bf16 anywhere (an
+AMP region exists), a matmul-family op still consuming a float32 operand
+is exactly a dot that will fall off the MXU fast path — flagged here as
+``amp-fp32-matmul`` without lowering anything.
+
+Entry point: ``infer_shapes(program, ...) -> ShapeReport``.
+"""
+
+from paddle_tpu.analysis.usedef import sub_block_indices
+from paddle_tpu.analysis.verify import Diagnostic
+from paddle_tpu.core.dtypes import convert_dtype
+
+__all__ = ["VarInfo", "ShapeReport", "infer_shapes", "sym", "is_sym",
+           "dims_compatible", "concrete_numel"]
+
+
+# ---------------------------------------------------------------------------
+# symbolic dims
+# ---------------------------------------------------------------------------
+#
+# A dim is either a non-negative int or a symbol string "?<origin>" naming
+# the unknown. Two different symbols are assumed equal when an op requires
+# it (unification is implicit: the merge keeps the more-concrete side).
+
+
+def sym(origin):
+    return f"?{origin}"
+
+
+def is_sym(d):
+    return isinstance(d, str)
+
+
+def dims_compatible(a, b):
+    """True unless both dims are concrete and differ."""
+    return is_sym(a) or is_sym(b) or a == b
+
+
+def _merge_dim(a, b):
+    """The more-concrete of two compatible dims."""
+    return b if is_sym(a) else a
+
+
+def concrete_numel(shape):
+    """Element count if every dim is concrete, else None."""
+    if shape is None:
+        return None
+    n = 1
+    for d in shape:
+        if is_sym(d):
+            return None
+        n *= d
+    return n
+
+
+def _shape_from_decl(var):
+    """Declared var metadata -> inference shape: -1/None dims become named
+    unknowns tied to the var and axis."""
+    if var.shape is None:
+        return None
+    out = []
+    for i, d in enumerate(var.shape):
+        if d is None or d < 0:
+            out.append(sym(f"{var.name}.{i}"))
+        else:
+            out.append(int(d))
+    return tuple(out)
+
+
+class VarInfo:
+    """Inferred (shape, dtype) for one var. shape None = unknown rank."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype) if dtype is not None else None
+
+    def __repr__(self):
+        return f"VarInfo(shape={self.shape}, dtype={self.dtype})"
+
+
+class ShapeReport:
+    """Result of a whole-program inference pass.
+
+    ``values``      name -> VarInfo (the LAST write wins, like execution)
+    ``diagnostics`` structured findings (errors first after sort)
+    ``unresolved``  op types seen with no propagation rule (coverage probe)
+    ``amp_mode``    whether a bf16 cast region was detected
+    """
+
+    def __init__(self):
+        self.values = {}
+        self.diagnostics = []
+        self.unresolved = set()
+        self.amp_mode = False
+
+    def errors(self):
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def get(self, name):
+        return self.values.get(name)
+
+
+# ---------------------------------------------------------------------------
+# the walking context
+# ---------------------------------------------------------------------------
+
+_GRAD_SUFFIX = "@GRAD"
+
+
+class _Ctx:
+    def __init__(self, report, block, feed_shapes, feed_dtypes):
+        self.report = report
+        self.block = block
+        self.feed_shapes = feed_shapes
+        self.feed_dtypes = feed_dtypes
+        self.op = None
+        self.op_index = None
+
+    # -- reads ----------------------------------------------------------
+    def get(self, name):
+        info = self.report.values.get(name)
+        if info is not None:
+            return info
+        v = self.block._find_var_recursive(name)
+        if v is None:
+            return None
+        if name in self.feed_shapes:
+            shape = tuple(int(d) for d in self.feed_shapes[name])
+            dtype = self.feed_dtypes.get(name, v.dtype)
+            info = VarInfo(shape, dtype)
+        else:
+            info = VarInfo(_shape_from_decl(v), v.dtype)
+        self.report.values[name] = info
+        return info
+
+    def first(self, slot):
+        names = self.op.inputs.get(slot) or []
+        return self.get(names[0]) if names else None
+
+    def first_name(self, slot):
+        names = self.op.inputs.get(slot) or []
+        return names[0] if names else None
+
+    # -- writes ---------------------------------------------------------
+    def set(self, slot, shape, dtype, index=0):
+        names = self.op.outputs.get(slot) or []
+        if index >= len(names):
+            return
+        self.set_name(names[index], shape, dtype)
+
+    def set_name(self, name, shape, dtype):
+        info = VarInfo(shape, dtype)
+        self._check_against_decl(name, info)
+        self.report.values[name] = info
+
+    def _check_against_decl(self, name, info):
+        v = self.block._find_var_recursive(name)
+        if v is None:
+            return
+        if info.shape is not None and v.shape is not None:
+            decl = v.shape
+            if len(decl) != len(info.shape):
+                # rank drift vs the declared metadata is how several layers
+                # legitimately declare (e.g. squeezed outputs) — only a
+                # concrete DIM conflict at equal rank is a hard finding
+                return
+            for i, (d, s) in enumerate(zip(decl, info.shape)):
+                if d is not None and d >= 0 and not is_sym(s) and d != s:
+                    self.diag(
+                        "error", "shape-mismatch",
+                        f"op '{self.op.type}' writes '{name}' with inferred "
+                        f"shape {list(info.shape)} but the var is declared "
+                        f"{list(decl)} (dim {i}: {s} != {d})",
+                        var=name,
+                    )
+                    return
+
+    def diag(self, severity, code, message, var=None):
+        self.report.diagnostics.append(Diagnostic(
+            severity, code, message,
+            block_idx=self.block.idx,
+            op_index=self.op_index,
+            op_type=self.op.type if self.op is not None else None,
+            var=var,
+            callstack=self.op.attrs.get("op_callstack")
+            if self.op is not None else None,
+        ))
+
+
+# ---------------------------------------------------------------------------
+# per-op propagation rules
+# ---------------------------------------------------------------------------
+
+_RULES = {}
+
+
+def rule(*op_types):
+    def deco(fn):
+        for t in op_types:
+            _RULES[t] = fn
+        return fn
+    return deco
+
+
+def _broadcast_shapes(ctx, xs, ys, axis, yname):
+    """Reference elementwise broadcast: Y aligns into X at `axis`
+    (ops/common.py broadcast_y); axis None/-1/equal-rank = numpy trailing
+    alignment. Returns the output shape; records a diagnostic on concrete
+    conflicts."""
+    if xs is None or ys is None:
+        return xs if xs is not None else ys
+    if axis not in (None, -1) and len(xs) != len(ys):
+        trailing = len(xs) - axis - len(ys)
+        if trailing >= 0:
+            ys = (1,) * axis + tuple(ys) + (1,) * trailing
+    # numpy trailing alignment
+    rank = max(len(xs), len(ys))
+    xs = (1,) * (rank - len(xs)) + tuple(xs)
+    ys = (1,) * (rank - len(ys)) + tuple(ys)
+    out = []
+    for i, (a, b) in enumerate(zip(xs, ys)):
+        # a literal 1 is a broadcast dim, never a constraint — the other
+        # side wins even when it is symbolic
+        if a == 1:
+            out.append(b)
+        elif b == 1:
+            out.append(a)
+        elif dims_compatible(a, b):
+            out.append(_merge_dim(a, b))
+        else:
+            ctx.diag(
+                "error", "shape-mismatch",
+                f"op '{ctx.op.type}' operands do not broadcast: dim {i} is "
+                f"{a} vs {b} (operand '{yname}')",
+                var=yname,
+            )
+            out.append(a)
+    return tuple(out)
+
+
+_ELEMENTWISE_OPS = (
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_min", "elementwise_max",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+)
+
+
+@rule(*_ELEMENTWISE_OPS)
+def _r_elementwise(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None:
+        return
+    shape = _broadcast_shapes(
+        ctx, x.shape, y.shape, ctx.op.attrs.get("axis", -1),
+        ctx.first_name("Y"),
+    )
+    ctx.set("Out", shape, x.dtype)
+
+
+_COMPARE_OPS = ("equal", "not_equal", "less_than", "less_equal",
+                "greater_than", "greater_equal")
+
+
+@rule(*_COMPARE_OPS)
+def _r_compare(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None:
+        return
+    shape = _broadcast_shapes(ctx, x.shape, y.shape, -1, ctx.first_name("Y"))
+    ctx.set("Out", shape, "bool")
+
+
+@rule("logical_and", "logical_or")
+def _r_logical(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None:
+        return
+    ctx.set("Out",
+            _broadcast_shapes(ctx, x.shape, y.shape, -1,
+                              ctx.first_name("Y")), "bool")
+
+
+@rule("logical_not", "isfinite_v2")
+def _r_logical_not(ctx):
+    x = ctx.first("X")
+    if x is not None:
+        ctx.set("Out", x.shape, "bool")
+
+
+#: ops whose Out mirrors X exactly (shape AND dtype)
+_SAME_SHAPE_OPS = (
+    "relu", "relu6", "sigmoid", "tanh", "gelu", "softmax", "log_softmax",
+    "exp", "sqrt", "rsqrt", "square", "abs", "log", "log2", "log1p",
+    "floor", "ceil", "round", "reciprocal", "sign", "sin", "cos", "erf",
+    "pow", "clip", "clip_by_norm", "cumsum", "flip", "roll", "assign",
+    "scale", "leaky_relu", "elu", "selu", "softplus", "softsign", "swish",
+    "hard_sigmoid", "hard_swish", "brelu", "tanh_shrink", "stanh", "mish",
+    "silu", "prelu", "square_error_cost", "sigmoid_cross_entropy_with_logits",
+    "fill_zeros_like", "gelu_approx", "maxout_identity", "increment",
+)
+
+
+@rule(*_SAME_SHAPE_OPS)
+def _r_same_shape(ctx):
+    x = ctx.first("X")
+    if x is not None:
+        ctx.set("Out", x.shape, x.dtype)
+
+
+@rule("dropout")
+def _r_dropout(ctx):
+    x = ctx.first("X")
+    if x is None:
+        return
+    ctx.set("Out", x.shape, x.dtype)
+    ctx.set("Mask", x.shape, "uint8")
+
+
+@rule("cast")
+def _r_cast(ctx):
+    x = ctx.first("X")
+    if x is None:
+        return
+    out_dtype = _attr_dtype(ctx.op.attrs.get("out_dtype"))
+    ctx.set("Out", x.shape, out_dtype or x.dtype)
+
+
+def _attr_dtype(spec):
+    if spec is None:
+        return None
+    try:
+        return convert_dtype(spec)
+    except Exception:
+        return None
+
+
+@rule("matmul", "matmul_v2")
+def _r_matmul(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None or x.shape is None or y.shape is None:
+        return
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) < 1 or len(ys) < 1:
+        return
+    tx = ctx.op.attrs.get("transpose_X", ctx.op.attrs.get("trans_x", False))
+    ty = ctx.op.attrs.get("transpose_Y", ctx.op.attrs.get("trans_y", False))
+    if tx and len(xs) > 1:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if ty and len(ys) > 1:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if len(xs) == 1 or len(ys) == 1:
+        return  # 1-D edge cases: leave to declared metadata
+    if not dims_compatible(xs[-1], ys[-2]):
+        ctx.diag(
+            "error", "shape-mismatch",
+            f"op '{ctx.op.type}' contraction dims differ: "
+            f"{ctx.first_name('X')} has {xs[-1]} columns but "
+            f"{ctx.first_name('Y')} has {ys[-2]} rows",
+            var=ctx.first_name("Y"),
+        )
+    batch = _broadcast_shapes(ctx, tuple(xs[:-2]), tuple(ys[:-2]), -1,
+                              ctx.first_name("Y"))
+    out = tuple(batch) + (xs[-2], ys[-1])
+    ctx.set("Out", out, _promote(x.dtype, y.dtype))
+
+
+def _promote(a, b):
+    if a == b or b is None:
+        return a
+    if a is None:
+        return b
+    order = ["bool", "uint8", "int8", "int16", "int32", "int64",
+             "bfloat16", "float16", "float32", "float64"]
+    try:
+        return order[max(order.index(a), order.index(b))]
+    except ValueError:
+        return a
+
+
+@rule("mul")
+def _r_mul(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None or x.shape is None or y.shape is None:
+        return
+    xnc = ctx.op.attrs.get("x_num_col_dims", 1)
+    ync = ctx.op.attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    if len(xs) < xnc or len(ys) < ync:
+        return
+    kx = concrete_numel(xs[xnc:])
+    ky = concrete_numel(ys[:ync])
+    if kx is not None and ky is not None and kx != ky:
+        ctx.diag(
+            "error", "shape-mismatch",
+            f"op 'mul' contraction sizes differ: {ctx.first_name('X')} "
+            f"flattens to {kx} columns but {ctx.first_name('Y')} to {ky} "
+            f"rows",
+            var=ctx.first_name("Y"),
+        )
+    ctx.set("Out", tuple(xs[:xnc]) + tuple(ys[ync:]),
+            _promote(x.dtype, y.dtype))
+
+
+@rule("fc")
+def _r_fc(ctx):
+    x, w = ctx.first("Input"), ctx.first("W")
+    if x is None or w is None or x.shape is None or w.shape is None:
+        return
+    nc = ctx.op.attrs.get("in_num_col_dims", 1)
+    if len(w.shape) != 2 or len(x.shape) < nc:
+        return
+    ctx.set("Out", tuple(x.shape[:nc]) + (w.shape[1],), x.dtype)
+
+
+@rule("sum")
+def _r_sum(ctx):
+    xs = [ctx.get(n) for n in ctx.op.inputs.get("X", [])]
+    xs = [v for v in xs if v is not None and v.shape is not None]
+    if not xs:
+        return
+    shape = xs[0].shape
+    for v in xs[1:]:
+        if v.shape is not None and len(v.shape) == len(shape):
+            shape = tuple(_merge_dim(a, b) if dims_compatible(a, b) else a
+                          for a, b in zip(shape, v.shape))
+    ctx.set("Out", shape, xs[0].dtype)
+
+
+@rule("mean", "squared_l2_norm")
+def _r_mean(ctx):
+    x = ctx.first("X")
+    if x is not None:
+        ctx.set("Out", (1,), x.dtype)
+
+
+@rule("reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod")
+def _r_reduce(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    ndim = len(x.shape)
+    attrs = ctx.op.attrs
+    if attrs.get("reduce_all", False):
+        axes = tuple(range(ndim))
+    else:
+        dims = attrs.get("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        axes = tuple(d % ndim for d in dims)
+    keep = attrs.get("keep_dim", False)
+    if keep:
+        out = tuple(1 if i in axes else d for i, d in enumerate(x.shape))
+    else:
+        out = tuple(d for i, d in enumerate(x.shape) if i not in axes)
+        if not out:
+            out = () if attrs.get("keep_scalar", False) else (1,)
+    ctx.set("Out", out, x.dtype)
+
+
+@rule("arg_max", "arg_min")
+def _r_argmax(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    axis = ctx.op.attrs.get("axis", -1) % len(x.shape)
+    out = tuple(d for i, d in enumerate(x.shape) if i != axis)
+    ctx.set("Out", out, "int64")
+
+
+@rule("top_k")
+def _r_top_k(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    k = ctx.op.attrs.get("k", 1)
+    out = tuple(x.shape[:-1]) + (int(k),)
+    ctx.set("Out", out, x.dtype)
+    ctx.set("Indices", out, "int64")
+
+
+@rule("accuracy")
+def _r_accuracy(ctx):
+    ctx.set("Accuracy", (1,), "float32")
+    ctx.set("Correct", (1,), "int32")
+    ctx.set("Total", (1,), "int32")
+
+
+@rule("cross_entropy")
+def _r_cross_entropy(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    ctx.set("Y", tuple(x.shape[:-1]) + (1,), x.dtype)
+
+
+@rule("softmax_with_cross_entropy")
+def _r_softmax_ce(ctx):
+    logits = ctx.first("Logits")
+    if logits is None or logits.shape is None:
+        return
+    axis = ctx.op.attrs.get("axis", -1) % len(logits.shape)
+    loss = tuple(1 if i == axis else d for i, d in enumerate(logits.shape))
+    ctx.set("Softmax", logits.shape, logits.dtype)
+    ctx.set("Loss", loss, logits.dtype)
+
+
+@rule("lookup_table_v2")
+def _r_lookup_v2(ctx):
+    w, ids = ctx.first("W"), ctx.first("Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None:
+        return
+    ctx.set("Out", tuple(ids.shape) + (w.shape[-1],), w.dtype)
+
+
+@rule("lookup_table")
+def _r_lookup_v1(ctx):
+    w, ids = ctx.first("W"), ctx.first("Ids")
+    if w is None or ids is None or w.shape is None or ids.shape is None:
+        return
+    ids_shape = ids.shape
+    if len(ids_shape) == 2 and ids_shape[-1] == 1:
+        ids_shape = ids_shape[:-1]
+    ctx.set("Out", tuple(ids_shape) + (w.shape[-1],), w.dtype)
+
+
+@rule("sharded_embedding_lookup")
+def _r_sharded_lookup(ctx):
+    table, ids = ctx.first("Table"), ctx.first("Ids")
+    if table is None or table.shape is None:
+        return
+    dim = table.shape[-1]
+    if ids is not None and ids.shape is not None:
+        ctx.set("Out", tuple(ids.shape) + (dim,), table.dtype)
+    else:
+        inv = ctx.first("Inv")
+        if inv is not None and inv.shape is not None:
+            ctx.set("Out", tuple(inv.shape) + (dim,), table.dtype)
+
+
+@rule("one_hot")
+def _r_one_hot(ctx):
+    x = ctx.first("X")
+    depth = ctx.op.attrs.get("depth")
+    if x is None or x.shape is None or depth is None:
+        return
+    shape = x.shape
+    if len(shape) >= 2 and shape[-1] == 1:
+        shape = shape[:-1]
+    ctx.set("Out", tuple(shape) + (int(depth),), "float32")
+
+
+@rule("conv2d", "depthwise_conv2d")
+def _r_conv2d(ctx):
+    x, w = ctx.first("Input"), ctx.first("Filter")
+    if x is None or w is None or x.shape is None or w.shape is None:
+        return
+    if len(x.shape) != 4 or len(w.shape) != 4:
+        return
+    attrs = ctx.op.attrs
+    layout = attrs.get("data_format", "NCHW")
+    strides = attrs.get("strides", [1, 1])
+    dilations = attrs.get("dilations", [1, 1])
+    if layout == "NHWC":
+        spatial = x.shape[1:3]
+        ksize = w.shape[0:2]
+        out_c = w.shape[3]
+        cin = x.shape[3]
+        cin_w = w.shape[2]
+    else:
+        spatial = x.shape[2:4]
+        ksize = w.shape[2:4]
+        out_c = w.shape[0]
+        cin = x.shape[1]
+        cin_w = w.shape[1]
+    groups = attrs.get("groups", 1)
+    if ctx.op.type != "depthwise_conv2d" and groups == 1 \
+            and not is_sym(cin) and not is_sym(cin_w) and cin != cin_w:
+        ctx.diag(
+            "error", "shape-mismatch",
+            f"op 'conv2d' input has {cin} channels but the filter expects "
+            f"{cin_w}",
+            var=ctx.first_name("Filter"),
+        )
+    out_sp = []
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    pads = attrs.get("paddings", [0, 0])
+    if len(pads) == 2:
+        pads4 = [pads[0], pads[0], pads[1], pads[1]]
+    else:
+        pads4 = list(pads)
+    for i in range(2):
+        d, k, s, dil = spatial[i], ksize[i], strides[i], dilations[i]
+        if is_sym(d) or is_sym(k):
+            out_sp.append(sym(f"{ctx.op.type}.{i}"))
+            continue
+        dk = (k - 1) * dil + 1
+        if algo == "SAME":
+            out_sp.append(-(-d // s))
+        elif algo == "VALID":
+            out_sp.append((d - dk) // s + 1)
+        else:
+            out_sp.append((d + pads4[2 * i] + pads4[2 * i + 1] - dk) // s + 1)
+    if layout == "NHWC":
+        out = (x.shape[0], out_sp[0], out_sp[1], out_c)
+    else:
+        out = (x.shape[0], out_c, out_sp[0], out_sp[1])
+    ctx.set("Output", out, x.dtype)
+
+
+@rule("pool2d")
+def _r_pool2d(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None or len(x.shape) != 4:
+        return
+    attrs = ctx.op.attrs
+    layout = attrs.get("data_format", "NCHW")
+    shape = x.shape
+    if layout != "NCHW":
+        shape = (shape[0], shape[3], shape[1], shape[2])
+    n, c, h, w = shape
+    if attrs.get("global_pooling", False) or (
+        attrs.get("adaptive", False)
+        and list(attrs.get("ksize", [1, 1])) == [1, 1]
+    ):
+        out = (n, c, 1, 1)
+    elif attrs.get("adaptive", False):
+        oh, ow = attrs["ksize"]
+        out = (n, c, int(oh), int(ow))
+    else:
+        ksize = attrs.get("ksize", [1, 1])
+        strides = attrs.get("strides", [1, 1])
+        pads = attrs.get("paddings", [0, 0])
+        sp = []
+        for i, d in enumerate((h, w)):
+            if is_sym(d):
+                sp.append(sym(f"pool2d.{i}"))
+                continue
+            k, s = ksize[i], strides[i]
+            p = pads[i] if i < len(pads) else 0
+            if attrs.get("ceil_mode", False):
+                sp.append(-(-(d + 2 * p - k) // s) + 1)
+            else:
+                sp.append((d + 2 * p - k) // s + 1)
+        out = (n, c, sp[0], sp[1])
+    ctx.set("Out", out, x.dtype)
+
+
+@rule("batch_norm")
+def _r_batch_norm(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    layout = ctx.op.attrs.get("data_layout", "NCHW")
+    c = x.shape[1] if layout == "NCHW" else x.shape[-1]
+    ctx.set("Y", x.shape, x.dtype)
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        ctx.set(slot, (c,), "float32")
+
+
+@rule("layer_norm")
+def _r_layer_norm(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    begin = ctx.op.attrs.get("begin_norm_axis", 1)
+    if begin < 0:
+        begin += len(x.shape)
+    ctx.set("Y", x.shape, x.dtype)
+    ctx.set("Mean", tuple(x.shape[:begin]), "float32")
+    ctx.set("Variance", tuple(x.shape[:begin]), "float32")
+
+
+@rule("instance_norm", "group_norm", "data_norm")
+def _r_norm_like(ctx):
+    x = ctx.first("X")
+    if x is not None:
+        ctx.set("Y", x.shape, x.dtype)
+
+
+@rule("fill_constant", "gaussian_random", "uniform_random",
+      "truncated_gaussian_random", "randint")
+def _r_fill(ctx):
+    shape = ctx.op.attrs.get("shape")
+    if shape is None:
+        return
+    dtype = _attr_dtype(ctx.op.attrs.get("dtype")) or (
+        "int64" if ctx.op.type == "randint" else "float32")
+    ctx.set("Out", tuple(int(s) if s >= 0 else sym(f"{ctx.op.type}.{i}")
+                         for i, s in enumerate(shape)), dtype)
+
+
+@rule("fill_constant_batch_size_like")
+def _r_fill_bsl(ctx):
+    x = ctx.first("Input")
+    shape = list(ctx.op.attrs.get("shape", []))
+    if x is None or x.shape is None or not shape:
+        return
+    in_idx = ctx.op.attrs.get("input_dim_idx", 0)
+    out_idx = ctx.op.attrs.get("output_dim_idx", 0)
+    if in_idx < len(x.shape) and out_idx < len(shape):
+        shape[out_idx] = x.shape[in_idx]
+    dtype = _attr_dtype(ctx.op.attrs.get("dtype")) or "float32"
+    ctx.set("Out", tuple(shape), dtype)
+
+
+@rule("assign_value")
+def _r_assign_value(ctx):
+    shape = ctx.op.attrs.get("shape")
+    if shape is None:
+        return
+    ctx.set("Out", tuple(int(s) for s in shape),
+            _attr_dtype(ctx.op.attrs.get("dtype")) or "float32")
+
+
+@rule("reshape2", "reshape")
+def _r_reshape(ctx):
+    x = ctx.first("X")
+    shape = ctx.op.attrs.get("shape")
+    if x is None or x.shape is None or shape is None:
+        return
+    out = []
+    neg = None
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i] if i < len(x.shape) else 1)
+        elif s == -1:
+            neg = i
+            out.append(None)
+        else:
+            out.append(int(s))
+    if neg is not None:
+        total = concrete_numel(x.shape)
+        known = concrete_numel([d for d in out if d is not None])
+        if total is not None and known is not None and known > 0:
+            if total % known != 0:
+                ctx.diag(
+                    "error", "shape-mismatch",
+                    f"op '{ctx.op.type}' cannot reshape "
+                    f"{list(x.shape)} ({total} elements) into {list(shape)}",
+                    var=ctx.first_name("X"),
+                )
+                out[neg] = sym(f"{ctx.op.type}.{neg}")
+            else:
+                out[neg] = total // known
+        else:
+            out[neg] = sym(f"{ctx.op.type}.{neg}")
+    else:
+        total = concrete_numel(x.shape)
+        target = concrete_numel(out)
+        if total is not None and target is not None and total != target:
+            ctx.diag(
+                "error", "shape-mismatch",
+                f"op '{ctx.op.type}' reshapes {total} elements into shape "
+                f"{list(shape)} ({target} elements)",
+                var=ctx.first_name("X"),
+            )
+    ctx.set("Out", tuple(out), x.dtype)
+    ctx.set("XShape", (0,) + tuple(x.shape), x.dtype)
+
+
+@rule("transpose2", "transpose")
+def _r_transpose(ctx):
+    x = ctx.first("X")
+    perm = ctx.op.attrs.get("axis")
+    if x is None or x.shape is None or perm is None:
+        return
+    if len(perm) != len(x.shape):
+        ctx.diag(
+            "error", "shape-mismatch",
+            f"op '{ctx.op.type}' axis {list(perm)} does not match operand "
+            f"rank {len(x.shape)}",
+            var=ctx.first_name("X"),
+        )
+        return
+    ctx.set("Out", tuple(x.shape[p] for p in perm), x.dtype)
+    ctx.set("XShape", (0,) + tuple(x.shape), x.dtype)
+
+
+@rule("flatten2", "flatten")
+def _r_flatten(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    axis = ctx.op.attrs.get("axis", 1)
+    lead = concrete_numel(x.shape[:axis])
+    tail = concrete_numel(x.shape[axis:])
+    out = (lead if lead is not None else sym("flatten.0"),
+           tail if tail is not None else sym("flatten.1"))
+    ctx.set("Out", out, x.dtype)
+    ctx.set("XShape", (0,) + tuple(x.shape), x.dtype)
+
+
+@rule("squeeze2", "squeeze")
+def _r_squeeze(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    axes = ctx.op.attrs.get("axes", [])
+    ndim = len(x.shape)
+    if axes:
+        axes = {a % ndim for a in axes}
+        out = tuple(d for i, d in enumerate(x.shape)
+                    if not (i in axes and (is_sym(d) or d == 1)))
+    else:
+        out = tuple(d for d in x.shape if d != 1)
+    ctx.set("Out", out, x.dtype)
+    ctx.set("XShape", (0,) + tuple(x.shape), x.dtype)
+
+
+@rule("unsqueeze2", "unsqueeze")
+def _r_unsqueeze(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    out = list(x.shape)
+    for a in ctx.op.attrs.get("axes", []):
+        out.insert(a if a >= 0 else a + len(out) + 1, 1)
+    ctx.set("Out", tuple(out), x.dtype)
+    ctx.set("XShape", (0,) + tuple(x.shape), x.dtype)
+
+
+@rule("concat")
+def _r_concat(ctx):
+    xs = [ctx.get(n) for n in ctx.op.inputs.get("X", [])]
+    xs = [v for v in xs if v is not None and v.shape is not None]
+    if not xs:
+        return
+    rank = len(xs[0].shape)
+    axis = ctx.op.attrs.get("axis", 0) % rank
+    out = list(xs[0].shape)
+    total = 0
+    for v in xs:
+        if len(v.shape) != rank:
+            return
+        d = v.shape[axis]
+        if total is not None and not is_sym(d):
+            total += d
+        else:
+            total = None
+        for i in range(rank):
+            if i != axis and not dims_compatible(out[i], v.shape[i]):
+                ctx.diag(
+                    "error", "shape-mismatch",
+                    f"op 'concat' operands disagree on non-concat dim {i}: "
+                    f"{out[i]} vs {v.shape[i]}",
+                    var=ctx.first_name("X"),
+                )
+    out[axis] = total if total is not None else sym("concat")
+    ctx.set("Out", tuple(out), xs[0].dtype)
+
+
+@rule("split")
+def _r_split(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    attrs = ctx.op.attrs
+    axis = attrs.get("axis", 0) % len(x.shape)
+    names = ctx.op.outputs.get("Out", [])
+    sections = attrs.get("sections") or []
+    for i, name in enumerate(names):
+        out = list(x.shape)
+        if sections:
+            out[axis] = sections[i] if i < len(sections) else sym("split")
+        elif not is_sym(out[axis]):
+            out[axis] = out[axis] // max(len(names), 1)
+        else:
+            out[axis] = sym("split")
+        ctx.set_name(name, tuple(out), x.dtype)
+
+
+@rule("stack")
+def _r_stack(ctx):
+    xs = [ctx.get(n) for n in ctx.op.inputs.get("X", [])]
+    xs = [v for v in xs if v is not None and v.shape is not None]
+    if not xs:
+        return
+    axis = ctx.op.attrs.get("axis", 0)
+    out = list(xs[0].shape)
+    out.insert(axis if axis >= 0 else axis + len(out) + 1,
+               len(ctx.op.inputs.get("X", [])))
+    ctx.set("Y", tuple(out), xs[0].dtype)
+    ctx.set("Out", tuple(out), xs[0].dtype)
+
+
+@rule("batched_gather")
+def _r_batched_gather(ctx):
+    x, idx = ctx.first("X"), ctx.first("Index")
+    if x is None or idx is None or x.shape is None or idx.shape is None:
+        return
+    ctx.set("Out", tuple(idx.shape) + tuple(x.shape[2:]), x.dtype)
+
+
+@rule("gather")
+def _r_gather(ctx):
+    x, idx = ctx.first("X"), ctx.first("Index")
+    if x is None or idx is None or x.shape is None or idx.shape is None:
+        return
+    idx_shape = idx.shape
+    if len(idx_shape) == 2 and idx_shape[-1] == 1:
+        idx_shape = idx_shape[:-1]
+    ctx.set("Out", tuple(idx_shape) + tuple(x.shape[1:]), x.dtype)
+
+
+@rule("slice")
+def _r_slice(ctx):
+    x = ctx.first("Input")
+    if x is None or x.shape is None:
+        return
+    attrs = ctx.op.attrs
+    axes = attrs.get("axes", [])
+    starts = attrs.get("starts", [])
+    ends = attrs.get("ends", [])
+    out = list(x.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        if ax >= len(out):
+            continue
+        d = out[ax]
+        if is_sym(d):
+            out[ax] = sym(f"slice.{ax}") if en >= int(1e9) or en < 0 \
+                else max(0, en - max(st, 0))
+            continue
+        st2 = st + d if st < 0 else min(st, d)
+        en2 = min(en + d if en < 0 else en, d)
+        out[ax] = max(0, en2 - st2)
+    decrease = attrs.get("decrease_axis", [])
+    if decrease:
+        out = [d for i, d in enumerate(out) if i not in set(decrease)]
+    ctx.set("Out", tuple(out), x.dtype)
+
+
+@rule("expand")
+def _r_expand(ctx):
+    x = ctx.first("X")
+    times = ctx.op.attrs.get("expand_times")
+    if x is None or x.shape is None or times is None:
+        return
+    out = tuple(d if is_sym(d) else d * t
+                for d, t in zip(x.shape, times))
+    ctx.set("Out", out, x.dtype)
+
+
+@rule("shape")
+def _r_shape(ctx):
+    x = ctx.first("Input")
+    if x is None or x.shape is None:
+        return
+    ctx.set("Out", (len(x.shape),), "int32")
+
+
+@rule("where")
+def _r_where(ctx):
+    x, y = ctx.first("X"), ctx.first("Y")
+    if x is None or y is None:
+        return
+    ctx.set("Out",
+            _broadcast_shapes(ctx, x.shape, y.shape, -1,
+                              ctx.first_name("Y")), x.dtype)
+
+
+@rule("scaled_dot_product_attention")
+def _r_sdpa(ctx):
+    q, v = ctx.first("Q"), ctx.first("V")
+    if q is None or q.shape is None:
+        return
+    out = tuple(q.shape)
+    if v is not None and v.shape is not None and len(v.shape) == len(out):
+        out = tuple(out[:-1]) + (v.shape[-1],)
+    ctx.set("Out", out, q.dtype)
+
+
+@rule("while", "conditional_block")
+def _r_control_flow(ctx):
+    # handled structurally by the walker (sub-block recursion); outputs
+    # keep their declared metadata
+    pass
+
+
+#: matmul-family op types the AMP lint watches
+_AMP_MATMUL_OPS = ("mul", "matmul", "matmul_v2", "conv2d",
+                   "depthwise_conv2d", "scaled_dot_product_attention")
+
+#: their operand slots
+_AMP_OPERAND_SLOTS = {
+    "mul": ("X", "Y"), "matmul": ("X", "Y"), "matmul_v2": ("X", "Y"),
+    "conv2d": ("Input", "Filter"), "depthwise_conv2d": ("Input", "Filter"),
+    "scaled_dot_product_attention": ("Q", "K", "V"),
+}
+
+
+def _amp_lint(ctx):
+    """The static half of the bf16 HLO gate: inside a program that casts
+    into bf16 (an AMP region exists), a matmul-family op consuming a
+    float32 operand is a dot that will run off the MXU bf16 path."""
+    if ctx.op.type not in _AMP_MATMUL_OPS:
+        return
+    for slot in _AMP_OPERAND_SLOTS[ctx.op.type]:
+        for name in ctx.op.inputs.get(slot, []):
+            info = ctx.get(name)
+            if info is not None and info.dtype == "float32":
+                ctx.diag(
+                    "warning", "amp-fp32-matmul",
+                    f"op '{ctx.op.type}' consumes float32 operand '{name}' "
+                    f"inside a bf16 AMP program — this dot falls off the "
+                    f"MXU bf16 fast path (missing cast?)",
+                    var=name,
+                )
+                return
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+
+def _program_has_bf16_cast(program):
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "cast" and \
+                    _attr_dtype(op.attrs.get("out_dtype")) == "bfloat16":
+                return True
+    return False
+
+
+def _walk(program, block, report, feed_shapes, feed_dtypes, amp_lint,
+          _path=frozenset()):
+    ctx = _Ctx(report, block, feed_shapes, feed_dtypes)
+    for op_index, op in enumerate(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        ctx.op, ctx.op_index = op, op_index
+        if amp_lint and report.amp_mode:
+            _amp_lint(ctx)
+        rule_fn = _RULES.get(op.type)
+        if rule_fn is not None:
+            rule_fn(ctx)
+        elif op.type.endswith("_grad"):
+            # generic grad contract: '<name>@GRAD' mirrors '<name>'
+            for out_names in op.outputs.values():
+                for n in out_names:
+                    if n.endswith(_GRAD_SUFFIX):
+                        base = ctx.get(n[: -len(_GRAD_SUFFIX)])
+                        if base is not None:
+                            ctx.set_name(n, base.shape, base.dtype)
+        else:
+            # generic state-step contract: output slot '<S>Out' mirrors
+            # input slot '<S>' (sgd/adam/momentum/..., MeanOut, PowOut)
+            mirrored = False
+            for slot, out_names in op.outputs.items():
+                src = None
+                if slot.endswith("Out") and slot[:-3] in op.inputs:
+                    src = op.inputs[slot[:-3]]
+                if src:
+                    for n, s in zip(out_names, src):
+                        base = ctx.get(s)
+                        if base is not None:
+                            ctx.set_name(n, base.shape, base.dtype)
+                            mirrored = True
+            if not mirrored:
+                report.unresolved.add(op.type)
+        # anything still uninferred falls back to its declared metadata
+        for out_names in op.outputs.values():
+            for n in out_names:
+                if n not in report.values:
+                    v = block._find_var_recursive(n)
+                    if v is not None:
+                        report.values[n] = VarInfo(_shape_from_decl(v),
+                                                   v.dtype)
+        for idx in sub_block_indices(op):
+            if idx in _path or idx >= program.num_blocks() \
+                    or idx == block.idx:
+                continue  # malformed graphs are the verifier's findings
+            _walk(program, program.block(idx), report, feed_shapes,
+                  feed_dtypes, amp_lint, _path | {block.idx})
+
+
+def infer_shapes(program, feed_shapes=None, feed_dtypes=None,
+                 amp_lint=True):
+    """Infer shapes + dtypes for every var the program touches.
+
+    ``feed_shapes`` maps feed name -> concrete shape (binding the symbolic
+    batch dims); ``feed_dtypes`` optionally overrides declared feed dtypes.
+    Returns a ShapeReport; errors mean the program cannot execute as
+    declared (the static analog of a trace-time explosion)."""
+    report = ShapeReport()
+    report.amp_mode = _program_has_bf16_cast(program)
+    _walk(program, program.global_block(), report,
+          dict(feed_shapes or {}), dict(feed_dtypes or {}), amp_lint)
+    report.diagnostics.sort(
+        key=lambda d: 0 if d.severity == "error" else 1
+    )
+    return report

@@ -146,7 +146,8 @@ class CompiledProgram:
         self._param_rules = None      # pattern -> spec table (sharding.py)
         self._param_overrides = None  # exact name -> spec
         self._input_specs = None      # feed name -> spec (default: batch on 'data')
-        self._spec_layout = None      # SpecLayout registry (spec_layout.py)
+        self._spec_layout = None      # SpecLayout | False (off) | None (auto)
+        self._auto_layout_cache = {}  # (prog uid, version) -> SpecLayout|None
 
     @property
     def program(self):
@@ -180,6 +181,12 @@ class CompiledProgram:
         input_specs=None,
         spec_layout=None,
     ):
+        # spec_layout contract: an instance/True = that registry;
+        # False = placement stays exactly as passed (pre-PR-9 behavior);
+        # None (the default) = AUTO — meshes with a tp/fsdp axis and no
+        # other placement source get the canonical registry, gated behind
+        # the static sharding analyzer proving the registry leaves zero
+        # weight-sized collectives for THIS program (see _auto_spec_layout)
         """Generic SPMD compilation over an n-D mesh: DP (batch on 'data'),
         Megatron TP (params matched by `param_rules`/`param_specs` sharded on
         'model'), and context/sequence parallelism (feeds sharded on 'seq'
@@ -206,7 +213,7 @@ class CompiledProgram:
             from paddle_tpu.parallel.spec_layout import SpecLayout
 
             spec_layout = SpecLayout()
-        if spec_layout is not None and param_rules is not None:
+        if spec_layout not in (None, False) and param_rules is not None:
             # one placement authority: a pattern table alongside the
             # registry would be silently ignored — refuse instead (exact
             # per-var pins belong in param_specs / layout.override())
@@ -217,7 +224,71 @@ class CompiledProgram:
                 "per-var placements"
             )
         self._spec_layout = spec_layout
+        # the AUTO decision depends on everything set above (mesh geometry,
+        # rules, input_specs) — a re-placement must re-run the analyzer gate
+        self._auto_layout_cache.clear()
         return self
+
+    # ------------------------------------------------------------------
+    def _resolve_spec_layout(self, feed_arrays):
+        """The spec_layout actually used for this compile.
+
+        Explicit settings win: an instance is used as-is, ``False`` keeps
+        the pre-registry behavior (everything not otherwise placed stays
+        replicated). The ``None`` default is AUTO (ROADMAP item 1's
+        remaining question): a mesh carrying a tp/fsdp axis with no other
+        placement source (param_rules/param_specs) gets the canonical
+        registry — but ONLY when the static sharding analyzer
+        (analysis/sharding.py) proves the registry leaves zero
+        weight-sized collectives for this exact program. If the analyzer
+        predicts any (a parameter the registry cannot shard whose update
+        would be gathered), placement falls back to the old replicated
+        behavior rather than trade one gather pattern for another.
+        Pure-dp meshes skip all of this and stay byte-identical."""
+        if self._spec_layout is False:
+            return None
+        if self._spec_layout is not None:
+            return self._spec_layout
+        if self._param_rules is not None or self._param_overrides:
+            return None
+        from paddle_tpu.parallel.spec_layout import tensor_parallel_axes
+
+        axis_sizes = dict(zip(self._mesh.axis_names,
+                              self._mesh.devices.shape))
+        if not tensor_parallel_axes(axis_sizes):
+            return None  # pure dp/seq/ep/stage mesh: registry is a no-op
+        key = (self._program._uid, self._program._version)
+        if key in self._auto_layout_cache:
+            return self._auto_layout_cache[key]
+        from paddle_tpu.analysis.sharding import (
+            analyze_sharding,
+            weight_param_shapes,
+            weight_sized_events,
+        )
+        from paddle_tpu.parallel.spec_layout import SpecLayout
+
+        candidate = SpecLayout()
+        feed_shapes = {
+            n: tuple(np.shape(v)) for n, v in (feed_arrays or {}).items()
+        }
+        try:
+            report = analyze_sharding(
+                self._program, self._mesh, spec_layout=candidate,
+                input_specs=self._input_specs, feed_shapes=feed_shapes,
+            )
+            offenders = weight_sized_events(
+                report, weight_param_shapes(self._program)
+            )
+        except Exception as e:  # analyzer must never break a compile
+            warnings.warn(
+                f"spec_layout auto-default skipped: static sharding "
+                f"analysis failed ({e!r}); parameters stay replicated "
+                f"(pass spec_layout=True to force the registry)"
+            )
+            offenders = [object()]
+        chosen = None if offenders else candidate
+        self._auto_layout_cache[key] = chosen
+        return chosen
 
     # ------------------------------------------------------------------
     def _run(self, exe, feed, fetch_list, scope, return_numpy):
@@ -468,17 +539,18 @@ class CompiledProgram:
                 make_step = None
             scope_names = donated + readonly
             layout_sig = None
-            if self._spec_layout is not None:
+            spec_layout = self._resolve_spec_layout(feed_arrays)
+            if spec_layout is not None:
                 # canonical sharding layer: role-derived specs for every
                 # scope input, exact param_specs layered on top
-                scope_shardings = self._spec_layout.derive_shardings(
+                scope_shardings = spec_layout.derive_shardings(
                     self._program,
                     scope_names,
                     [np.shape(scope.find_var(n)) for n in scope_names],
                     mesh,
                     overrides=self._param_overrides,
                 )
-                layout_sig = self._spec_layout.fingerprint()
+                layout_sig = spec_layout.fingerprint()
             elif self._param_rules is not None or self._param_overrides:
                 scope_shardings = derive_shardings(
                     scope_names,
@@ -515,6 +587,12 @@ class CompiledProgram:
                 mesh=mesh, in_shardings=in_shardings,
                 out_shardings=out_shardings,
                 layout_sig=layout_sig,
+                placement={
+                    "spec_layout": spec_layout,
+                    "param_rules": self._param_rules,
+                    "param_specs": self._param_overrides,
+                    "input_specs": self._input_specs,
+                },
                 extra_fingerprint=(("dgc", dgc_sparse),),
                 label="compiled_program",
             )

@@ -75,3 +75,23 @@ def is_float_dtype(dtype):
 
 def is_integer_dtype(dtype):
     return convert_dtype(dtype) in {"int8", "uint8", "int16", "int32", "int64"}
+
+
+_DTYPE_BYTES = {
+    "float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,
+    "int64": 8, "uint64": 8, "int32": 4, "uint32": 4, "int16": 2,
+    "uint16": 2, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def dtype_size(dtype, default=4):
+    """Bytes per element for a framework dtype name. The ONE size table the
+    static analyzers (analysis/sharding.py, analysis/memory.py) share —
+    byte predictions cross-validated against utils/hlo.py must not drift
+    because two hand-copies disagree. (utils/hlo.py keeps its own table
+    keyed by HLO shorthand: f32/s32/pred is a different name universe.)"""
+    try:
+        name = convert_dtype(dtype)
+    except Exception:
+        return default
+    return _DTYPE_BYTES.get(name, default)

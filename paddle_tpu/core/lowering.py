@@ -107,6 +107,125 @@ def verify_for_lowering(program, feed_names, fetch_names, scope=None):
 
 
 # ---------------------------------------------------------------------------
+# opt-in static diagnostic stages (FLAGS_static_diagnostics) — run ahead of
+# the mandatory verifier so a program with a statically-decidable defect
+# (shape mismatch, over-budget collective) fails with op attribution
+# before any tracing
+# ---------------------------------------------------------------------------
+
+_STATIC_STAGE_NAMES = ("shapes", "sharding", "memory")
+
+
+def _static_stages():
+    from paddle_tpu.utils.flags import flags
+
+    raw = (flags.static_diagnostics or "").strip().lower()
+    if not raw:
+        return ()
+    if raw == "all":
+        return _STATIC_STAGE_NAMES
+    parts = tuple(p.strip() for p in raw.split(",") if p.strip())
+    unknown = [p for p in parts if p not in _STATIC_STAGE_NAMES]
+    if unknown:
+        # a silently-dropped typo ("shape") would disarm a gate the
+        # operator believes is on — refuse instead
+        raise EnforceError(
+            f"FLAGS_static_diagnostics: unknown stage(s) {unknown}; "
+            f"valid: {', '.join(_STATIC_STAGE_NAMES)} or 'all'"
+        )
+    return tuple(s for s in _STATIC_STAGE_NAMES if s in parts)
+
+
+_diag_log = None
+
+
+def _stage_log():
+    global _diag_log
+    if _diag_log is None:
+        from paddle_tpu.observability.logger import RateLimitedLogger
+
+        _diag_log = RateLimitedLogger("paddle_tpu.static_diagnostics",
+                                      max_records=32)
+    return _diag_log
+
+
+def run_static_diagnostics(program, feed_sig, fetch_names, stages, *,
+                           mesh=None, placement=None, label=""):
+    """Run the requested analysis stages; error diagnostics raise, warnings
+    go through the rate-limited logger. ``placement`` carries the
+    CompiledProgram's parameter-placement inputs (spec_layout /
+    param_rules / param_specs / input_specs) so the sharding stage lints
+    the layout the compile will actually use."""
+    from paddle_tpu.analysis import shapes as a_shapes
+    from paddle_tpu.utils.flags import flags
+
+    feed_shapes = {n: s for n, s, _d in feed_sig}
+    feed_dtypes = {n: d for n, _s, d in feed_sig}
+    shape_report = None
+    errors = []
+    if "shapes" in stages or "memory" in stages or "sharding" in stages:
+        shape_report = a_shapes.infer_shapes(
+            program, feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
+        )
+    if shape_report is not None:
+        for d in shape_report.diagnostics:
+            if d.severity == "error":
+                # every stage consumes the shape report — a broken shape
+                # poisons sharding bytes and HBM estimates, so shape
+                # errors gate no matter which stage was armed
+                errors.append(d)
+            elif "shapes" in stages:
+                _stage_log().warning("static[%s]: %s", label, d)
+    sharding_report = None
+    if "sharding" in stages and mesh is not None:
+        from paddle_tpu.analysis import sharding as a_sharding
+
+        placement = placement or {}
+        sharding_report = a_sharding.analyze_sharding(
+            program, mesh,
+            spec_layout=placement.get("spec_layout"),
+            param_rules=placement.get("param_rules"),
+            param_specs=placement.get("param_specs"),
+            input_specs=placement.get("input_specs"),
+            feed_shapes=feed_shapes,
+            shape_report=shape_report,
+        )
+        budget_kb = flags.collective_budget_kb
+        if budget_kb:
+            from paddle_tpu.analysis.sharding import (
+                collective_budget_diagnostics,
+            )
+
+            errors.extend(collective_budget_diagnostics(
+                sharding_report, budget_kb * 1024,
+            ))
+    if "memory" in stages:
+        from paddle_tpu.analysis.memory import estimate_peak_hbm
+
+        mem = estimate_peak_hbm(
+            program, feed_shapes=feed_shapes, fetch_names=fetch_names,
+            shape_report=shape_report, sharding_report=sharding_report,
+        )
+        _stage_log().info(
+            "static[%s]: peak HBM estimate %.2f MiB per device "
+            "(persistent %.2f MiB + intermediates %.2f MiB at op "
+            "#%s <%s>)",
+            label, mem.peak_total_bytes / 2**20,
+            mem.persistent_bytes / 2**20,
+            mem.peak_intermediate_bytes / 2**20,
+            mem.peak_op_index, mem.peak_op_type,
+        )
+    if errors:
+        lines = [f"[{d.code}] {d.message}" for d in errors[:5]]
+        raise EnforceError(
+            f"static diagnostics failed before lowering ({len(errors)} "
+            "error(s)):\n  " + "\n  ".join(lines),
+            op_type=errors[0].op_type,
+            op_callstack=errors[0].callstack,
+        )
+
+
+# ---------------------------------------------------------------------------
 # the lowered-step entry
 # ---------------------------------------------------------------------------
 
@@ -208,6 +327,7 @@ def lower_step(
     in_shardings=None,
     out_shardings=None,
     layout_sig=None,
+    placement=None,
     extra_fingerprint=(),
     use_cache=True,
     persist=None,
@@ -237,6 +357,16 @@ def lower_step(
     block = program.global_block()
     feed_names = [n for n, _s, _d in feed_sig]
 
+    # opt-in static diagnostic stages run FIRST: statically-decidable
+    # defects (shape mismatch, over-budget collective) fail with op
+    # attribution before the verifier and long before any tracing
+    stages = _static_stages()
+    if stages:
+        run_static_diagnostics(
+            program, feed_sig, fetch_names, stages,
+            mesh=mesh, placement=placement, label=label,
+        )
+
     # mandatory pre-lowering pass: a program that fails verification never
     # reaches tracing (and never poisons the content-addressed cache)
     verify_for_lowering(program, feed_names, fetch_names, scope=scope)
@@ -246,6 +376,24 @@ def lower_step(
         plan = plan_step(block, feed_names, fetch_names, scope,
                          with_donation)
     donated, readonly, written, ops = plan
+
+    # donation safety is always-on and cheap (O(ops)): a plan that
+    # fetches a donated buffer, aliases it twice, or reads it after its
+    # in-place update must never reach tracing
+    if with_donation and donated:
+        from paddle_tpu.analysis.memory import check_donation_safety
+
+        unsafe = check_donation_safety(
+            program, donated, readonly, fetch_names, block=block,
+        )
+        if unsafe:
+            lines = [f"[{d.code}] {d.message}" for d in unsafe[:5]]
+            raise EnforceError(
+                f"donation-safety check failed ({len(unsafe)} error(s)):"
+                "\n  " + "\n  ".join(lines),
+                op_type=unsafe[0].op_type,
+                op_callstack=unsafe[0].callstack,
+            )
     plan = (list(feed_names), list(fetch_names), donated, readonly,
             written, ops)
 

@@ -69,6 +69,20 @@ FSDP_AXIS_NAMES = ("fsdp",)
 DATA_AXIS_NAMES = ("data", "dp", "batch")
 EP_AXIS_NAMES = ("ep", "expert")
 
+#: the axes that make a mesh "tensor-sharded" for parameter placement —
+#: the static sharding analyzer (analysis/sharding.py) and the
+#: spec_layout auto-default gate (compiler.py) both key off this set, so
+#: a new tp-axis alias added here flows to both
+TENSOR_AXIS_NAMES = TP_AXIS_NAMES + FSDP_AXIS_NAMES
+
+
+def tensor_parallel_axes(axis_sizes):
+    """Mesh axes (from a {name: size} map) that tensor-shard parameters:
+    tp/fsdp aliases with size > 1. Empty on pure dp/seq/ep/stage meshes —
+    the registry is a no-op there and placement machinery can skip it."""
+    return [a for a in axis_sizes
+            if a in TENSOR_AXIS_NAMES and axis_sizes[a] > 1]
+
 
 class Role:
     """Closed set of parameter roles. String constants (not an Enum) so a

@@ -100,6 +100,20 @@ define_flag(
     "interpret-tested, flag-gated until on-chip numbers arbitrate",
 )
 define_flag(
+    "static_diagnostics", "",
+    "opt-in static-analysis stages run ahead of the mandatory verifier "
+    "in core/lowering.py: comma list of 'shapes', 'sharding', 'memory' "
+    "(or 'all'). Shape/dtype errors then fail at lowering time with op "
+    "attribution instead of exploding inside jit; sharding adds the "
+    "collective-cost report, memory the peak-HBM estimate",
+)
+define_flag(
+    "collective_budget_kb", 0,
+    "per-collective byte budget (KB) for the static sharding linter "
+    "when the 'sharding' diagnostic stage is on; 0 disables the budget "
+    "gate (the report still runs)",
+)
+define_flag(
     "pallas_dgc_topk", False,
     "use the blocked Pallas top-k (ops/pallas/topk.py) for DGC gradient "
     "compaction instead of lax.top_k; interpret-tested, flag-gated until "

@@ -1,21 +1,43 @@
 #!/usr/bin/env python
-"""Lint a serialized program (train or inference) with the program verifier.
+"""Lint serialized programs (train or inference) with the static analyzers.
 
-Runs analysis/verify.py over a program file and exits nonzero when errors
-are found — the CI hook that keeps every serialized/example program
-well-formed (use-before-def, dangling vars, dtype/rank violations, orphaned
-sub-blocks) on every PR.
+Subcommands (CI contract: exit 0 = clean, 1 = lint findings, 2 = internal
+error; ``--json`` emits one machine-readable report line per program):
 
-Accepts either a raw ``Program.to_bytes()`` JSON file or a saved inference
-``__model__`` (whose desc embeds feed/fetch names — they are used as the
-lint's feed/fetch context automatically). ``--builtin`` lints a
-freshly-built model program instead of a file.
+  verify       program verifier (use-before-def, dangling vars, dtype/rank
+               violations, unknown ops) — the default when no subcommand
+               is given, so pre-PR-9 invocations keep working
+  shapes       whole-program symbolic shape/dtype inference
+               (analysis/shapes.py): shape mismatches + the AMP
+               fp32-matmul lint
+  sharding     static PartitionSpec propagation (analysis/sharding.py):
+               findings are predicted WEIGHT-SIZED collectives — a
+               parameter the layout leaves replicated in a tensor-sharded
+               program pays a full weight gather per step
+  collectives  the same propagation as a byte-budget linter:
+               ``--budget-kb N`` fails on any predicted collective moving
+               more than N KB per device
+  memory       liveness-driven peak-HBM estimate + the donation-safety
+               hard errors (read-after-donate, donated-var-fetched,
+               donated-var-aliased-twice)
+  smoke        the fast-tier CI gate: shapes+sharding+donation over every
+               examples/ build_programs() graph, plus a drift check of
+               STATIC_EVIDENCE_r09.json's static predictions against a
+               fresh recompute (the live-HLO half is gated by
+               tests/test_hlo.py::test_static_evidence_r09_committed)
+
+Accepts raw ``Program.to_bytes()`` JSON files or saved inference
+``__model__`` descs (embedded feed/fetch names ride along), and
+``--builtin mnist|mnist_conv|transformer`` for freshly-built models.
 
 Usage:
   python tools/lint_program.py path/to/__model__ [path2 ...]
-  python tools/lint_program.py --builtin mnist --builtin transformer
-  python tools/lint_program.py model.json --feed x,y --fetch loss \\
-      [--json] [--warnings-as-errors]
+  python tools/lint_program.py shapes model.json --feed-shape x=32,13
+  python tools/lint_program.py sharding --builtin transformer \\
+      --mesh 2x4:data,model --spec-layout --json
+  python tools/lint_program.py collectives model.json --mesh 2x4:data,model \\
+      --budget-kb 192
+  python tools/lint_program.py smoke
 """
 
 import argparse
@@ -26,6 +48,96 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BUILTINS = ("mnist", "mnist_conv", "transformer")
+SUBCOMMANDS = ("verify", "shapes", "sharding", "collectives", "memory",
+               "smoke")
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 1, 2
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+def _discover_examples():
+    """Every examples/*.py defining build_programs() — the contract
+    examples/README.md documents. Derived from the filesystem (not a
+    hand-list) so a new example enters the smoke gates — and the mirrors
+    in tests/test_static_analysis.py — without a list to forget."""
+    names = []
+    for fn in sorted(os.listdir(os.path.join(REPO, "examples"))):
+        path = os.path.join(REPO, "examples", fn)
+        if fn.endswith(".py"):
+            with open(path) as f:
+                if "def build_programs" in f.read():
+                    names.append(fn[:-3])
+    return tuple(names)
+
+
+EXAMPLES = _discover_examples()
+
+
+def _ensure_virtual_devices(n):
+    """The sharding/collectives subcommands need an n-device mesh; on the
+    CPU lint rig that means forcing virtual host devices BEFORE jax
+    initializes."""
+    flags_env = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags_env:
+        os.environ["XLA_FLAGS"] = (
+            flags_env + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _mesh_arg_devices(argv):
+    """Pre-parse --mesh so the virtual-device env is set before jax loads."""
+    for i, a in enumerate(argv):
+        spec = None
+        if a == "--mesh" and i + 1 < len(argv):
+            spec = argv[i + 1]
+        elif a.startswith("--mesh="):
+            spec = a.split("=", 1)[1]
+        if spec:
+            try:
+                shape = _parse_mesh(spec)[0]
+                n = 1
+                for d in shape:
+                    n *= d
+                return max(n, 1)
+            except Exception:
+                return None
+    return None
+
+
+def _usage_error(msg):
+    """Bad invocation — exit EXIT_INTERNAL (2), never EXIT_FINDINGS (1):
+    CI gates on 1 meaning 'the program has lint findings', and a malformed
+    command line must not read as that."""
+    print(msg, file=sys.stderr)
+    raise SystemExit(EXIT_INTERNAL)
+
+
+def _parse_mesh(spec):
+    """'2x4:data,model' -> ((2, 4), ('data', 'model'))."""
+    shape_s, _, axes_s = spec.partition(":")
+    try:
+        shape = tuple(int(d) for d in shape_s.lower().split("x"))
+    except ValueError:
+        shape, axes = (), ()
+    else:
+        axes = tuple(a for a in axes_s.split(",") if a)
+    if not axes or len(axes) != len(shape):
+        _usage_error(
+            f"bad --mesh '{spec}': want SHAPE:AXES like 2x4:data,model"
+        )
+    return shape, axes
+
+
+def _parse_feed_shapes(entries):
+    """['x=32,13', 'y=32,1'] -> {'x': (32, 13), 'y': (32, 1)}."""
+    out = {}
+    for e in entries or []:
+        name, _, dims = e.partition("=")
+        if not dims:
+            _usage_error(f"bad --feed-shape '{e}': want name=2,8")
+        out[name] = tuple(int(d) for d in dims.replace("x", ",").split(","))
+    return out
 
 
 def _load_program(path):
@@ -36,8 +148,6 @@ def _load_program(path):
 
     with open(path, "rb") as f:
         data = f.read()
-    # from_bytes only reads format_version/random_seed/blocks, so the
-    # embedded feed/fetch keys of a saved __model__ can ride along
     desc = json.loads(data.decode("utf-8"))
     program = Program.from_bytes(data)
     return (program, desc.get("feed_var_names", []),
@@ -63,15 +173,67 @@ def _build_builtin(name):
             optimizer=fluid.optimizer.Adam(1e-3),
         )
     else:
-        raise SystemExit(f"unknown --builtin '{name}'; have {BUILTINS}")
+        _usage_error(f"unknown --builtin '{name}'; have {BUILTINS}")
     feed_names = [f if isinstance(f, str) else f.name for f in feeds]
     fetch_names = [f if isinstance(f, str) else f.name for f in fetches]
     return main, feed_names, fetch_names
 
 
+def _iter_programs(args, feed, fetch):
+    for path in args.programs:
+        program, ffeed, ffetch = _load_program(path)
+        yield os.path.basename(path), program, ffeed or feed, \
+            ffetch or fetch
+    for name in getattr(args, "builtin", None) or []:
+        program, bfeed, bfetch = _build_builtin(name)
+        yield f"builtin:{name}", program, bfeed, bfetch
+
+
+def _diag_json(d):
+    return {
+        "severity": d.severity, "code": d.code, "message": d.message,
+        "block": d.block_idx, "op_index": d.op_index, "op_type": d.op_type,
+        "var": d.var,
+    }
+
+
+def _report(label, pass_name, diags, extra=None, as_json=False,
+            warnings_as_errors=False, out=sys.stdout):
+    """Shared finding formatter; returns the number of gating findings."""
+    errors = [d for d in diags if d.severity == "error"]
+    gating = diags if warnings_as_errors else errors
+    if as_json:
+        payload = {
+            "program": label,
+            "pass": pass_name,
+            "errors": len(errors),
+            "warnings": len(diags) - len(errors),
+            "diagnostics": [_diag_json(d) for d in diags],
+        }
+        payload.update(extra or {})
+        out.write(json.dumps(payload) + "\n")
+    else:
+        for d in diags:
+            out.write(f"{label}: {d}\n")
+        for k, v in (extra or {}).items():
+            if k != "events":
+                out.write(f"{label}: {k} = {v}\n")
+        out.write(
+            f"{label}: [{pass_name}] {len(errors)} error(s), "
+            f"{len(diags) - len(errors)} warning(s)\n"
+        )
+    return len(gating)
+
+
+# ---------------------------------------------------------------------------
+# subcommand bodies
+# ---------------------------------------------------------------------------
+
+
 def lint(program, feed_names, fetch_names, label, as_json=False,
          warnings_as_errors=False, out=sys.stdout):
-    """Verify one program; returns the number of gating findings."""
+    """Verify one program; returns the number of gating findings.
+    (Kept under this name: tests and older CI hooks call it directly.)"""
     from paddle_tpu.analysis.verify import verify_program
 
     diags = verify_program(
@@ -84,18 +246,7 @@ def lint(program, feed_names, fetch_names, label, as_json=False,
             "program": label,
             "errors": len(errors),
             "warnings": len(diags) - len(errors),
-            "diagnostics": [
-                {
-                    "severity": d.severity,
-                    "code": d.code,
-                    "message": d.message,
-                    "block": d.block_idx,
-                    "op_index": d.op_index,
-                    "op_type": d.op_type,
-                    "var": d.var,
-                }
-                for d in diags
-            ],
+            "diagnostics": [_diag_json(d) for d in diags],
         }) + "\n")
     else:
         for d in diags:
@@ -107,7 +258,354 @@ def lint(program, feed_names, fetch_names, label, as_json=False,
     return len(gating)
 
 
+def _cmd_shapes(args):
+    from paddle_tpu.analysis.shapes import infer_shapes
+
+    feed_shapes = _parse_feed_shapes(args.feed_shape)
+    failures = 0
+    for label, program, _feed, _fetch in _iter_programs(args, [], []):
+        rep = infer_shapes(program, feed_shapes=feed_shapes)
+        failures += _report(
+            label, "shapes", rep.diagnostics,
+            extra={"unresolved_ops": sorted(rep.unresolved),
+                   "amp_mode": rep.amp_mode},
+            as_json=args.as_json,
+            warnings_as_errors=args.warnings_as_errors,
+        )
+    return failures
+
+
+def _make_mesh(args):
+    shape, axes = _parse_mesh(args.mesh)
+    from paddle_tpu.parallel.env import make_mesh
+
+    return make_mesh(shape=shape, axis_names=axes)
+
+
+def _sharding_report(args, program, feed_shapes):
+    from paddle_tpu.analysis.sharding import analyze_sharding
+
+    layout = None
+    if args.spec_layout:
+        from paddle_tpu.parallel.spec_layout import SpecLayout
+
+        layout = SpecLayout()
+    return analyze_sharding(
+        program, _make_mesh(args), spec_layout=layout,
+        feed_shapes=feed_shapes,
+    )
+
+
+def _cmd_sharding(args):
+    from paddle_tpu.analysis.sharding import (
+        weight_param_shapes,
+        weight_sized_events,
+    )
+    from paddle_tpu.analysis.verify import Diagnostic
+
+    feed_shapes = _parse_feed_shapes(args.feed_shape)
+    failures = 0
+    for label, program, _feed, _fetch in _iter_programs(args, [], []):
+        rep = _sharding_report(args, program, feed_shapes)
+        diags = list(rep.diagnostics)
+        for e in weight_sized_events(rep, weight_param_shapes(program)):
+            diags.append(Diagnostic(
+                "error", "weight-sized-collective",
+                f"predicted {e.kind} of FULL weight '{e.var}' "
+                f"({list(e.shape)}, {e.bytes} bytes): {e.cause} — shard "
+                f"this parameter (spec_layout registry or an override) "
+                f"or every step pays a weight-sized gather",
+                op_type=e.op_type, op_index=e.op_index, var=e.var,
+            ))
+        failures += _report(
+            label, "sharding", diags,
+            extra={"max_bytes": rep.max_bytes(),
+                   "total_bytes": rep.total_bytes(),
+                   "by_kind": rep.by_kind(),
+                   "events": [e.to_json() for e in rep.events[:64]]},
+            as_json=args.as_json,
+            warnings_as_errors=args.warnings_as_errors,
+        )
+    return failures
+
+
+def _cmd_collectives(args):
+    from paddle_tpu.analysis.sharding import collective_budget_diagnostics
+
+    feed_shapes = _parse_feed_shapes(args.feed_shape)
+    budget = args.budget_kb * 1024
+    failures = 0
+    for label, program, _feed, _fetch in _iter_programs(args, [], []):
+        rep = _sharding_report(args, program, feed_shapes)
+        diags = list(rep.diagnostics)
+        diags += collective_budget_diagnostics(rep, budget)
+        failures += _report(
+            label, "collectives", diags,
+            extra={"budget_bytes": budget, "max_bytes": rep.max_bytes(),
+                   "by_kind": rep.by_kind(),
+                   "events": [e.to_json() for e in rep.events[:64]]},
+            as_json=args.as_json,
+            warnings_as_errors=args.warnings_as_errors,
+        )
+    return failures
+
+
+def _static_donation_plan(program, feed_names, fetch_names):
+    """plan_step's donation classification without a scope: persistable
+    vars written by live ops and not fetched are donated, the rest of the
+    persistable reads are read-only."""
+    block = program.global_block()
+    from paddle_tpu.analysis.usedef import UseDefMap
+
+    usedef = UseDefMap(block)
+    read, written = set(), set()
+    for op in block.ops:
+        read |= usedef.reads_of(op)
+        written |= usedef.writes_of(op)
+
+    def persistable(n):
+        v = block._find_var_recursive(n)
+        return v is not None and v.persistable
+
+    fetches = set(fetch_names)
+    donated = sorted(n for n in written
+                     if persistable(n) and n not in fetches)
+    readonly = sorted(n for n in read
+                      if persistable(n) and n not in set(donated))
+    return donated, readonly
+
+
+def _cmd_memory(args):
+    from paddle_tpu.analysis.memory import (
+        check_donation_safety,
+        estimate_peak_hbm,
+    )
+
+    feed_shapes = _parse_feed_shapes(args.feed_shape)
+    failures = 0
+    for label, program, feed, fetch in _iter_programs(args, [], []):
+        donated, readonly = _static_donation_plan(program, feed, fetch)
+        diags = check_donation_safety(program, donated, readonly, fetch)
+        donate = not args.no_donate
+        rep = estimate_peak_hbm(
+            program, feed_shapes=feed_shapes, fetch_names=fetch,
+            donate=donate,
+        )
+        diags = diags + rep.diagnostics
+        failures += _report(
+            label, "memory", diags,
+            extra={"peak": rep.to_json(), "donated": len(donated)},
+            as_json=args.as_json,
+            warnings_as_errors=args.warnings_as_errors,
+        )
+    return failures
+
+
+def _build_example(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"lint_example_{name}", os.path.join(REPO, "examples", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    built = mod.build_programs()
+    main, startup, feed_names = built[0], built[1], built[2]
+    fetch_names = [f if isinstance(f, str) else f.name for f in built[3]]
+    return main, startup, feed_names, fetch_names
+
+
+def _cmd_smoke(args):
+    """Fast-tier CI gate: every examples/ program is clean under shapes +
+    sharding (8-way dp mesh) + donation safety, and the committed
+    STATIC_EVIDENCE_r09.json static predictions match a fresh recompute
+    (drift here means the analyzer or the layout changed without
+    regenerating evidence — run tools/static_report.py)."""
+    import builtins
+
+    as_json = bool(getattr(args, "as_json", False))
+    findings = []
+
+    def print(*a, **kw):  # noqa: A001 - JSON mode keeps stdout machine-only
+        msg = " ".join(str(x) for x in a)
+        if msg.startswith("SMOKE FAIL"):
+            findings.append(msg)
+        kw.setdefault("file", sys.stderr if as_json else sys.stdout)
+        builtins.print(*a, **kw)
+
+    from paddle_tpu.analysis.memory import check_donation_safety
+    from paddle_tpu.analysis.shapes import infer_shapes
+    from paddle_tpu.analysis.sharding import analyze_sharding
+    from paddle_tpu.parallel.env import make_mesh
+    from paddle_tpu.passes import (
+        apply_deferred_sharded_embedding_rewrite,
+        apply_deferred_sparse_rewrite,
+    )
+
+    failures = 0
+    mesh = make_mesh(shape=(8,), axis_names=("data",))
+    for name in EXAMPLES:
+        main, startup, feed_names, fetch_names = _build_example(name)
+        apply_deferred_sparse_rewrite(main)
+        apply_deferred_sharded_embedding_rewrite(main)
+        before = failures
+        for tag, program in ((f"{name}:main", main),
+                             (f"{name}:startup", startup)):
+            rep = infer_shapes(program)
+            errs = rep.errors()
+            if errs:
+                failures += 1
+                print(f"SMOKE FAIL {tag}: shape errors: "
+                      f"{[str(d)[:120] for d in errs[:3]]}")
+        srep = analyze_sharding(main, mesh)
+        # weight-sized linting needs a tensor-sharded placement, which no
+        # example uses — that class is covered by the evidence drift gate
+        # below (registry + megatron-control arms). What IS checkable on
+        # this pure-dp mesh is the grad-sync law: events only for
+        # trainable parameters, never optimizer slots/scheduler counters
+        # (a phantom event here inflates every downstream byte budget)
+        trainable = {p.name for p in main.all_parameters()}
+        phantom = sorted({e.var for e in srep.events
+                          if e.cause == "grad-sync"} - trainable)
+        if phantom:
+            failures += 1
+            print(f"SMOKE FAIL {name}: grad-sync predicted for "
+                  f"non-parameter state: {phantom[:3]}")
+        donated, readonly = _static_donation_plan(
+            main, feed_names, fetch_names
+        )
+        ddiags = check_donation_safety(main, donated, readonly,
+                                       fetch_names)
+        if ddiags:
+            failures += 1
+            print(f"SMOKE FAIL {name}: donation safety: "
+                  f"{[d.code for d in ddiags[:3]]}")
+        if failures == before:
+            print(f"smoke: {name} clean "
+                  f"(donated={len(donated)}, events={len(srep.events)})")
+
+    # static-evidence drift gate: recompute the static half of
+    # STATIC_EVIDENCE_r09.json and compare
+    path = os.path.join(REPO, "STATIC_EVIDENCE_r09.json")
+    if not os.path.exists(path):
+        print("SMOKE FAIL: STATIC_EVIDENCE_r09.json missing "
+              "(run tools/static_report.py --out STATIC_EVIDENCE_r09.json)")
+        return failures + 1
+    with open(path) as f:
+        committed = json.load(f)
+    import importlib.util
+
+    sr_spec = importlib.util.spec_from_file_location(
+        "static_report", os.path.join(REPO, "tools", "static_report.py")
+    )
+    static_report = importlib.util.module_from_spec(sr_spec)
+    sr_spec.loader.exec_module(static_report)
+
+    fresh = static_report.static_sections()
+    for arm, sec in fresh.items():
+        # a fresh arm absent from the committed file IS drift (exit 1),
+        # not a KeyError traceback (exit 2)
+        want = committed.get("arms", {}).get(arm, {}).get("static", {})
+        for key in ("weight_sized_count", "max_bytes", "budget_verdict",
+                    "weight_sized_shapes"):
+            if want.get(key) != sec.get(key):
+                failures += 1
+                print(f"SMOKE FAIL: static evidence drift in {arm}.{key}: "
+                      f"committed {want.get(key)} != fresh {sec.get(key)}")
+    for arm in sorted(set(committed.get("arms", {})) - set(fresh)):
+        # committed claims nothing re-derives any more are drift too: an
+        # arm deleted/renamed in static_report.py must regenerate the file
+        failures += 1
+        print(f"SMOKE FAIL: committed evidence arm '{arm}' is no longer "
+              f"derived by tools/static_report.py — regenerate "
+              f"STATIC_EVIDENCE_r09.json or restore the arm")
+    if not failures:
+        print("smoke: all examples clean, static evidence matches")
+    if as_json:
+        builtins.print(json.dumps({
+            "program": "smoke", "pass": not failures,
+            "examples": list(EXAMPLES), "failures": findings,
+        }))
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def _add_common(ap, with_mesh=False):
+    ap.add_argument("programs", nargs="*", help="serialized program files")
+    ap.add_argument("--builtin", action="append", default=[],
+                    choices=BUILTINS,
+                    help="lint a freshly-built known model program")
+    ap.add_argument("--feed-shape", action="append", default=[],
+                    metavar="NAME=D0,D1",
+                    help="bind a feed's symbolic dims (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON report line per program")
+    ap.add_argument("--warnings-as-errors", action="store_true")
+    if with_mesh:
+        ap.add_argument("--mesh", required=True, metavar="SHAPE:AXES",
+                        help="virtual mesh, e.g. 2x4:data,model")
+        ap.add_argument("--spec-layout", action="store_true",
+                        help="place parameters through the canonical "
+                        "SpecLayout registry (parallel/spec_layout.py)")
+
+
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    sub = argv[0] if argv and argv[0] in SUBCOMMANDS else None
+    if sub in ("sharding", "collectives"):
+        n = _mesh_arg_devices(argv)
+        if n:
+            _ensure_virtual_devices(n)
+    if sub == "smoke":
+        _ensure_virtual_devices(8)
+
+    try:
+        if sub is None:
+            return _legacy_main(argv)
+        body = argv[1:]
+        if sub == "verify":
+            return _legacy_main(body)
+        ap = argparse.ArgumentParser(
+            prog=f"lint_program.py {sub}",
+            description=f"static '{sub}' lint over serialized programs",
+        )
+        if sub == "smoke":
+            ap.add_argument("--json", action="store_true", dest="as_json",
+                            help="one JSON summary line on stdout "
+                            "(progress goes to stderr)")
+            return (EXIT_FINDINGS if _cmd_smoke(ap.parse_args(body))
+                    else EXIT_CLEAN)
+        _add_common(ap, with_mesh=sub in ("sharding", "collectives"))
+        if sub == "collectives":
+            ap.add_argument("--budget-kb", type=int, required=True,
+                            help="per-collective byte budget in KB")
+        if sub == "memory":
+            ap.add_argument("--no-donate", action="store_true",
+                            help="estimate without buffer donation")
+        args = ap.parse_args(body)
+        if not args.programs and not args.builtin:
+            ap.error("nothing to lint: pass program files and/or --builtin")
+        body_fn = {
+            "shapes": _cmd_shapes,
+            "sharding": _cmd_sharding,
+            "collectives": _cmd_collectives,
+            "memory": _cmd_memory,
+        }[sub]
+        return EXIT_FINDINGS if body_fn(args) else EXIT_CLEAN
+    except SystemExit:
+        raise
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return EXIT_INTERNAL
+
+
+def _legacy_main(argv):
     ap = argparse.ArgumentParser(
         description="Lint serialized programs with the IR verifier"
     )
@@ -143,7 +641,7 @@ def main(argv=None):
             program, bfeed, bfetch, f"builtin:{name}",
             as_json=args.as_json, warnings_as_errors=args.warnings_as_errors,
         )
-    return 1 if failures else 0
+    return EXIT_FINDINGS if failures else EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""STATIC_EVIDENCE_r09 generator: static sharding predictions vs live HLO.
+
+Round 9's claim is that the collective story PR 7 proved by compiling and
+grepping HLO is *statically decidable*: analysis/sharding.py predicts —
+without XLA in the loop — which collectives a (program, mesh, layout)
+triple will pay and how many bytes each moves. This tool makes that claim
+falsifiable the r07 way: for each evidence arm (registry tp tiny-BERT,
+registry dp×fsdp×tp, MEGATRON_RULES control) it records
+
+  static:  the analyzer's resharding report — per-kind byte accounting,
+           predicted weight-sized collectives (shape + bytes + cause),
+           and the --budget-kb verdict
+  live:    the same program actually lowered on the 8-virtual-device mesh
+           (utils/hlo.py builders, identical geometry to r07), with
+           weight_shaped_collectives + collective_byte_report
+  match:   every live weight-shaped collective resolved against a static
+           prediction of the same shape, byte ratio recorded (the
+           acceptance bound is 2x)
+
+plus the static peak-HBM estimates (donate on/off) for the examples/
+programs. tests/test_hlo.py::test_static_evidence_r09_committed re-derives
+the live half and tools/lint_program.py smoke re-derives the static half,
+so neither side can drift silently.
+
+Usage: python tools/static_report.py [--out STATIC_EVIDENCE_r09.json]
+       (~3 min on the CPU rig; the static half alone is seconds)
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+GEOMETRY = {"batch": 8, "seq_len": 24, "max_pred": 20}
+BUDGET_KB = 192  # separates activation-class (<=160 KB live max on the
+# registry arms) from the control's 256 KB full-weight gathers
+
+
+def _arms():
+    from paddle_tpu.parallel.env import make_mesh
+    from paddle_tpu.parallel.sharding import MEGATRON_RULES
+    from paddle_tpu.parallel.spec_layout import SpecLayout
+
+    return {
+        "tp_registry": dict(
+            mesh=make_mesh((2, 4), ("data", "model")),
+            mesh_spec=((2, 4), ("data", "model")),
+            spec_layout=SpecLayout(), param_rules=None,
+        ),
+        "dp_fsdp_tp_registry": dict(
+            mesh=make_mesh((2, 2, 2), ("data", "fsdp", "model")),
+            mesh_spec=((2, 2, 2), ("data", "fsdp", "model")),
+            spec_layout=SpecLayout(), param_rules=None,
+        ),
+        "megatron_control": dict(
+            mesh=make_mesh((2, 4), ("data", "model")),
+            mesh_spec=((2, 4), ("data", "model")),
+            spec_layout=None, param_rules=MEGATRON_RULES,
+        ),
+    }
+
+
+def _evidence_program():
+    """The r07 evidence program + synthetic feed shapes + param shapes —
+    built ONCE and shared by the static and live halves."""
+    import numpy as np
+
+    from paddle_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    main, startup, feeds, fetches = bert.build_bert_pretrain(
+        cfg, seq_len=GEOMETRY["seq_len"], lr=1e-3,
+        max_predictions_per_seq=GEOMETRY["max_pred"],
+    )
+    data = bert.synthetic_batch(
+        np.random.RandomState(0), GEOMETRY["batch"], GEOMETRY["seq_len"],
+        cfg, max_predictions_per_seq=GEOMETRY["max_pred"],
+    )
+    feed_shapes = {k: tuple(np.asarray(v).shape) for k, v in data.items()}
+    from paddle_tpu.analysis.sharding import weight_param_shapes
+
+    return main, feed_shapes, weight_param_shapes(main)
+
+
+def static_sections():
+    """arm -> static prediction summary (the half the smoke gate
+    re-derives; NO lowering happens here)."""
+    from paddle_tpu.analysis.sharding import (
+        analyze_sharding,
+        collective_budget_diagnostics,
+        weight_sized_events,
+    )
+
+    main, feed_shapes, param_shapes = _evidence_program()
+    out = {}
+    for tag, arm in _arms().items():
+        rep = analyze_sharding(
+            main, arm["mesh"], spec_layout=arm["spec_layout"],
+            param_rules=arm["param_rules"], feed_shapes=feed_shapes,
+        )
+        ws = weight_sized_events(rep, param_shapes)
+        over = collective_budget_diagnostics(rep, BUDGET_KB * 1024)
+        shape_counts = {}
+        for e in ws:
+            key = "x".join(map(str, e.shape))
+            shape_counts[key] = shape_counts.get(key, 0) + 1
+        out[tag] = {
+            "events": len(rep.events),
+            "by_kind": rep.by_kind(),
+            "max_bytes": rep.max_bytes(),
+            "total_bytes": rep.total_bytes(),
+            "weight_sized_count": len(ws),
+            "weight_sized_shapes": dict(sorted(shape_counts.items())),
+            "weight_sized": [e.to_json() for e in ws],
+            "budget_kb": BUDGET_KB,
+            "budget_verdict": "fail" if over else "pass",
+            "over_budget": len(over),
+        }
+    return out
+
+
+def live_sections():
+    """arm -> live HLO ground truth (the half the evidence test
+    re-derives; lowers each arm on the virtual mesh, minutes)."""
+    from collections import Counter
+
+    from paddle_tpu.utils import hlo
+
+    out = {}
+    geo = dict(seq_len=GEOMETRY["seq_len"], max_pred=GEOMETRY["max_pred"],
+               with_param_shapes=True)
+    for tag, arm in _arms().items():
+        shape, axes = arm["mesh_spec"]
+        txt, shapes = hlo.tiny_bert_parallel_text(
+            shape, axes, param_rules=arm["param_rules"],
+            spec_layout=arm["spec_layout"], **geo,
+        )
+        offenders = hlo.weight_shaped_collectives(txt, shapes)
+        counts = Counter(
+            (kind, "x".join(map(str, s))) for kind, s, _l in offenders
+        )
+        rep = hlo.collective_byte_report(txt)
+        out[tag] = {
+            "weight_shaped_count": len(offenders),
+            "weight_shaped": [
+                {"kind": k, "shape": s, "count": n}
+                for (k, s), n in sorted(counts.items())
+            ],
+            "collectives": hlo.count_collectives(txt),
+            "max_bytes": rep["max_bytes"],
+            "by_kind": rep["by_kind"],
+        }
+    return out
+
+
+def match_sections(static, live):
+    """Resolve every live weight-shaped collective against a static
+    prediction of the same full shape; byte ratios must be within 2x."""
+    out = {}
+    for tag in static:
+        s, l = static[tag], live[tag]
+        matches, unmatched = [], []
+        for ent in l["weight_shaped"]:
+            shape = tuple(int(d) for d in ent["shape"].split("x"))
+            nbytes = 1
+            for d in shape:
+                nbytes *= d
+            nbytes *= 4  # the evidence programs train f32 master state
+            preds = [e for e in s["weight_sized"]
+                     if tuple(e["shape"] or ()) == shape and e["bytes"]]
+            if not preds:
+                unmatched.append(ent)
+                continue
+            best = min(preds, key=lambda e: abs(e["bytes"] - nbytes))
+            ratio = max(best["bytes"], nbytes) / max(
+                min(best["bytes"], nbytes), 1)
+            matches.append({
+                "shape": ent["shape"], "live_kind": ent["kind"],
+                "live_count": ent["count"], "live_bytes": nbytes,
+                "static_cause": best["cause"],
+                "static_bytes": best["bytes"],
+                "byte_ratio": round(ratio, 4),
+            })
+        out[tag] = {
+            "live_collectives_matched": len(matches),
+            "live_collectives_unmatched": len(unmatched),
+            "unmatched": unmatched,
+            "max_byte_ratio": max(
+                (m["byte_ratio"] for m in matches), default=1.0),
+            "matches": matches,
+        }
+    return out
+
+
+def example_memory_section():
+    """Static peak-HBM estimates for the examples/ programs (donate
+    on/off) — the numbers tests/test_static_analysis.py bounds against
+    runtime-observed live bytes."""
+    import importlib.util
+
+    from paddle_tpu.analysis.memory import estimate_peak_hbm
+    from paddle_tpu.passes import (
+        apply_deferred_sharded_embedding_rewrite,
+        apply_deferred_sparse_rewrite,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = {}
+    for name in ("fit_a_line", "recognize_digits", "recommender_system"):
+        spec = importlib.util.spec_from_file_location(
+            f"sr_example_{name}", os.path.join(repo, "examples",
+                                               f"{name}.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        built = mod.build_programs()
+        main, feed_names, fetches = built[0], built[2], built[3]
+        apply_deferred_sparse_rewrite(main)
+        apply_deferred_sharded_embedding_rewrite(main)
+        fetch_names = [f if isinstance(f, str) else f.name for f in fetches]
+        # bind the symbolic batch dims (batch 16) so every intermediate
+        # has a concrete size
+        feed_shapes = {}
+        block = main.global_block()
+        for fname in feed_names:
+            v = block._find_var_recursive(fname)
+            if v is not None and v.shape is not None:
+                feed_shapes[fname] = tuple(
+                    16 if d is None or d < 0 else int(d) for d in v.shape
+                )
+        on = estimate_peak_hbm(main, feed_shapes=feed_shapes,
+                               fetch_names=fetch_names, donate=True)
+        off = estimate_peak_hbm(main, feed_shapes=feed_shapes,
+                                fetch_names=fetch_names, donate=False)
+        out[name] = {
+            "peak_donate_bytes": on.peak_total_bytes,
+            "peak_no_donate_bytes": off.peak_total_bytes,
+            "persistent_bytes": on.persistent_bytes,
+            "unknown_vars": len(on.unknown_vars),
+        }
+    return out
+
+
+def build_report(with_live=True):
+    static = static_sections()
+    report = {
+        "geometry": GEOMETRY,
+        "budget_kb": BUDGET_KB,
+        "arms": {tag: {"static": sec} for tag, sec in static.items()},
+        "example_peak_hbm": example_memory_section(),
+    }
+    if with_live:
+        live = live_sections()
+        match = match_sections(static, live)
+        for tag in report["arms"]:
+            report["arms"][tag]["live"] = live[tag]
+            report["arms"][tag]["match"] = match[tag]
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--static-only", action="store_true",
+                    help="skip the live HLO recompute (seconds, not "
+                    "minutes; the smoke gate's mode)")
+    args = ap.parse_args()
+    report = build_report(with_live=not args.static_only)
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
