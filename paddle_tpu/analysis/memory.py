@@ -35,7 +35,8 @@ from paddle_tpu.analysis.usedef import UseDefMap
 from paddle_tpu.analysis.verify import Diagnostic
 from paddle_tpu.core.dtypes import dtype_size
 
-__all__ = ["MemoryReport", "estimate_peak_hbm", "check_donation_safety"]
+__all__ = ["MemoryReport", "estimate_peak_hbm", "check_donation_safety",
+           "check_hbm_budget"]
 
 _OP_ROLE_BACKWARD = 1
 _OP_ROLE_OPTIMIZE = 2
@@ -243,6 +244,30 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
 # ---------------------------------------------------------------------------
 # donation safety — the pre-lowering hard-error gate
 # ---------------------------------------------------------------------------
+
+
+def check_hbm_budget(report, budget_bytes, label=""):
+    """Gate a MemoryReport against a per-device HBM budget BEFORE any
+    compile. Returns error Diagnostics (empty = fits). The continuous-
+    batching decode engine sizes its pre-allocated KV arenas with this:
+    the arenas are persistable program state, so an oversized
+    ``slots x max_len`` grid shows up in ``persistent_bytes`` and fails
+    here with sizing advice instead of OOMing inside XLA."""
+    budget_bytes = int(budget_bytes)
+    if budget_bytes <= 0 or report.peak_total_bytes <= budget_bytes:
+        return []
+    what = f" for '{label}'" if label else ""
+    return [Diagnostic(
+        "error", "hbm-over-budget",
+        f"estimated peak HBM{what} is "
+        f"{report.peak_total_bytes / 2**20:.1f} MiB "
+        f"(persistent {report.persistent_bytes / 2**20:.1f} MiB + "
+        f"intermediates {report.peak_intermediate_bytes / 2**20:.1f} MiB "
+        f"at op #{report.peak_op_index} <{report.peak_op_type}>), over "
+        f"the {budget_bytes / 2**20:.1f} MiB budget — shrink the KV "
+        f"arena (fewer slots / shorter max_len), drop layers, or raise "
+        f"the budget",
+    )]
 
 
 def check_donation_safety(program, donated, readonly=(), fetch_names=(),

@@ -33,7 +33,7 @@ from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.utils.enforce import EnforceError
 
 __all__ = ["LoweredStep", "lower_step", "jit_compile", "verify_for_lowering",
-           "abstract_signature"]
+           "abstract_signature", "zero_rng_key"]
 
 _JITS = obs_metrics.registry().counter(
     "lowering_jit_total", "jax.jit computations created via the chokepoint"
@@ -284,9 +284,12 @@ def _sds(value):
     return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
 
-def _rng_abstract():
-    """Abstract value of the rng key argument, matching the construction
-    in ``Executor._next_rng_key`` (impl-dependent dtype)."""
+def zero_rng_key(device=None):
+    """The fixed zero rng key deterministic (inference/decode) steps pass
+    for the shared 4-arg contract's rng slot. MUST be built flags-aware —
+    under ``FLAGS_rng_impl != threefry`` a plain PRNGKey would be a dtype
+    mismatch against ``_rng_abstract`` on every call. One definition
+    (Predictor and the decode engine both commit this key once)."""
     import jax
 
     from paddle_tpu.utils.flags import flags
@@ -295,6 +298,15 @@ def _rng_abstract():
         key = jax.random.key(0, impl=flags.rng_impl)
     else:
         key = jax.random.PRNGKey(0)
+    return jax.device_put(key, device) if device is not None else key
+
+
+def _rng_abstract():
+    """Abstract value of the rng key argument, matching the construction
+    in ``Executor._next_rng_key`` (impl-dependent dtype)."""
+    import jax
+
+    key = zero_rng_key()
     return jax.ShapeDtypeStruct(key.shape, key.dtype)
 
 

@@ -503,20 +503,12 @@ class Predictor:
     def _rng_arg(self):
         # the lowered step takes the rng key as an argument (shared 4-arg
         # contract); inference programs are deterministic, so one
-        # committed zero key serves every call. MUST be built with the
-        # same flags-aware construction as lowering._rng_abstract (the
-        # AOT executable's input aval): under FLAGS_rng_impl != threefry
-        # a plain PRNGKey would be a dtype mismatch on every request.
+        # committed zero key serves every call (lowering.zero_rng_key is
+        # flags-aware so the dtype matches the AOT executable's rng aval)
         if self._rng0 is None:
-            import jax
+            from paddle_tpu.core.lowering import zero_rng_key
 
-            from paddle_tpu.utils.flags import flags
-
-            if flags.rng_impl != "threefry":
-                key = jax.random.key(0, impl=flags.rng_impl)
-            else:
-                key = jax.random.PRNGKey(0)
-            self._rng0 = jax.device_put(key, self._place.jax_device())
+            self._rng0 = zero_rng_key(self._place.jax_device())
         return self._rng0
 
     def _execute_feeds(self, feed_vals):

@@ -25,6 +25,9 @@ __all__ = [
     "distributed_embedding",
     "sharded_embedding",
     "scaled_dot_product_attention",
+    "kv_cache_write",
+    "masked_write",
+    "cached_attention",
     "moe_ffn",
     "dropout",
     "softmax",
@@ -572,6 +575,55 @@ def scaled_dot_product_attention(q, k, v, bias=None, causal=False,
         "scaled_dot_product_attention", inputs, {"Out": [out.name]}, attrs
     )
     return out
+
+
+def kv_cache_write(cache, new_kv, write_onehot, name=None):
+    """Write one new key/value row per sequence into a slotted KV cache,
+    functionally: ``out[s, l] = new_kv[s] if write_onehot[s, l] else
+    cache[s, l]``. ``cache`` is ``[S, L, H]``, ``new_kv`` ``[S, H]``, and
+    ``write_onehot`` a ``[S, L]`` float mask that is one-hot at each
+    sequence's write cursor (an all-zero row leaves that sequence's cache
+    bit-untouched — how the decode engine freezes inactive slots).
+
+    Returns the updated cache; callers persist it with
+    ``layers.assign(out, output=cache_var)`` so the lowering donates the
+    arena and the update happens in place on device."""
+    mask = unsqueeze(write_onehot, [2], name=name)       # [S, L, 1]
+    new_row = unsqueeze(new_kv, [1])                     # [S, 1, H]
+    return masked_write(cache, new_row, mask)
+
+
+def masked_write(cache, new, mask, name=None):
+    """``cache*(1-mask) + new*mask`` for a 0/1 float ``mask``
+    broadcastable against both operands — THE bit-exactness-critical
+    masked update shared by every slotted-arena write (`kv_cache_write`'s
+    per-position one-hot, the decode inject program's per-slot mask).
+
+    Composes multiply/add on existing ops instead of a scatter. Both
+    branches are exact in IEEE arithmetic (``x*1.0 == x``,
+    ``x + 0.0 == x``), which is what makes continuous-batching decode
+    bit-identical to offline decode — positions where the mask is zero
+    are never perturbed by writes addressed elsewhere."""
+    keep = scale(mask, scale=-1.0, bias=1.0, name=name)  # 1 - mask
+    return elementwise_add(
+        elementwise_mul(cache, keep),
+        elementwise_mul(new, mask),
+    )
+
+
+def cached_attention(q, k_cache, v_cache, attn_bias, sm_scale=1.0,
+                     name=None):
+    """Single-position attention of ``q`` ``[S, H]`` over a slotted KV
+    cache ``[S, L, H]`` — the decode-step half of cached (incremental)
+    attention; `kv_cache_write` is the other half. ``attn_bias`` is an
+    additive ``[S, 1, L]`` mask fed from the host scheduler: 0.0 at
+    positions ``<= cursor``, -1e9 beyond (exp underflows to exactly 0.0,
+    the repo-wide padding contract), so stale cache positions are
+    bit-invisible. Returns the ``[S, H]`` context vectors."""
+    q3 = unsqueeze(q, [1], name=name)                    # [S, 1, H]
+    scores = matmul(q3, k_cache, transpose_y=True, alpha=float(sm_scale))
+    att = softmax(elementwise_add(scores, attn_bias), axis=-1)
+    return squeeze(matmul(att, v_cache), [1])            # [S, H]
 
 
 def moe_ffn(input, num_experts, d_ff=None, expert_axis="expert",

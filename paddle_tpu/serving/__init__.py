@@ -27,9 +27,18 @@ Layers (each its own module, composable and separately testable):
   graceful drain, and the `stats()` snapshot.
 * `metrics`  — always-on serving counters + latency reservoirs, mirrored
   into profiler.py's event/counter machinery when profiling is enabled.
+* `decode`   — the continuous-batching generation subsystem (serving
+  v2): iteration-level scheduler over a slotted KV arena, multi-tenant
+  model registry, AOT warm start (`GenerationEngine`, `DecodeModel`,
+  `build_decoder_model`).
 """
 
 from paddle_tpu.serving.batcher import BucketLattice, DynamicBatcher
+from paddle_tpu.serving.decode import (
+    DecodeModel,
+    GenerationEngine,
+    build_decoder_model,
+)
 from paddle_tpu.serving.engine import ServingEngine
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.queue import RequestQueue
@@ -46,7 +55,10 @@ from paddle_tpu.serving.request import (
 __all__ = [
     "BucketLattice",
     "DeadlineExceededError",
+    "DecodeModel",
     "DynamicBatcher",
+    "GenerationEngine",
+    "build_decoder_model",
     "Priority",
     "RejectedError",
     "Request",

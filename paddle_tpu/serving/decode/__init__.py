@@ -1,0 +1,44 @@
+"""Continuous-batching decode subsystem (online serving v2).
+
+The PR-2 engine (serving/engine.py) schedules at REQUEST granularity:
+whole requests coalesce into fixed (batch, seq) buckets and a finished
+sequence holds its rows until the slowest batchmate drains. This package
+schedules at ITERATION granularity (Orca, OSDI'22) over a slotted KV
+arena (the fixed-shape analog of vLLM's paged KV, SOSP'23): a decode
+batch of S slots is stepped once per model iteration through ONE
+compiled ``[S, 1]`` executable, finished sequences retire between
+iterations, and admitted prompts prefill into free slots mid-flight.
+
+Modules:
+
+* `model`  — `DecodeModel`: the three-program (decode step / prefill /
+  inject) fixed-shape contract + `build_decoder_model`, the canonical
+  cached-attention decoder builder.
+* `pool`   — host-side slot allocator + content-hash prefix cache over
+  prefill results (shared-prefix dedup).
+* `engine` — `GenerationEngine`: multi-tenant model registry, weighted-
+  fair admission over the queue's priority lanes, the per-entry
+  scheduler loop, circuit-breaker relaunch, and AOT warm start through
+  the compile cache.
+* `metrics`— `DecodeMetrics`: the serving counter set + occupancy /
+  tokens-per-step / step-latency series.
+"""
+
+from paddle_tpu.serving.decode.engine import (
+    GenerationEngine,
+    GenerationRequest,
+)
+from paddle_tpu.serving.decode.metrics import DecodeMetrics
+from paddle_tpu.serving.decode.model import DecodeModel, build_decoder_model
+from paddle_tpu.serving.decode.pool import PrefixCache, SlotPool, prompt_key
+
+__all__ = [
+    "DecodeMetrics",
+    "DecodeModel",
+    "GenerationEngine",
+    "GenerationRequest",
+    "PrefixCache",
+    "SlotPool",
+    "build_decoder_model",
+    "prompt_key",
+]

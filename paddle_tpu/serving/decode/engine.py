@@ -1,0 +1,876 @@
+"""GenerationEngine: continuous-batching decode over slotted KV arenas.
+
+The PR-2 ServingEngine batches whole requests into fixed buckets — a
+finished sequence holds its rows until the whole bucket drains. This
+engine schedules at ITERATION granularity (Orca, OSDI'22): a fixed batch
+of S slots is stepped once per model iteration through ONE compiled
+``[S, 1]`` decode executable; finished sequences retire between
+iterations and admitted prompts prefill into free slots mid-flight, so
+occupancy tracks offered load instead of the slowest batchmate.
+
+Correctness contract (tested, not asserted by construction alone):
+generation is bit-identical to offline whole-sequence decode for the
+same prompt, regardless of admission order, slot assignment, or what the
+other slots are doing — because (a) retired/foreign slots touch the
+arena only through multiply-by-zero writes (exact no-ops in IEEE
+arithmetic), and (b) the additive ``-1e9`` attention bias makes
+positions beyond a slot's cursor contribute exactly 0.0 (the repo-wide
+padding contract).
+
+Multi-tenancy: one engine hosts N ``(model, version)`` entries, each with
+its own slot batch, queue, and scheduler thread. Admission applies
+per-tenant quotas (queued rows reject at the door; in-flight caps make
+the picker skip, not reject) and WEIGHTED-FAIR selection layered over the
+queue's strict priority lanes: within the head non-empty lane, the
+tenant with the smallest virtual time wins the free slot and pays
+``1/weight`` virtual time for it (stride scheduling), so a tenant with
+weight 2 gets two slots for every one a weight-1 tenant gets — under
+contention, and only then.
+
+Cold start: the three executables per entry lower through
+``core/lowering.py`` into the content-addressed compile cache. With
+``PADDLE_TPU_CACHE_DIR`` set, a fresh replica (or the circuit breaker's
+relaunched replacement) restores decode/prefill/inject from the
+``jax.export`` disk tier with ZERO traces — subprocess-asserted in
+tests/test_decode.py. Before anything compiles, the KV arena is sized
+against the peak-HBM budget via ``analysis/memory.py`` — an oversized
+``slots x max_len`` grid fails with sizing advice, not an XLA OOM.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu import profiler
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving.decode.metrics import DecodeMetrics
+from paddle_tpu.serving.decode.model import NEG_INF, DecodeModel
+from paddle_tpu.serving.decode.pool import PrefixCache, SlotPool, prompt_key
+from paddle_tpu.serving.engine import _ReplicaBreaker
+from paddle_tpu.serving.queue import RequestQueue
+from paddle_tpu.serving.request import (
+    DeadlineExceededError,
+    Priority,
+    RejectedError,
+    RequestError,
+    Response,
+)
+
+__all__ = ["GenerationEngine", "GenerationRequest"]
+
+
+class GenerationRequest:
+    """One admitted generation request (rows is always 1: a request holds
+    one slot). `response.result()` yields ``{"tokens": int64 array}`` —
+    the generated tokens, including the stop token when eos fired."""
+
+    __slots__ = ("id", "prompt", "max_new", "tenant", "priority", "deadline",
+                 "submit_time", "dispatch_time", "response", "rows")
+
+    def __init__(self, rid, prompt, max_new, tenant, priority, deadline):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.tenant = str(tenant)
+        self.priority = priority
+        self.deadline = deadline
+        self.submit_time = time.perf_counter()
+        self.dispatch_time = None
+        self.response = Response()
+        self.rows = 1
+
+    def expired(self, now=None):
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) > self.deadline
+
+
+class _ArenaInvalidError(RuntimeError):
+    """A DONATED arena update (inject) failed mid-execution: the old
+    buffers were consumed and the new ones never materialized, so the
+    whole KV pool — not just the admitting request — is undefined."""
+
+
+class _TenantState:
+    __slots__ = ("weight", "max_in_flight", "max_queued", "in_flight",
+                 "queued", "vtime")
+
+    def __init__(self, weight=1.0, max_in_flight=None, max_queued=None):
+        self.weight = float(weight)
+        self.max_in_flight = max_in_flight
+        self.max_queued = max_queued
+        self.in_flight = 0
+        self.queued = 0
+        self.vtime = 0.0
+
+
+class _Slot:
+    """Host-side state of one live arena slot."""
+
+    __slots__ = ("request", "cursor", "last_token", "generated")
+
+    def __init__(self, request, cursor, first_token):
+        self.request = request
+        self.cursor = cursor          # next arena position to write
+        self.last_token = first_token
+        self.generated = [first_token]
+
+
+class _ModelEntry:
+    """One hosted (model, version): programs + executables + slot batch +
+    its scheduler thread. All slot/arena mutation happens on the loop
+    thread; admission hand-off goes through the queue."""
+
+    def __init__(self, engine, model, queue_depth, breaker_threshold,
+                 breaker_cooldown_s, prefix_cache_size):
+        self._engine = engine
+        self._model = model
+        self._queue = RequestQueue(queue_depth)
+        self._cond = threading.Condition(self._queue.lock)
+        self._pool = SlotPool(model.slots)
+        self._slots = [None] * model.slots
+        self._prefix = PrefixCache(prefix_cache_size)
+        self._breaker = (
+            _ReplicaBreaker(breaker_threshold, breaker_cooldown_s)
+            if breaker_threshold and breaker_threshold > 0 else None
+        )
+        self._metrics = DecodeMetrics(
+            engine_label=f"{engine.label}:{model.label}")
+        self.compile_sources = {"trace": 0, "disk": 0, "memory": 0}
+        self._entries = {}      # kind -> (LoweredStep, executable)
+        self._thread = None
+        self._stop = False
+        self._scope = None
+        self._rng0 = None
+        # half-open relaunch latch: one rebuild per breaker episode
+        self._probe_relaunched = False
+
+    # -- build / warmup ---------------------------------------------------
+    def build(self):
+        """Run startup (weights + zeroed arenas into the scope), then
+        lower + AOT-compile the three executables. With a warm compile
+        cache nothing here traces (`compile_sources` says so)."""
+        import paddle_tpu as fluid
+        from paddle_tpu.core.lowering import zero_rng_key
+
+        self._scope = fluid.Scope()
+        exe = fluid.Executor(self._engine.place)
+        with fluid.scope_guard(self._scope):
+            exe.run(self._model.startup_program)
+        self._rng0 = zero_rng_key(self._engine.device)
+        self._lower_all()
+        return self
+
+    def _lower_all(self):
+        from paddle_tpu.core import lowering
+
+        m = self._model
+        plans = (
+            ("step", m.decode_program, m.decode_feed_sig(),
+             [m.logits_fetch], True),
+            ("prefill", m.prefill_program, m.prefill_feed_sig(),
+             [m.prefill_logits_fetch] + [n for kv in m.prefill_kv_fetches
+                                         for n in kv], False),
+            ("inject", m.inject_program, m.inject_feed_sig(), [], True),
+        )
+        with profiler.RecordEvent("decode::warmup"):
+            for kind, prog, feed_sig, fetches, donate in plans:
+                entry, source = lowering.lower_step(
+                    prog, self._scope, feed_sig, fetches, donate=donate,
+                    label=f"decode:{m.label}:{kind}",
+                )
+                self.compile_sources[source] = (
+                    self.compile_sources.get(source, 0) + 1)
+                executable = entry.aot_compile(
+                    lowering.abstract_signature(entry, feed_sig,
+                                                self._scope))
+                self._entries[kind] = (entry, executable)
+
+    def _run(self, kind, feeds):
+        """Execute one lowered program against the entry scope; written
+        persistables (the arenas — donated, updated in place on device)
+        re-enter the scope for the next call."""
+        import jax
+
+        entry, executable = self._entries[kind]
+        dev = self._engine.device
+        feed_vals = tuple(
+            jax.device_put(np.ascontiguousarray(feeds[n]), dev)
+            for n in entry.feed_names
+        )
+        donated = tuple(self._scope.find_var(n) for n in entry.donated)
+        readonly = tuple(self._scope.find_var(n) for n in entry.readonly)
+        fetches, updates = executable(feed_vals, donated, readonly,
+                                      self._rng0)
+        for n, u in zip(entry.written, updates):
+            self._scope.set(n, u)
+        return fetches
+
+    def _reset_arenas(self):
+        """Zero the KV pool and drop all slot state (relaunch path: a
+        failed donated call leaves the old arena buffers invalid)."""
+        import jax
+        import jax.numpy as jnp
+
+        m = self._model
+        for kn, vn in m.state_names:
+            for n in (kn, vn):
+                self._scope.set(n, jax.device_put(
+                    jnp.zeros((m.slots, m.max_len, m.hidden), jnp.float32),
+                    self._engine.device))
+        self._pool.reset()
+        self._slots = [None] * m.slots
+
+    def relaunch(self):
+        """The circuit breaker's replacement replica: rebuild programs
+        from the model's builder (content-identical by construction),
+        re-lower — every entry should come from the compile cache, not a
+        trace — and reset the arena. Weights stay; queued requests are
+        served by the relaunched replica."""
+        if self._model.builder is not None:
+            self._model = self._model.builder()
+        self._lower_all()
+        self._reset_arenas()
+        self._metrics.incr("relaunches")
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._queue.reopen()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"decode-{self._model.label}",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self, timeout=60.0):
+        self._queue.close()
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def notify(self):
+        with self._cond:
+            self._cond.notify()
+
+    # -- scheduler loop ---------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                for r in self._queue.expire():
+                    self._reject_expired(r)
+                if (self._stop and self._queue.empty()
+                        and self._pool.active_count == 0):
+                    return
+            if self._breaker is not None and not self._stop:
+                verdict, wait_s = self._breaker.gate()
+                if verdict == "wait":
+                    with self._cond:
+                        for r in self._queue.expire():
+                            self._reject_expired(r)
+                        if not self._stop:
+                            self._cond.wait(timeout=min(wait_s, 0.1))
+                    continue
+                if verdict == "probe" and not self._probe_relaunched:
+                    # re-admission probe IS a relaunch: fresh programs,
+                    # zeroed arena, executables from the compile cache —
+                    # ONCE per half-open episode (the flag); the probe
+                    # STEP's outcome then closes or reopens the breaker,
+                    # so an idle engine doesn't rebuild every loop tick
+                    self._metrics.incr("breaker_probes")
+                    try:
+                        self.relaunch()
+                        self._probe_relaunched = True
+                    except Exception:
+                        self._breaker_event(self._breaker.record_failure())
+                        continue
+            admitted = self._admit_free_slots()
+            if self._pool.active_count == 0:
+                # nothing decodable AND this round admitted nothing —
+                # either the queue is empty, or everything queued is
+                # blocked on a tenant cap held by another entry's
+                # in-flight work; poll, don't spin
+                with self._cond:
+                    if not self._stop and not admitted:
+                        self._cond.wait(timeout=0.02)
+                continue
+            self._step()
+
+    def _reject_expired(self, request):
+        self._metrics.incr("deadline_missed")
+        self._engine._tenant_unqueue(request.tenant)
+        request.response._complete(error=DeadlineExceededError(
+            "deadline expired after "
+            f"{time.perf_counter() - request.submit_time:.3f}s in queue"))
+        self._metrics.observe_request(request)
+
+    def _breaker_event(self, event):
+        if event:
+            self._metrics.incr(event)
+
+    # -- admission (prefill + inject into a free slot) --------------------
+    def _admit_free_slots(self):
+        picked = []
+        with self._cond:
+            while self._pool.free_count - len(picked) > 0:
+                req = self._engine._pick(self._queue)
+                if req is None:
+                    break
+                picked.append(req)
+            # the round's picks are ONE drain event for the rate EWMA
+            self._queue.note_drained()
+        for req in picked:
+            self._engine._tenant_unqueue(req.tenant)
+            if req.expired():
+                # picked but dead: release the pick-time in-flight
+                # reservation; no slot to free
+                self._engine._tenant_unflight(req.tenant)
+                self._metrics.incr("deadline_missed")
+                req.response._complete(error=DeadlineExceededError(
+                    "deadline expired before prefill"))
+                self._metrics.observe_request(req)
+                continue
+            slot = self._pool.acquire()
+            try:
+                self._prefill_into(req, slot)
+            except _ArenaInvalidError as e:
+                # donated inject failed: like a step failure, every
+                # in-flight sequence is lost (failed loudly), the
+                # outcome drives the breaker, and the arena resets
+                self._slots[slot] = None
+                self._engine._tenant_unflight(req.tenant)
+                self._metrics.incr("failed")
+                req.response._complete(error=RequestError(
+                    f"request {req.id} failed in inject: {e}"))
+                self._metrics.observe_request(req)
+                self._metrics.incr("step_failures")
+                self._probe_relaunched = False
+                if self._breaker is not None:
+                    self._breaker_event(self._breaker.record_failure())
+                for s, st in enumerate(self._slots):
+                    if st is not None:
+                        self._reject_in_flight(st.request, RequestError(
+                            f"request {st.request.id} lost to arena "
+                            f"failure during admission: {e}"), slot=s)
+                self._reset_arenas()
+                # the reset arena is valid (zeroed): the REMAINING picked
+                # requests still admit — dropping them would abandon
+                # their futures and leak their tenants' queued counters
+            except Exception as e:  # request-attributed, not replica health
+                self._pool.release(slot)
+                self._slots[slot] = None
+                self._engine._tenant_unflight(req.tenant)
+                self._metrics.incr("failed")
+                req.response._complete(error=RequestError(
+                    f"request {req.id} failed in prefill: {e}"))
+                self._metrics.observe_request(req)
+        return len(picked)
+
+    def _prefill_into(self, req, slot):
+        m = self._model
+        req.dispatch_time = time.perf_counter()
+        prompt = req.prompt
+        key = prompt_key(prompt)
+        cached = self._prefix.get(key)
+        if cached is not None:
+            kv_rows, logits_row = cached
+            # hit/miss totals live on PrefixCache (one source, surfaced
+            # by stats()); only the per-tenant series is a counter here
+            self._metrics.tenant_incr("prefix_hits", req.tenant)
+        else:
+            t0 = time.perf_counter()
+            with profiler.RecordEvent("decode::prefill"):
+                faults.fire("decode.prefill")
+                fetches = self._run("prefill", self._prefill_feeds(prompt))
+            logits = np.asarray(fetches[0])          # [1, L, V]
+            kv_rows = [np.asarray(f) for f in fetches[1:]]
+            # copy: a view would pin the whole [1, L, V] prefill logits
+            # buffer in the prefix cache for the life of the entry
+            logits_row = np.array(logits[0, len(prompt) - 1])
+            self._prefix.put(key, kv_rows, logits_row)
+            self._metrics.observe_prefill(time.perf_counter() - t0)
+        inj = {DecodeModel.INJ_SLOT:
+               np.eye(m.slots, dtype="float32")[slot][:, None, None]}
+        for i, (kn, vn) in enumerate(m.inject_kv_feeds):
+            inj[kn] = kv_rows[2 * i]
+            inj[vn] = kv_rows[2 * i + 1]
+        try:
+            with profiler.RecordEvent("decode::inject"):
+                faults.fire("decode.inject")
+                self._run("inject", inj)
+        except Exception as e:
+            raise _ArenaInvalidError(str(e)) from e
+        first = int(np.argmax(logits_row))
+        self._slots[slot] = _Slot(req, len(prompt), first)
+        self._metrics.incr("admitted")
+        # the prefill's first token: counted apart from generated_tokens
+        # so tokens_per_step stays a decode-step quantity (<= S)
+        self._metrics.incr("prefill_tokens")
+        self._metrics.tenant_incr("admitted", req.tenant)
+        self._metrics.tenant_incr("tokens", req.tenant)
+        if self._finished(self._slots[slot]):
+            self._retire(slot)
+
+    def _prefill_feeds(self, prompt):
+        m = self._model
+        toks = np.zeros((1, m.max_len), "int64")
+        toks[0, :len(prompt)] = prompt
+        pos = np.arange(m.max_len, dtype="int64")[None]
+        bias = np.triu(np.full((m.max_len, m.max_len), NEG_INF, "float32"),
+                       k=1)[None]
+        return {DecodeModel.PRE_TOKENS: toks,
+                DecodeModel.PRE_POSITIONS: pos,
+                DecodeModel.PRE_BIAS: bias}
+
+    # -- the decode iteration ---------------------------------------------
+    def _step(self):
+        m = self._model
+        S, L = m.slots, m.max_len
+        tok = np.zeros((S, 1), "int64")
+        pos = np.zeros((S, 1), "int64")
+        bias = np.full((S, 1, L), NEG_INF, "float32")
+        write = np.zeros((S, L), "float32")
+        active = []
+        for s in range(S):
+            st = self._slots[s]
+            if st is None:
+                continue
+            active.append(s)
+            tok[s, 0] = st.last_token
+            pos[s, 0] = st.cursor
+            bias[s, 0, :st.cursor + 1] = 0.0
+            write[s, st.cursor] = 1.0
+        t0 = time.perf_counter()
+        try:
+            with profiler.RecordEvent("decode::step"):
+                faults.fire("decode.step")
+                fetches = self._run("step", {
+                    DecodeModel.DEC_TOKEN: tok, DecodeModel.DEC_POSITION: pos,
+                    DecodeModel.DEC_BIAS: bias, DecodeModel.DEC_WRITE: write,
+                })
+        except Exception as e:
+            # a failed donated call leaves the arena undefined: every
+            # in-flight sequence is lost (failed loudly), the batch-level
+            # outcome drives the breaker, and the arena resets
+            self._metrics.incr("step_failures")
+            self._probe_relaunched = False
+            if self._breaker is not None:
+                self._breaker_event(self._breaker.record_failure())
+            for s in list(active):
+                st = self._slots[s]
+                self._reject_in_flight(st.request, RequestError(
+                    f"request {st.request.id} lost to decode-step failure: "
+                    f"{e}"), slot=s)
+            self._reset_arenas()
+            return
+        if self._breaker is not None:
+            self._breaker_event(self._breaker.record_success())
+        logits = np.asarray(fetches[0])              # [S, 1, V]
+        now = time.perf_counter()
+        for s in active:
+            st = self._slots[s]
+            nxt = int(np.argmax(logits[s, 0]))
+            st.generated.append(nxt)
+            st.cursor += 1
+            st.last_token = nxt
+            self._metrics.tenant_incr("tokens", st.request.tenant)
+            # finished wins over expired: the device already paid for a
+            # COMPLETE generation, deliver it (the prefill fast path
+            # retires without an expiry check — same policy)
+            if self._finished(st):
+                self._retire(s)
+            elif st.request.expired(now):
+                self._reject_in_flight(st.request, DeadlineExceededError(
+                    "deadline expired mid-generation after "
+                    f"{len(st.generated)} tokens"), slot=s)
+        self._metrics.observe_step(len(active), len(active),
+                                   time.perf_counter() - t0)
+
+    def _finished(self, st):
+        m = self._model
+        return (len(st.generated) >= st.request.max_new
+                or (m.eos_id is not None and st.last_token == m.eos_id)
+                or st.cursor >= m.max_len)
+
+    def _retire(self, slot):
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._pool.release(slot)
+        req = st.request
+        self._engine._tenant_unflight(req.tenant)
+        req.response._complete(outputs={
+            "tokens": np.asarray(st.generated, dtype="int64"),
+        })
+        self._metrics.incr("completed")
+        self._metrics.incr("retired")
+        self._metrics.tenant_incr("completed", req.tenant)
+        self._metrics.observe_request(req)
+
+    def _reject_in_flight(self, req, error, slot=None):
+        if slot is not None:
+            self._slots[slot] = None
+            self._pool.release(slot)
+        self._engine._tenant_unflight(req.tenant)
+        self._metrics.incr(
+            "deadline_missed" if isinstance(error, DeadlineExceededError)
+            else "failed")
+        req.response._complete(error=error)
+        self._metrics.observe_request(req)
+
+    # -- reference path ----------------------------------------------------
+    def offline_decode(self, prompt, max_new):
+        """Offline whole-sequence reference: re-run the full causal
+        prefill forward per generated token (no KV cache, no slots) with
+        identical finish rules. The bit-exactness tests compare
+        continuous output against THIS."""
+        m = self._model
+        toks = list(prompt)
+        out = []
+        for _ in range(int(max_new)):
+            t = len(toks) - 1
+            fetches = self._run("prefill", self._prefill_feeds(toks))
+            nxt = int(np.argmax(np.asarray(fetches[0])[0, t]))
+            out.append(nxt)
+            toks.append(nxt)
+            if m.eos_id is not None and nxt == m.eos_id:
+                break
+            if len(toks) >= m.max_len:
+                break
+        return out
+
+    # -- observability ----------------------------------------------------
+    def stats(self):
+        m = self._model
+        return self._metrics.snapshot(extra={
+            **self._metrics.queue_snapshot(self._queue),
+            "model": m.name, "version": m.version,
+            "slots": m.slots, "max_len": m.max_len,
+            "active_slots": self._pool.active_count,
+            "occupancy": self._metrics.occupancy(m.slots),
+            "tokens_per_step": self._metrics.tokens_per_step(),
+            "arena_mib": m.arena_bytes() / 2**20,
+            "prefix_cache_entries": len(self._prefix),
+            "prefix_hits": self._prefix.hits,
+            "prefix_misses": self._prefix.misses,
+            "compile_sources": dict(self.compile_sources),
+            "breaker_state": (self._breaker.state if self._breaker
+                              else None),
+            "tenant_tokens": self._metrics.tenant_counts("tokens"),
+            "tenant_completed": self._metrics.tenant_counts("completed"),
+        })
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def prefix_cache(self):
+        return self._prefix
+
+
+class GenerationEngine:
+    """Multi-tenant front door over N hosted decode models."""
+
+    _SEQ = 0
+
+    def __init__(self, place=None, queue_depth=256, breaker_threshold=3,
+                 breaker_cooldown_s=1.0, prefix_cache_size=64,
+                 hbm_budget_mb=None, label=None):
+        import paddle_tpu as fluid
+
+        if place is None:
+            import jax
+
+            place = (fluid.TPUPlace(0) if jax.default_backend() == "tpu"
+                     else fluid.CPUPlace())
+        self.place = place
+        self.device = place.jax_device()
+        GenerationEngine._SEQ += 1
+        self.label = label or f"genengine-{GenerationEngine._SEQ}"
+        self._queue_depth = int(queue_depth)
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self._prefix_cache_size = prefix_cache_size
+        self._hbm_budget_mb = hbm_budget_mb
+        self._entries = {}        # (name, version) -> _ModelEntry
+        self._latest = {}         # name -> version (last registered)
+        self._tenants = {}        # tenant -> _TenantState
+        self._tenant_lock = threading.Lock()
+        self._vclock = 0.0        # engine-wide virtual time (last dispatch)
+        self._started = False
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+
+    # -- model registry ---------------------------------------------------
+    def register_model(self, model):
+        """Host one (model, version). Sizes the KV arena against the HBM
+        budget BEFORE any compile, then builds + warms the entry (from
+        the compile cache when one is populated). Returns the entry."""
+        if not isinstance(model, DecodeModel):
+            model = model()        # zero-arg builder
+        if model.key in self._entries:
+            raise ValueError(f"model {model.label} already registered")
+        self._check_hbm(model)
+        entry = _ModelEntry(
+            self, model, self._queue_depth, self._breaker_threshold,
+            self._breaker_cooldown_s, self._prefix_cache_size,
+        ).build()
+        self._entries[model.key] = entry
+        self._latest[model.name] = model.version
+        if self._started:
+            entry.start()
+        return entry
+
+    def _check_hbm(self, model):
+        """Static pre-compile gate: decode-step peak HBM (the arena is
+        persistable state, so it dominates) must fit the budget."""
+        if not self._hbm_budget_mb:
+            return
+        from paddle_tpu.analysis.memory import (
+            check_hbm_budget,
+            estimate_peak_hbm,
+        )
+        from paddle_tpu.utils.enforce import EnforceError
+
+        report = estimate_peak_hbm(
+            model.decode_program,
+            feed_shapes={n: s for n, s, _d in model.decode_feed_sig()},
+            fetch_names=[model.logits_fetch],
+        )
+        diags = check_hbm_budget(
+            report, self._hbm_budget_mb * 2**20, label=model.label)
+        if diags:
+            raise EnforceError(
+                "KV arena does not fit the HBM budget:\n  "
+                + "\n  ".join(d.message for d in diags))
+
+    def models(self):
+        return sorted(self._entries)
+
+    def entry(self, name=None, version=None):
+        return self._resolve(name, version)
+
+    def _resolve(self, name, version):
+        if name is None:
+            if len(self._entries) != 1:
+                raise RejectedError(
+                    f"engine hosts {len(self._entries)} models; submit "
+                    "must name one")
+            return next(iter(self._entries.values()))
+        name = str(name)
+        if version is None:
+            version = self._latest.get(name)
+        entry = self._entries.get((name, str(version)))
+        if entry is None:
+            raise RejectedError(
+                f"no model {name}@{version}; hosted: "
+                f"{['@'.join(k) for k in sorted(self._entries)]}")
+        return entry
+
+    # -- tenancy ----------------------------------------------------------
+    def set_tenant(self, tenant, weight=1.0, max_in_flight=None,
+                   max_queued=None):
+        """Configure one tenant: scheduling weight (stride share under
+        contention) and admission quotas. Unknown tenants default to
+        weight 1.0, no quotas."""
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        with self._tenant_lock:
+            st = self._tenants.get(str(tenant))
+            if st is None:
+                self._tenants[str(tenant)] = _TenantState(
+                    weight, max_in_flight, max_queued)
+            else:
+                st.weight = float(weight)
+                st.max_in_flight = max_in_flight
+                st.max_queued = max_queued
+
+    def _tenant(self, tenant):
+        st = self._tenants.get(tenant)
+        if st is None:
+            st = _TenantState()
+            self._tenants[tenant] = st
+        return st
+
+    def _tenant_unqueue(self, tenant):
+        with self._tenant_lock:
+            st = self._tenant(tenant)
+            st.queued = max(st.queued - 1, 0)
+
+    def _tenant_unflight(self, tenant):
+        with self._tenant_lock:
+            st = self._tenant(tenant)
+            st.in_flight = max(st.in_flight - 1, 0)
+
+    def _pick(self, queue):
+        """Weighted-fair pick (caller holds queue.lock): first non-empty
+        priority lane wins (strict priority), then the lane's queued
+        tenant with the smallest virtual time, skipping tenants at their
+        in-flight cap. The winner's FIRST queued request dispatches
+        (per-tenant FIFO) and the tenant pays 1/weight virtual time."""
+        with self._tenant_lock:
+            for lane in Priority.LANES:
+                requests = queue.lane(lane)
+                if not requests:
+                    continue
+                best = None
+                candidates = {}
+                for r in requests:
+                    if r.tenant in candidates:
+                        continue
+                    st = self._tenant(r.tenant)
+                    if (st.max_in_flight is not None
+                            and st.in_flight >= st.max_in_flight):
+                        continue
+                    candidates[r.tenant] = (st, r)
+                if not candidates:
+                    continue  # every queued tenant here is capped
+                for tenant, (st, r) in candidates.items():
+                    if best is None or st.vtime < best[0].vtime:
+                        best = (st, r)
+                st, req = best
+                # catch-up: a long-idle tenant wins its first contested
+                # pick (it IS behind) but then re-enters at the engine's
+                # virtual clock instead of burning banked lag into a
+                # starvation burst
+                base = max(st.vtime, self._vclock)
+                st.vtime = base + 1.0 / st.weight
+                self._vclock = base
+                # in-flight is RESERVED at pick time: a multi-slot
+                # admission round calls _pick repeatedly before any
+                # prefill runs, so charging later would let one round
+                # blow through max_in_flight
+                st.in_flight += 1
+                queue.remove([req], batch=True)
+                return req
+        return None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        for entry in self._entries.values():
+            entry.start()
+        return self
+
+    def shutdown(self, timeout=60.0):
+        """Graceful drain: stop admitting; queued + in-flight sequences
+        finish generating before the loops exit."""
+        for entry in self._entries.values():
+            entry.shutdown(timeout)
+        self._started = False
+
+    drain = shutdown
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- admission --------------------------------------------------------
+    def submit(self, prompt_ids, model=None, version=None, tenant="default",
+               priority=Priority.NORMAL, max_new_tokens=16,
+               deadline_ms=None):
+        """Admit one generation request; returns its Response future
+        (``result()`` -> ``{"tokens": int64 array}``). Raises structured
+        RejectedError on invalid prompts, over-quota tenants, or a full
+        queue (with a measured retry-after)."""
+        entry = self._resolve(model, version)
+        m = entry.model
+        tenant = str(tenant)
+        entry.metrics.incr("submitted")
+        entry.metrics.tenant_incr("submitted", tenant)
+        self._validate(m, prompt_ids, max_new_tokens, priority, entry)
+        with self._tenant_lock:
+            st = self._tenant(tenant)
+            over_quota = (st.max_queued is not None
+                          and st.queued >= st.max_queued)
+            quota = (st.queued, st.max_queued)
+            if not over_quota:
+                st.queued += 1
+        if over_quota:
+            # the queue lock is taken OUTSIDE _tenant_lock here: the
+            # scheduler thread acquires them in queue-then-tenant order
+            # (_admit_free_slots -> _pick), so estimating retry-after
+            # while still holding _tenant_lock would be an ABBA deadlock
+            entry.metrics.incr("rejected")
+            entry.metrics.incr("rejected_quota")
+            entry.metrics.tenant_incr("rejected", tenant)
+            raise RejectedError(
+                f"tenant '{tenant}' is at its admission quota "
+                f"({quota[0]}/{quota[1]} queued)",
+                retry_after_s=entry._queue.retry_after_estimate(1),
+            )
+        deadline = (time.perf_counter() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        req = GenerationRequest(rid, prompt_ids, max_new_tokens, tenant,
+                                priority, deadline)
+        try:
+            with entry._cond:
+                entry._queue.put(req)
+                entry._cond.notify()
+        except RejectedError:
+            self._tenant_unqueue(tenant)
+            entry.metrics.incr("rejected")
+            entry.metrics.incr("rejected_shutdown" if entry._queue.closed()
+                               else "rejected_queue_full")
+            entry.metrics.tenant_incr("rejected", tenant)
+            raise
+        return req.response
+
+    def _validate(self, m, prompt_ids, max_new, priority, entry):
+        def bad(msg):
+            entry.metrics.incr("rejected")
+            entry.metrics.incr("rejected_invalid")
+            raise RejectedError(msg)
+
+        try:
+            prompt = [int(t) for t in prompt_ids]
+        except (TypeError, ValueError):
+            bad("prompt_ids must be a sequence of token ids")
+        if priority not in Priority.LANES:
+            bad(f"unknown priority {priority!r}")
+        if not prompt:
+            bad("empty prompt")
+        if any(t < 0 or t >= m.vocab_size for t in prompt):
+            bad(f"prompt token out of range [0, {m.vocab_size})")
+        if int(max_new) < 1:
+            bad(f"max_new_tokens must be >= 1, got {max_new}")
+        if len(prompt) + int(max_new) > m.max_len:
+            bad(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the KV arena length {m.max_len}; shorten the "
+                "request or host the model with a longer max_len")
+
+    # -- observability ----------------------------------------------------
+    def stats(self):
+        per_model = {e.model.label: e.stats()
+                     for e in self._entries.values()}
+        with self._tenant_lock:
+            tenants = {
+                t: {"weight": st.weight, "in_flight": st.in_flight,
+                    "queued": st.queued,
+                    "max_in_flight": st.max_in_flight,
+                    "max_queued": st.max_queued}
+                for t, st in self._tenants.items()
+            }
+        return {
+            "models": per_model,
+            "tenants": tenants,
+            "hosted": ["@".join(k) for k in sorted(self._entries)],
+        }

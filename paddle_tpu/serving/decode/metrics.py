@@ -1,0 +1,75 @@
+"""Decode-engine metrics: the serving counter set + iteration-level series.
+
+Extends ServingMetrics (same engine-label discipline, same registry /
+profiler mirroring, same per-tenant counters) with the quantities that
+only exist under iteration-level scheduling: decode steps, active
+slot-steps (the occupancy numerator), generated tokens, prefill runs vs
+prefix-cache hits, retirements, and step/prefill latency histograms.
+``occupancy()`` is the headline number: mean fraction of the S-slot batch
+doing real work per iteration — what continuous batching buys over
+request-at-a-time bucketing.
+"""
+
+from paddle_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["DecodeMetrics"]
+
+
+class DecodeMetrics(ServingMetrics):
+    COUNTERS = ServingMetrics.COUNTERS + (
+        # iteration-level scheduler ("generated_tokens" counts tokens a
+        # decode STEP produced; each admission's prefill-derived first
+        # token is "prefill_tokens" — delivered total is their sum)
+        "decode_steps", "active_slot_steps", "generated_tokens",
+        "prefill_tokens", "retired", "step_failures",
+        # admission / KV pool (prefix hit/miss totals live on
+        # PrefixCache itself — stats() reports them from that one
+        # source; only the per-tenant prefix_hits series is a counter)
+        "prefills", "rejected_quota",
+        # circuit breaker relaunch (AOT-warmed replacement replicas)
+        "relaunches",
+    )
+
+    def __init__(self, engine_label=None, registry=None):
+        super().__init__(engine_label=engine_label, registry=registry)
+        labels = {"engine": self.engine_label}
+        self._step = self._registry.histogram(
+            "serving_decode_step_seconds",
+            "one decode iteration (all slots)", labels=labels,
+        )
+        self._prefill = self._registry.histogram(
+            "serving_prefill_seconds",
+            "prompt prefill forward latency", labels=labels,
+        )
+        for h in (self._step, self._prefill):
+            h.reset()
+
+    def observe_step(self, active_slots, new_tokens, seconds):
+        self.incr("decode_steps")
+        self.incr("active_slot_steps", active_slots)
+        self.incr("generated_tokens", new_tokens)
+        self._step.observe(seconds)
+
+    def observe_prefill(self, seconds):
+        self.incr("prefills")
+        self._prefill.observe(seconds)
+
+    def occupancy(self, slots):
+        steps = self.count("decode_steps")
+        if steps <= 0:
+            return 0.0
+        return self.count("active_slot_steps") / float(steps * slots)
+
+    def tokens_per_step(self):
+        steps = self.count("decode_steps")
+        if steps <= 0:
+            return 0.0
+        return self.count("generated_tokens") / float(steps)
+
+    def snapshot(self, extra=None):
+        out = super().snapshot(extra=None)
+        out.update(self._step.snapshot("decode_step"))
+        out.update(self._prefill.snapshot("prefill"))
+        if extra:
+            out.update(extra)
+        return out

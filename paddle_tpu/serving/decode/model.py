@@ -1,0 +1,280 @@
+"""DecodeModel: the three-program contract of the continuous-batching engine.
+
+A generation model is served through THREE fixed-shape programs that share
+one scope (weights by name) and one slotted KV arena:
+
+* **decode step** — the per-iteration hot path. ONE static shape: token
+  ``[S, 1]`` + position ``[S, 1]`` + attention bias ``[S, 1, L]`` + write
+  one-hot ``[S, L]``, against per-layer K/V arenas ``[S, L, H]`` held as
+  persistable state. The arena update composes multiply/add (see
+  ``layers.kv_cache_write``), so a slot whose write row is all-zero is
+  bit-untouched — retired slots are invisible, admitted slots join
+  mid-flight, and the compiled executable never sees the batch change.
+* **prefill** — whole-prompt forward at ``[1, L]`` with a causal additive
+  bias, fetching per-layer K/V rows ``[1, L, H]`` and logits ``[1, L, V]``.
+  Stateless (donation off): its outputs are host-cacheable, which is what
+  makes shared-prefix dedup by content hash possible.
+* **inject** — writes prefill K/V rows into one slot of the arena by slot
+  one-hot ``[S, 1, 1]`` (broadcast multiply/add, same exactness argument).
+
+All three shapes are static, so a warmed engine holds exactly three
+executables and can never retrace. Every parameter, feed, and arena var
+name is derived from the ``(name, version)`` prefix — content-identical
+rebuilds (circuit-breaker relaunch, a cold replica) re-derive identical
+programs and hit the compile cache instead of recompiling.
+
+``build_decoder_model`` is the canonical builder: a small pre-norm-free
+residual transformer decoder (token+position embedding, per-layer
+attention + FFN, logits head). Custom architectures follow the same feed/
+fetch contract and plug into the same engine.
+"""
+
+import numpy as np
+
+__all__ = ["DecodeModel", "build_decoder_model"]
+
+# additive-mask value: exp(-1e9) underflows to exactly 0.0 (the repo-wide
+# padding contract), so masked cache positions are bit-invisible
+NEG_INF = -1e9
+
+
+class DecodeModel:
+    """The three programs + their naming contract and geometry.
+
+    ``state_names`` lists per-layer ``(k_arena, v_arena)`` var names;
+    ``prefill_kv_fetches`` the matching per-layer ``(k_rows, v_rows)``
+    fetch names of the prefill program. ``builder`` (optional) is a
+    zero-arg callable that re-creates a content-identical DecodeModel —
+    the circuit breaker's relaunch path uses it to rebuild a replica that
+    warms entirely from the compile cache."""
+
+    # feed-name contract (fixed; the engine builds these arrays)
+    DEC_TOKEN = "dec_token"
+    DEC_POSITION = "dec_position"
+    DEC_BIAS = "dec_bias"
+    DEC_WRITE = "dec_write"
+    PRE_TOKENS = "pre_tokens"
+    PRE_POSITIONS = "pre_positions"
+    PRE_BIAS = "pre_bias"
+    INJ_SLOT = "inj_slot"
+
+    def __init__(self, *, decode_program, prefill_program, inject_program,
+                 startup_program, slots, max_len, vocab_size, hidden,
+                 state_names, logits_fetch, prefill_logits_fetch,
+                 prefill_kv_fetches, inject_kv_feeds, eos_id=None,
+                 name="model", version="1", builder=None):
+        self.decode_program = decode_program
+        self.prefill_program = prefill_program
+        self.inject_program = inject_program
+        self.startup_program = startup_program
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.state_names = list(state_names)
+        self.logits_fetch = logits_fetch
+        self.prefill_logits_fetch = prefill_logits_fetch
+        self.prefill_kv_fetches = list(prefill_kv_fetches)
+        self.inject_kv_feeds = list(inject_kv_feeds)
+        self.eos_id = eos_id
+        self.name = str(name)
+        self.version = str(version)
+        self.builder = builder
+
+    @property
+    def key(self):
+        return (self.name, self.version)
+
+    @property
+    def label(self):
+        return f"{self.name}@{self.version}"
+
+    def arena_bytes(self):
+        """Exact bytes of the slotted KV pool: 2 arenas x layers x
+        ``[S, L, H]`` float32 — what `analysis/memory.py` sees as
+        persistent state and what the HBM budget gate reasons about."""
+        per = self.slots * self.max_len * self.hidden * 4
+        return per * 2 * len(self.state_names)
+
+    # -- feed signatures (ordered like each program's feed list) ---------
+    def decode_feed_sig(self):
+        s, l = self.slots, self.max_len
+        return (
+            (self.DEC_TOKEN, (s, 1), "int64"),
+            (self.DEC_POSITION, (s, 1), "int64"),
+            (self.DEC_BIAS, (s, 1, l), "float32"),
+            (self.DEC_WRITE, (s, l), "float32"),
+        )
+
+    def prefill_feed_sig(self):
+        l = self.max_len
+        return (
+            (self.PRE_TOKENS, (1, l), "int64"),
+            (self.PRE_POSITIONS, (1, l), "int64"),
+            (self.PRE_BIAS, (1, l, l), "float32"),
+        )
+
+    def inject_feed_sig(self):
+        s, l, h = self.slots, self.max_len, self.hidden
+        sig = [(self.INJ_SLOT, (s, 1, 1), "float32")]
+        for kn, vn in self.inject_kv_feeds:
+            sig.append((kn, (1, l, h), "float32"))
+            sig.append((vn, (1, l, h), "float32"))
+        return tuple(sig)
+
+
+def _state_var(main_program, startup_program, name, shape):
+    """A persistable float32 state var declared in ``main_program`` and
+    zero-initialized ONCE in the shared startup (create_global_var would
+    append a duplicate fill per program that declares the arena)."""
+    mblock = main_program.global_block()
+    var = mblock.vars.get(name)
+    if var is None:
+        var = mblock.create_var(name=name, shape=list(shape),
+                                dtype="float32", persistable=True)
+        var.stop_gradient = True
+    sblock = startup_program.global_block()
+    if name not in sblock.vars:
+        sblock.create_var(name=name, shape=list(shape), dtype="float32",
+                          persistable=True)
+        sblock.append_op(
+            "fill_constant", {}, {"Out": [name]},
+            {"shape": list(shape), "dtype": "float32", "value": 0.0},
+        )
+    return var
+
+
+def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
+                        slots=4, max_len=32, eos_id=None, name="decoder",
+                        version="1"):
+    """Build the canonical cached-attention decoder as a DecodeModel.
+
+    Residual transformer decoder: token+position embeddings, per layer
+    (q/k/v projection -> cached attention -> output projection ->
+    residual -> relu FFN -> residual), logits head. Offline/prefill and
+    decode paths share every weight by explicit name, which is both the
+    bit-exactness contract (one set of parameters, two access patterns)
+    and the relaunch contract (rebuilding produces byte-identical
+    programs, so the compile cache, not XLA, pays for the restart)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.ir import Program, program_guard
+    from paddle_tpu.utils import unique_name
+
+    V, H, S, L = int(vocab_size), int(hidden), int(slots), int(max_len)
+    NL = int(num_layers)
+    FFN = int(ffn_dim) if ffn_dim else 4 * H
+    if L < 2:
+        raise ValueError(f"max_len {L} leaves no room to generate")
+    prefix = f"{name}_v{version}"
+
+    def attr(suffix):
+        return fluid.ParamAttr(name=f"{prefix}.{suffix}")
+
+    def proj(h, size, suffix, act=None):
+        return fluid.layers.fc(
+            h, size, num_flatten_dims=2, act=act,
+            param_attr=attr(suffix + ".w"), bias_attr=attr(suffix + ".b"),
+        )
+
+    def embed(toks, pos):
+        te = fluid.layers.embedding(toks, size=(V, H),
+                                    param_attr=attr("tok_emb"))
+        pe = fluid.layers.embedding(pos, size=(L, H),
+                                    param_attr=attr("pos_emb"))
+        return fluid.layers.elementwise_add(te, pe)
+
+    def ffn_block(h, i):
+        ff = proj(h, FFN, f"l{i}.ffn1", act="relu")
+        return fluid.layers.elementwise_add(h, proj(ff, H, f"l{i}.ffn2"))
+
+    sm_scale = 1.0 / float(np.sqrt(H))
+    state_names = [(f"{prefix}.kcache{i}", f"{prefix}.vcache{i}")
+                   for i in range(NL)]
+    startup = Program()
+
+    # -- prefill: whole-prompt causal forward at [1, L] ------------------
+    prefill = Program()
+    kv_fetches = []
+    # unique_name.guard(): auto-named temp vars restart per program, so a
+    # rebuild ANYWHERE in a process (the breaker's relaunch, a second
+    # engine) is textually identical and hits the compile cache instead
+    # of retracing
+    with unique_name.guard(), program_guard(prefill, startup):
+        toks = fluid.data(DecodeModel.PRE_TOKENS, [1, L], dtype="int64")
+        pos = fluid.data(DecodeModel.PRE_POSITIONS, [1, L], dtype="int64")
+        bias = fluid.data(DecodeModel.PRE_BIAS, [1, L, L], dtype="float32")
+        h = embed(toks, pos)
+        for i in range(NL):
+            q = proj(h, H, f"l{i}.q")
+            k = proj(h, H, f"l{i}.k")
+            v = proj(h, H, f"l{i}.v")
+            scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                         alpha=sm_scale)
+            att = fluid.layers.softmax(
+                fluid.layers.elementwise_add(scores, bias), axis=-1)
+            ctx = fluid.layers.matmul(att, v)
+            h = fluid.layers.elementwise_add(h, proj(ctx, H, f"l{i}.out"))
+            h = ffn_block(h, i)
+            kv_fetches.append((k.name, v.name))
+        pre_logits = proj(h, V, "head")
+
+    # -- decode step: one token per slot at [S, 1] -----------------------
+    decode = Program()
+    with unique_name.guard(), program_guard(decode, startup):
+        tok = fluid.data(DecodeModel.DEC_TOKEN, [S, 1], dtype="int64")
+        pos = fluid.data(DecodeModel.DEC_POSITION, [S, 1], dtype="int64")
+        bias = fluid.data(DecodeModel.DEC_BIAS, [S, 1, L], dtype="float32")
+        write = fluid.data(DecodeModel.DEC_WRITE, [S, L], dtype="float32")
+        h = embed(tok, pos)
+        for i in range(NL):
+            kc = _state_var(decode, startup, state_names[i][0], [S, L, H])
+            vc = _state_var(decode, startup, state_names[i][1], [S, L, H])
+            q = proj(h, H, f"l{i}.q")
+            k = proj(h, H, f"l{i}.k")
+            v = proj(h, H, f"l{i}.v")
+            nk = fluid.layers.kv_cache_write(
+                kc, fluid.layers.squeeze(k, [1]), write)
+            nv = fluid.layers.kv_cache_write(
+                vc, fluid.layers.squeeze(v, [1]), write)
+            # persist: the lowering donates the arenas, so this is an
+            # in-place device update, not a copy
+            fluid.layers.assign(nk, output=kc)
+            fluid.layers.assign(nv, output=vc)
+            ctx = fluid.layers.cached_attention(
+                fluid.layers.squeeze(q, [1]), nk, nv, bias,
+                sm_scale=sm_scale)
+            ctx = fluid.layers.unsqueeze(ctx, [1])
+            h = fluid.layers.elementwise_add(h, proj(ctx, H, f"l{i}.out"))
+            h = ffn_block(h, i)
+        dec_logits = proj(h, V, "head")
+
+    # -- inject: write prefill rows into one arena slot ------------------
+    inject = Program()
+    inj_feeds = []
+    with unique_name.guard(), program_guard(inject, startup):
+        slot = fluid.data(DecodeModel.INJ_SLOT, [S, 1, 1], dtype="float32")
+        for i in range(NL):
+            kc = _state_var(inject, startup, state_names[i][0], [S, L, H])
+            vc = _state_var(inject, startup, state_names[i][1], [S, L, H])
+            kn, vn = f"inj_k{i}", f"inj_v{i}"
+            rk = fluid.data(kn, [1, L, H], dtype="float32")
+            rv = fluid.data(vn, [1, L, H], dtype="float32")
+            nk = fluid.layers.masked_write(kc, rk, slot)
+            nv = fluid.layers.masked_write(vc, rv, slot)
+            fluid.layers.assign(nk, output=kc)
+            fluid.layers.assign(nv, output=vc)
+            inj_feeds.append((kn, vn))
+
+    kwargs = dict(vocab_size=V, hidden=H, num_layers=NL, ffn_dim=FFN,
+                  slots=S, max_len=L, eos_id=eos_id, name=name,
+                  version=version)
+    return DecodeModel(
+        decode_program=decode, prefill_program=prefill,
+        inject_program=inject, startup_program=startup,
+        slots=S, max_len=L, vocab_size=V, hidden=H,
+        state_names=state_names, logits_fetch=dec_logits.name,
+        prefill_logits_fetch=pre_logits.name,
+        prefill_kv_fetches=kv_fetches, inject_kv_feeds=inj_feeds,
+        eos_id=eos_id, name=name, version=version,
+        builder=lambda: build_decoder_model(**kwargs),
+    )

@@ -1,0 +1,115 @@
+"""Slotted KV-cache pool bookkeeping: slot allocator + prefix-reuse cache.
+
+The device side of the pool is the pre-allocated ``[S, L, H]`` arenas
+inside the decode/inject programs (model.py); this module is the host
+side: which slot is free, where each live slot's write cursor is, and a
+content-hash cache of prefill results so two requests with the same
+prompt pay for ONE prefill forward.
+
+The prefix cache stores host copies of the prefill program's outputs
+(per-layer K/V rows + the first-token logits row). Reuse is exact by
+construction: the inject program writes the SAME bytes into the arena
+whether they came from a fresh prefill or the cache, so a prefix hit
+cannot perturb generation — asserted by the dedup test in
+tests/test_decode.py.
+"""
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["SlotPool", "PrefixCache", "prompt_key"]
+
+
+def prompt_key(prompt_ids):
+    """Content hash of a prompt (the shared-prefix dedup key)."""
+    arr = np.ascontiguousarray(np.asarray(prompt_ids, dtype=np.int64))
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+class SlotPool:
+    """Fixed-capacity slot allocator. Slots are just indices into the
+    arena's leading axis; state per slot lives with the scheduler. Not
+    thread-safe by itself — the scheduler owns it from one loop thread."""
+
+    def __init__(self, slots):
+        self.slots = int(slots)
+        self._free = list(range(self.slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._active = set()
+
+    def acquire(self):
+        """Lowest free slot index, or None when the batch is full."""
+        if not self._free:
+            return None
+        s = self._free.pop()
+        self._active.add(s)
+        return s
+
+    def release(self, slot):
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        self._active.discard(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    def active(self):
+        return sorted(self._active)
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def active_count(self):
+        return len(self._active)
+
+    def reset(self):
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._active.clear()
+
+
+class PrefixCache:
+    """Bounded LRU of prefill results keyed by prompt content hash.
+
+    Values are host numpy tuples ``(kv_rows, logits_row)`` where
+    ``kv_rows`` is the per-layer ``[1, L, H]`` K/V list and
+    ``logits_row`` the ``[V]`` logits at the prompt's last position.
+    Thread-safe (submissions from many clients race admission)."""
+
+    def __init__(self, capacity=64):
+        self.capacity = int(capacity)
+        self._map = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key):
+        with self._lock:
+            val = self._map.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self._map.move_to_end(key)
+            self.hits += 1
+            return val
+
+    def put(self, key, kv_rows, logits_row):
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._map[key] = (
+                [np.asarray(r) for r in kv_rows], np.asarray(logits_row),
+            )
+            self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._map)
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()

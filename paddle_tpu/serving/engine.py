@@ -289,8 +289,10 @@ class ServingEngine:
         return norm
 
     def _drain_estimate(self):
-        """Backpressure hint: time for the current queue to drain at the
-        observed batch rate (bounded; 50ms default before any data).
+        """Caller-side backpressure floor: time for the current queue to
+        drain at the observed batch rate (bounded; 50ms default before
+        any data). The queue combines this with its own measured
+        drain-rate estimate and reports the larger of the two.
         O(1) — it runs on every submit under the queue lock."""
         per_batch = self._metrics.run_avg_s() or 0.05
         batches = (self._queue.depth() / float(self._lattice.max_rows)
@@ -406,7 +408,7 @@ class ServingEngine:
         misses = cs["misses"] - self._warm_base["misses"]
         breakers = [b.state for b in self._breakers if b is not None]
         return self._metrics.snapshot(extra={
-            "queue_depth": self._queue.depth(),
+            **self._metrics.queue_snapshot(self._queue),
             "num_replicas": len(self._replicas),
             "breaker_states": breakers,
             "breaker_open_replicas": sum(

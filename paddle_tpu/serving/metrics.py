@@ -14,6 +14,12 @@ Latency percentiles come from bucketed histograms (p50/p95/p99 by
 linear interpolation inside the target bucket) — O(buckets) memory at
 any traffic level, where the old ring-buffer reservoir held 4096
 samples per series.
+
+Per-lane queue-depth gauges (`serving_queue_lane_depth{engine,lane}`)
+and per-tenant counters (`serving_tenant_<name>_total{engine,tenant}`)
+ride the same engine label set: a scrape shows which priority lane is
+backed up and which tenant is consuming the capacity, and the same
+numbers flow through `stats()` into the C-ABI stats JSON.
 """
 
 import itertools
@@ -21,10 +27,14 @@ import threading
 
 from paddle_tpu import profiler
 from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.serving.request import Priority
 
 __all__ = ["ServingMetrics"]
 
 _ENGINE_SEQ = itertools.count()
+
+LANE_NAMES = {Priority.HIGH: "high", Priority.NORMAL: "normal",
+              Priority.LOW: "low"}
 
 
 class ServingMetrics:
@@ -66,6 +76,16 @@ class ServingMetrics:
             "serving_batch_occupancy_sum",
             "sum of per-batch row occupancy", labels=labels,
         )
+        self._lane_depth = {
+            lane: self._registry.gauge(
+                "serving_queue_lane_depth",
+                "queued rows per priority lane",
+                labels={**labels, "lane": name},
+            )
+            for lane, name in LANE_NAMES.items()
+        }
+        self._tenant_counts = {}  # (counter_name, tenant) -> Counter
+        self._tenant_lock = threading.Lock()
         # batches/batched_rows/occupancy must move together for the
         # derived averages in snapshot() to be consistent
         self._batch_lock = threading.Lock()
@@ -75,12 +95,64 @@ class ServingMetrics:
         # the previous engine's totals)
         for series in list(self._counts.values()) + [
             self._queue_wait, self._run, self._total, self._occupancy_sum,
-        ]:
+        ] + list(self._lane_depth.values()):
             series.reset()
 
     def incr(self, name, n=1):
         self._counts[name].inc(n)
         profiler.incr_counter(f"serving.{name}", n)
+
+    def tenant_incr(self, name, tenant, n=1):
+        """Per-tenant counter `serving_tenant_<name>_total{engine,tenant}`
+        (get-or-create per label set; tenants are few and long-lived)."""
+        key = (name, tenant)
+        c = self._tenant_counts.get(key)
+        if c is None:
+            with self._tenant_lock:
+                c = self._tenant_counts.get(key)
+                if c is None:
+                    c = self._registry.counter(
+                        f"serving_tenant_{name}_total",
+                        f"per-tenant serving {name} count",
+                        labels={"engine": self.engine_label,
+                                "tenant": str(tenant)},
+                    )
+                    c.reset()
+                    self._tenant_counts[key] = c
+        c.inc(n)
+
+    def tenant_counts(self, name):
+        """{tenant: count} snapshot for one per-tenant counter family."""
+        with self._tenant_lock:  # tenant_incr inserts concurrently
+            items = list(self._tenant_counts.items())
+        return {t: c.value for (n, t), c in items if n == name}
+
+    def set_lane_depths(self, depths):
+        """Update the per-lane queue-depth gauges from
+        `RequestQueue.lane_depths()`."""
+        for lane, rows in depths.items():
+            g = self._lane_depth.get(lane)
+            if g is not None:
+                g.set(rows)
+
+    def queue_snapshot(self, queue):
+        """ONE consistent `queue.stats()` read shaped into the shared
+        `stats()` extra keys (depth and lane depths from the same lock
+        acquisition), updating the per-lane gauges on the way — the
+        single definition both engines' stats() methods use."""
+        qs = queue.stats()
+        lane_depths = qs.pop("lane_depths")
+        self.set_lane_depths(lane_depths)
+        return {
+            "queue_depth": qs["depth"],
+            "queue_lane_depths": {
+                name: lane_depths.get(lane, 0)
+                for lane, name in LANE_NAMES.items()
+            },
+            "queue_drain_rate_rows_per_s": qs["drain_rate_rows_per_s"],
+            "queue_rejected_at_admission": qs["rejected_at_admission"],
+            "queue_expired_in_queue": qs["expired_in_queue"],
+        }
 
     def observe_batch(self, plan, run_seconds):
         with self._batch_lock:

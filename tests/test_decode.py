@@ -1,0 +1,689 @@
+"""Continuous-batching decode engine (paddle_tpu/serving/decode).
+
+The acceptance contract (ISSUE 10): generation through the iteration-
+level scheduler is bit-identical to offline whole-sequence decode for
+the same prompts REGARDLESS of admission order, slot assignment, or
+what the other slots are doing; a killed replica is re-admitted by the
+circuit breaker as an AOT-warmed replacement with zero recompiles; and
+a fresh process restores all three executables (decode step / prefill /
+inject) from the compile-cache disk tier with zero traces —
+subprocess-asserted like tests/test_compile_cache.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving.decode import (
+    GenerationEngine,
+    GenerationRequest,
+    build_decoder_model,
+)
+from paddle_tpu.serving.queue import RequestQueue
+from paddle_tpu.serving.request import (
+    DeadlineExceededError,
+    Priority,
+    RejectedError,
+    RequestError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "decode_worker.py")
+
+
+def _small_model(name="dec", version="1", slots=4, max_len=16, eos_id=None):
+    return build_decoder_model(
+        vocab_size=32, hidden=8, num_layers=2, slots=slots,
+        max_len=max_len, eos_id=eos_id, name=name, version=version,
+    )
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One warm engine + entry shared by the read-mostly tests."""
+    engine = GenerationEngine(queue_depth=64, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="shared", slots=4, max_len=16))
+    engine.start()
+    yield engine, entry
+    engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: continuous == offline under arbitrary interleavings
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_decode_matches_offline_any_admission_order(served):
+    """10 mixed-length prompts, submitted in shuffled orders with jittered
+    arrivals and mixed priorities over a 4-slot batch: every request's
+    tokens equal the offline whole-sequence reference, although slot
+    assignment and batchmates differ per round (retirement order
+    permutes the free-slot list between rounds)."""
+    engine, entry = served
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(0, 32, size=rng.randint(1, 7)))
+               for _ in range(10)]
+    max_news = [int(rng.randint(1, 9)) for _ in range(10)]
+    refs = [entry.offline_decode(p, n) for p, n in zip(prompts, max_news)]
+
+    for round_seed in (0, 1):
+        order = np.random.RandomState(round_seed).permutation(10)
+        resps = {}
+        for i in order:
+            resps[int(i)] = engine.submit(
+                prompts[i], max_new_tokens=max_news[i],
+                priority=int(i) % 3,
+            )
+            if int(i) % 3 == 0:
+                time.sleep(0.002)  # stagger arrivals across iterations
+        for i, r in resps.items():
+            got = [int(t) for t in r.result(timeout=120)["tokens"]]
+            assert got == refs[i], (
+                f"round {round_seed} prompt {i}: continuous {got} != "
+                f"offline {refs[i]}")
+
+
+def test_eos_and_arena_edge_finish_rules_match_offline():
+    """eos stop and prompt-fills-arena edge both fire identically in the
+    continuous and offline paths (the finish rules are the contract,
+    not an implementation detail). The eos token is probed from what the
+    greedy head ACTUALLY generates (eos_id is host-side policy, so the
+    probe model and the served model share byte-identical programs and
+    weights under the same (name, version) prefix)."""
+    prompt = [1, 2, 3]
+    probe = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    free_run = probe.register_model(
+        lambda: _small_model(name="eos", slots=2, max_len=10)
+    ).offline_decode(prompt, 6)
+    assert len(free_run) == 6  # nothing stops it without an eos rule
+    # first token whose first occurrence is mid-stream: stopping on it is
+    # observable (shorter than the free run) and unambiguous (index 0 of
+    # that token IS the stop point)
+    eos_at = next((j for j in range(1, len(free_run) - 1)
+                   if free_run[j] not in free_run[:j]), 0)
+    eos_id = free_run[eos_at]
+
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="eos", slots=2, max_len=10, eos_id=eos_id))
+    engine.start()
+    try:
+        want = entry.offline_decode(prompt, 6)
+        assert want == free_run[:eos_at + 1]  # stopped early, ON the eos
+        got = [int(t) for t in engine.submit(
+            prompt, max_new_tokens=6).result(timeout=120)["tokens"]]
+        assert got == want and got[-1] == eos_id
+        # arena edge: prompt + max_new fills the KV arena exactly
+        edge = [4, 5, 6, 7]
+        assert engine.submit(edge, max_new_tokens=6).result(
+            timeout=120)["tokens"].shape[0] <= 6
+        assert [int(t) for t in engine.submit(edge, max_new_tokens=6)
+                .result(timeout=120)["tokens"]] == entry.offline_decode(edge, 6)
+    finally:
+        engine.shutdown()
+
+
+def test_prefix_cache_dedups_prefill_bit_exactly(served):
+    """Two requests with the same prompt pay ONE prefill forward; the
+    cache-hit admission generates the same tokens as the miss."""
+    engine, entry = served
+    prompt = [9, 9, 8, 7]
+    hits0 = entry.prefix_cache.hits
+    prefills0 = entry.metrics.count("prefills")
+    r1 = engine.submit(prompt, max_new_tokens=5)
+    out1 = [int(t) for t in r1.result(timeout=120)["tokens"]]
+    r2 = engine.submit(prompt, max_new_tokens=5)
+    out2 = [int(t) for t in r2.result(timeout=120)["tokens"]]
+    assert out1 == out2 == entry.offline_decode(prompt, 5)
+    assert entry.prefix_cache.hits >= hits0 + 1
+    assert entry.metrics.count("prefills") == prefills0 + 1
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant registry + weighted-fair scheduling
+# ---------------------------------------------------------------------------
+
+
+def _queued(queue, rid, tenant, priority=Priority.NORMAL):
+    req = GenerationRequest(rid, [1], 4, tenant, priority, None)
+    queue.put(req)
+    return req
+
+
+def test_weighted_fair_pick_honors_stride_shares():
+    """Under contention, a weight-2 tenant wins two slots for every one a
+    weight-1 tenant wins (deterministic stride scheduling on the picker,
+    no engine threads involved)."""
+    engine = GenerationEngine(breaker_threshold=0)
+    engine.set_tenant("a", weight=2.0)
+    engine.set_tenant("b", weight=1.0)
+    queue = RequestQueue(max_depth=256)
+    for i in range(60):
+        _queued(queue, i, "a" if i % 2 == 0 else "b")
+    wins = {"a": 0, "b": 0}
+    for _ in range(30):
+        wins[engine._pick(queue).tenant] += 1
+    assert wins["a"] == 20 and wins["b"] == 10, wins
+
+
+def test_pick_strict_priority_lanes_before_fairness():
+    """Lane order dominates: a HIGH request dispatches before NORMAL
+    traffic even when its tenant is far behind on virtual time."""
+    engine = GenerationEngine(breaker_threshold=0)
+    engine.set_tenant("busy", weight=1.0)
+    queue = RequestQueue(max_depth=64)
+    for i in range(4):
+        _queued(queue, i, "busy")
+        engine._pick(queue)  # banks virtual time for 'busy'
+    _queued(queue, 100, "fresh")                      # NORMAL lane
+    _queued(queue, 101, "busy", priority=Priority.HIGH)
+    assert engine._pick(queue).id == 101
+
+
+def test_pick_skips_tenant_at_in_flight_cap():
+    engine = GenerationEngine(breaker_threshold=0)
+    engine.set_tenant("capped", weight=10.0, max_in_flight=1)
+    engine._tenant("capped").in_flight = 1
+    queue = RequestQueue(max_depth=64)
+    _queued(queue, 1, "capped")
+    _queued(queue, 2, "other")
+    assert engine._pick(queue).tenant == "other"
+    # only the capped tenant queued -> nothing admissible, req stays queued
+    assert engine._pick(queue) is None
+    engine._tenant("capped").in_flight = 0
+    assert engine._pick(queue).tenant == "capped"
+
+
+def test_pick_reserves_in_flight_so_one_round_cannot_exceed_cap():
+    """An admission round with several free slots calls _pick repeatedly
+    BEFORE any prefill runs; the cap must be charged at pick time or one
+    round admits a capped tenant twice."""
+    engine = GenerationEngine(breaker_threshold=0)
+    engine.set_tenant("capped", weight=1.0, max_in_flight=1)
+    queue = RequestQueue(max_depth=64)
+    _queued(queue, 1, "capped")
+    _queued(queue, 2, "capped")
+    first = engine._pick(queue)
+    assert first.tenant == "capped"
+    assert engine._tenant("capped").in_flight == 1
+    # same round, second free slot: the reservation blocks the pick
+    assert engine._pick(queue) is None
+    # retire the first -> the second request becomes admissible
+    engine._tenant_unflight("capped")
+    assert engine._pick(queue).id == 2
+
+
+def test_idle_tenant_reenters_at_vtime_floor():
+    """A long-idle tenant must not burn banked lag into a burst that
+    starves everyone else: it re-enters at the current floor and still
+    alternates with the active tenant."""
+    engine = GenerationEngine(breaker_threshold=0)
+    engine.set_tenant("active", weight=1.0)
+    engine.set_tenant("idle", weight=1.0)
+    queue = RequestQueue(max_depth=256)
+    for i in range(10):
+        _queued(queue, i, "active")
+        engine._pick(queue)  # active's vtime climbs to 10
+    for i in range(10, 18):
+        _queued(queue, i, "active" if i % 2 == 0 else "idle")
+    picks = [engine._pick(queue).tenant for _ in range(8)]
+    # never more than 2 consecutive wins for the returning tenant
+    for k in range(len(picks) - 2):
+        assert len(set(picks[k:k + 3])) > 1, picks
+
+
+def test_quota_reject_on_live_engine_does_not_deadlock():
+    """Over-quota submits while the scheduler loop is dispatching: the
+    quota path must estimate retry-after OUTSIDE _tenant_lock (the loop
+    acquires queue-lock -> tenant-lock; holding tenant-lock while taking
+    the queue lock was an ABBA deadlock)."""
+    engine = GenerationEngine(queue_depth=64, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="livequota", slots=1, max_len=32))
+    engine.set_tenant("q", max_queued=1)
+    engine.start()
+    try:
+        keep = [engine.submit([1, 2], tenant="q", max_new_tokens=24)]
+        rejected = 0
+        for _ in range(200):  # race the scheduler's admission scans
+            try:
+                keep.append(engine.submit([1, 2], tenant="q",
+                                          max_new_tokens=2))
+            except RejectedError as e:
+                assert e.retry_after_s > 0.0
+                rejected += 1
+        assert rejected > 0
+        for r in keep:
+            r.result(timeout=120)
+    finally:
+        engine.shutdown()
+    assert entry.metrics.count("rejected_quota") == rejected
+
+
+def test_inject_failure_invalidates_arena_and_recovers():
+    """A failed DONATED inject is replica health, not a request error:
+    the admitting request and every in-flight sequence fail loudly, the
+    arena resets, and the next request generates bit-identically."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="inj", slots=2, max_len=32))
+    ref = entry.offline_decode([5, 6], 4)
+    engine.start()
+    try:
+        victim = engine.submit([1, 2], max_new_tokens=24)  # holds slot 0
+        deadline = time.time() + 30
+        while entry.stats()["active_slots"] < 1:
+            assert time.time() < deadline
+            time.sleep(0.002)
+        faults.configure([{"site": "decode.inject", "action": "raise",
+                           "times": 1}])
+        doomed = engine.submit([3, 4], max_new_tokens=4)
+        with pytest.raises(RequestError, match="failed in inject"):
+            doomed.result(timeout=120)
+        with pytest.raises(RequestError, match="arena failure"):
+            victim.result(timeout=120)
+        out = engine.submit([5, 6], max_new_tokens=4).result(timeout=120)
+        assert [int(t) for t in out["tokens"]] == ref
+    finally:
+        engine.shutdown()
+        faults.reset()
+    assert entry.stats()["step_failures"] == 1
+
+
+def test_arena_failure_mid_admission_still_admits_remaining_picked():
+    """When the FIRST of several picked requests invalidates the arena,
+    the rest must still admit into the reset arena — dropping them would
+    abandon their futures forever and leak tenant queued counters."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="multi", slots=2, max_len=16))
+    ref = entry.offline_decode([5, 6], 4)
+    # both queued BEFORE start: one admission round picks both
+    doomed = engine.submit([1, 2], max_new_tokens=4)
+    survivor = engine.submit([5, 6], max_new_tokens=4)
+    faults.configure([{"site": "decode.inject", "action": "raise",
+                       "times": 1}])
+    engine.start()
+    try:
+        with pytest.raises(RequestError, match="failed in inject"):
+            doomed.result(timeout=120)
+        got = [int(t) for t in survivor.result(timeout=120)["tokens"]]
+        assert got == ref
+    finally:
+        engine.shutdown()
+        faults.reset()
+    assert engine.stats()["tenants"]["default"]["queued"] == 0
+
+
+def test_half_open_breaker_relaunches_once_while_idle():
+    """An open breaker whose cooldown lapses with NO traffic must not
+    rebuild the replica on every scheduler tick: one relaunch per
+    half-open episode, then the probe STEP decides close/reopen."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=1,
+                              breaker_cooldown_s=0.05)
+    entry = engine.register_model(
+        lambda: _small_model(name="idleprobe", slots=2, max_len=16))
+    faults.configure([{"site": "decode.step", "action": "raise",
+                       "times": 1}])
+    engine.start()
+    try:
+        with pytest.raises(RequestError):
+            engine.submit([5, 6], max_new_tokens=4).result(timeout=120)
+        time.sleep(0.6)  # many loop ticks past cooldown, zero traffic
+        st = entry.stats()
+        assert st["relaunches"] == 1, st["relaunches"]
+        assert st["breaker_probes"] == 1, st["breaker_probes"]
+        # the probe step closes the breaker and serves correctly
+        out = engine.submit([5, 6], max_new_tokens=4).result(timeout=120)
+        assert [int(t) for t in out["tokens"]] == entry.offline_decode(
+            [5, 6], 4)
+    finally:
+        engine.shutdown()
+        faults.reset()
+    assert entry.stats()["breaker_state"] == "closed"
+
+
+def test_tenant_admission_quota_rejects_with_measured_backoff():
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="quota", slots=2, max_len=8))
+    engine.set_tenant("small", max_queued=2)
+    # engine NOT started: submissions stay queued
+    engine.submit([1, 2], tenant="small", max_new_tokens=2)
+    engine.submit([1, 2], tenant="small", max_new_tokens=2)
+    with pytest.raises(RejectedError) as exc:
+        engine.submit([1, 2], tenant="small", max_new_tokens=2)
+    assert "quota" in str(exc.value)
+    assert exc.value.retry_after_s > 0.0
+    assert entry.metrics.count("rejected_quota") == 1
+    assert engine.stats()["tenants"]["small"]["queued"] == 2
+
+
+def test_model_registry_resolution_and_versioning():
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    engine.register_model(
+        lambda: _small_model(name="m", version="1", slots=2, max_len=8))
+    e2 = engine.register_model(
+        lambda: _small_model(name="m", version="2", slots=2, max_len=8))
+    assert engine.models() == [("m", "1"), ("m", "2")]
+    assert engine.entry("m") is e2                # latest version wins
+    assert engine.entry("m", "2") is e2
+    with pytest.raises(RejectedError, match="must name one"):
+        engine.submit([1], max_new_tokens=1)      # ambiguous: 2 hosted
+    with pytest.raises(RejectedError, match="no model"):
+        engine.submit([1], model="ghost", max_new_tokens=1)
+    engine.start()
+    try:
+        out = engine.submit([3, 4], model="m", version="1",
+                            max_new_tokens=3).result(timeout=120)
+        ref = engine.entry("m", "1").offline_decode([3, 4], 3)
+        assert [int(t) for t in out["tokens"]] == ref
+    finally:
+        engine.shutdown()
+
+
+def test_submit_validation_rejects_inadmissible_requests(served):
+    engine, entry = served
+    m = entry.model
+    with pytest.raises(RejectedError, match="empty"):
+        engine.submit([], max_new_tokens=2)
+    with pytest.raises(RejectedError, match="out of range"):
+        engine.submit([m.vocab_size], max_new_tokens=2)
+    with pytest.raises(RejectedError, match="max_new_tokens"):
+        engine.submit([1], max_new_tokens=0)
+    with pytest.raises(RejectedError, match="exceeds the KV arena"):
+        engine.submit(list(range(1, 16)), max_new_tokens=8)
+    with pytest.raises(RejectedError, match="priority"):
+        engine.submit([1], priority=99, max_new_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# satellite: queue drain-rate backoff + expired-vs-rejected split
+# ---------------------------------------------------------------------------
+
+
+class _Row:
+    _seq = 0
+
+    def __init__(self, rows=1, priority=Priority.NORMAL, dead=False):
+        _Row._seq += 1
+        self.id = _Row._seq
+        self.rows = rows
+        self.priority = priority
+        self._dead = dead
+
+    def expired(self, now=None):
+        return self._dead
+
+
+def test_retry_after_tracks_measured_drain_rate():
+    q = RequestQueue(max_depth=4)
+    for _ in range(4):
+        q.put(_Row())
+    # cold start: no drain observed yet -> the seed hint
+    with pytest.raises(RejectedError) as exc:
+        q.put(_Row())
+    assert exc.value.retry_after_s == pytest.approx(0.05)
+    # drain 3 rows at a measured ~100 rows/s
+    for r in list(q.lane(Priority.NORMAL))[:3]:
+        time.sleep(0.01)
+        q.remove([r])
+    est = q.retry_after_estimate(rows=4)
+    # 3 rows of overflow at O(100) rows/s: an order-of-magnitude window,
+    # not a fixed hint (the EWMA smooths scheduler jitter)
+    assert 0.005 <= est <= 1.0
+    assert q.stats()["drain_rate_rows_per_s"] > 0
+    # caller floor: reported hint is max(measured, caller estimate)
+    q.put(_Row(rows=3))
+    with pytest.raises(RejectedError) as exc:
+        q.put(_Row(), retry_after_s=4.5)
+    assert exc.value.retry_after_s == pytest.approx(4.5)
+
+
+def test_queue_counts_expiry_separately_from_admission_rejects():
+    q = RequestQueue(max_depth=2)
+    q.put(_Row(dead=True))
+    q.put(_Row())
+    with pytest.raises(RejectedError):
+        q.put(_Row())                      # rejected at admission
+    dead = q.expire()
+    assert len(dead) == 1                  # expired while queued
+    s = q.stats()
+    assert s["rejected_at_admission"] == 1
+    assert s["expired_in_queue"] == 1
+    assert s["depth"] == 1
+    assert s["lane_depths"][Priority.NORMAL] == 1
+
+
+def test_drain_rate_ignores_idle_gaps_between_bursts():
+    """Only back-to-back drains of a busy queue are service-rate samples.
+    A drain after the queue sat empty spans the idle gap — sampling it
+    would converge the EWMA to the ARRIVAL rate, so the first rejection
+    of a burst hitting a long-idle queue would back off ~100x too long."""
+    q = RequestQueue(max_depth=8)
+    for _ in range(4):
+        q.put(_Row())
+    for r in list(q.lane(Priority.NORMAL)):
+        time.sleep(0.005)
+        q.remove([r])                  # the last remove empties the queue
+    busy = q.stats()["drain_rate_rows_per_s"]
+    assert busy > 20.0
+    time.sleep(0.3)                    # idle gap: ~3 rows/s if mis-sampled
+    q.put(_Row())
+    q.remove(list(q.lane(Priority.NORMAL)))
+    assert q.stats()["drain_rate_rows_per_s"] == pytest.approx(busy)
+
+
+def test_pick_rounds_sample_drain_rate_once_per_round():
+    """_pick removes one request per call in a tight loop; sampling each
+    pick would measure the loop's microsecond gaps (~1e6 rows/s) and
+    collapse every retry-after hint to its floor. The round's picks are
+    deferred and note_drained() samples them as ONE drain event."""
+    engine = GenerationEngine(breaker_threshold=0)
+    q = RequestQueue(max_depth=64)
+    for i in range(8):
+        _queued(q, i, "t")
+    for _ in range(4):                 # admission round 1 (4 free slots)
+        assert engine._pick(q) is not None
+    q.note_drained()
+    time.sleep(0.02)
+    for _ in range(4):                 # admission round 2
+        assert engine._pick(q) is not None
+    q.note_drained()
+    rate = q.stats()["drain_rate_rows_per_s"]
+    # 4 rows per ~20ms round is O(200) rows/s; per-pick sampling would
+    # have pushed the EWMA toward 1e6
+    assert 0 < rate < 5000, rate
+
+
+def test_finished_generation_delivered_even_if_deadline_lapses_same_step():
+    """The device already paid for a COMPLETE generation: 'finished' wins
+    over 'expired' on the iteration that lands the final token, matching
+    the prefill fast path (which retires without an expiry check).
+    Thread-less — the worker is stepped by hand for determinism."""
+    engine = GenerationEngine(breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="dlwin", slots=1, max_len=16))
+    resp = engine.submit([1, 2, 3], max_new_tokens=2, deadline_ms=60000)
+    assert entry._admit_free_slots() == 1
+    req = entry._slots[0].request
+    entry._step()                      # token 1 of 2: mid-flight
+    req.deadline = 0.0                 # lapses before the FINAL iteration
+    entry._step()                      # token 2: finished AND expired
+    got = [int(t) for t in resp.result(timeout=5)["tokens"]]
+    assert got == entry.offline_decode([1, 2, 3], 2)
+
+
+def test_deadline_expires_in_queue_while_slots_are_busy():
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _small_model(name="dl", slots=1, max_len=32))
+    engine.start()
+    try:
+        long = engine.submit([1, 2], max_new_tokens=20)   # holds the slot
+        doomed = engine.submit([3, 4], max_new_tokens=4, deadline_ms=1.0)
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=120)
+        long.result(timeout=120)
+        assert entry.metrics.count("deadline_missed") >= 1
+        assert entry.stats()["queue_expired_in_queue"] >= 1
+    finally:
+        engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# kill a replica mid-decode: breaker re-admits an AOT-warmed replacement
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_relaunches_warm_replica_with_zero_recompiles():
+    """An injected decode-step crash loses the in-flight batch (failed
+    loudly), opens the breaker, and the cooldown probe relaunches the
+    replica — whose three executables ALL come from the in-process
+    compile-cache tier (zero new traces), after which generation is
+    bit-identical to the offline reference again."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=1,
+                              breaker_cooldown_s=0.05)
+    entry = engine.register_model(
+        lambda: _small_model(name="kill", slots=2, max_len=16))
+    assert entry.compile_sources["trace"] == 3
+    ref = entry.offline_decode([5, 6, 7], 6)
+    faults.configure([{"site": "decode.step", "action": "raise",
+                       "times": 1}])
+    engine.start()
+    try:
+        doomed = engine.submit([5, 6, 7], max_new_tokens=6)
+        with pytest.raises(RequestError, match="decode-step failure"):
+            doomed.result(timeout=120)
+        # the replacement replica serves the SAME request correctly
+        out = engine.submit([5, 6, 7], max_new_tokens=6).result(timeout=120)
+        assert [int(t) for t in out["tokens"]] == ref
+    finally:
+        engine.shutdown()
+        faults.reset()
+    st = entry.stats()
+    assert st["step_failures"] == 1
+    assert st["relaunches"] == 1
+    assert st["breaker_probes"] >= 1
+    # zero recompiles: the relaunch re-lowered all three programs from
+    # the memory tier; the trace count never moved
+    assert entry.compile_sources["trace"] == 3
+    assert entry.compile_sources["memory"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# AOT warm start across processes (the cold-replica acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+def _run_worker(cache_dir):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_CACHE_DIR", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if cache_dir is not None:
+        env["PADDLE_TPU_CACHE_DIR"] = str(cache_dir)
+    proc = subprocess.run(
+        [sys.executable, WORKER], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_fresh_process_restores_all_executables_with_zero_compiles(tmp_path):
+    """A cold replica with a populated cache dir reaches full decode/
+    prefill/inject coverage from the jax.export disk tier: zero traces,
+    all three entries disk-sourced, bit-identical generations."""
+    cache = tmp_path / "cache"
+    cold = _run_worker(cache)
+    assert cold["compile_sources"]["trace"] == 3
+    warm = _run_worker(cache)
+    assert warm["compile_sources"] == {"trace": 0, "disk": 3, "memory": 0}, \
+        warm
+    assert warm["persistent_hits"] >= 3
+    assert warm["persistent_errors"] == 0
+    assert warm["tokens"] == cold["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke (tier-1 wiring for tools/bench_serving.py --decode)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_decode_smoke_cli():
+    """tools/bench_serving.py --decode --smoke is the tier-1 CI hook:
+    open-loop mixed-length workload, asserting continuous-vs-offline
+    bit-identity for EVERY request, zero retraces after warmup, and
+    occupancy > 1.5x the request-at-a-time baseline."""
+    env = dict(os.environ)
+    env["PADDLE_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+         "--decode", "--smoke"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "DECODE_SMOKE_OK" in proc.stdout
+    report = json.loads(proc.stdout.strip().splitlines()[0])
+    extra = report["extra"]
+    assert extra["retraces_after_warmup"] == 0
+    assert extra["offline_mismatches"] == 0
+    assert all(s["occupancy_gain"] > 1.5 for s in extra["sweep"])
+
+
+# ---------------------------------------------------------------------------
+# HBM budget gate + observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_arena_sized_against_hbm_budget_before_compile():
+    tiny = GenerationEngine(breaker_threshold=0, hbm_budget_mb=0.001)
+    from paddle_tpu.utils.enforce import EnforceError
+
+    with pytest.raises(EnforceError, match="budget"):
+        tiny.register_model(
+            lambda: _small_model(name="oom", slots=4, max_len=16))
+    roomy = GenerationEngine(breaker_threshold=0, hbm_budget_mb=64)
+    entry = roomy.register_model(
+        lambda: _small_model(name="fits", slots=2, max_len=8))
+    assert entry.model.arena_bytes() < 64 * 2**20
+
+
+def test_stats_surface_has_decode_and_tenant_series(served):
+    engine, entry = served
+    out = engine.submit([2, 4, 6], tenant="acme",
+                        max_new_tokens=3).result(timeout=120)
+    assert len(out["tokens"]) == 3
+    st = entry.stats()
+    assert st["occupancy"] > 0.0
+    # a decode-step quantity: the prefill-derived first token of each
+    # admission is counted apart (prefill_tokens), so <= S always holds
+    assert 0.0 < st["tokens_per_step"] <= st["slots"]
+    assert st["prefill_tokens"] == st["admitted"]
+    assert st["compile_sources"]["trace"] == 3
+    assert st["arena_mib"] == pytest.approx(
+        entry.model.arena_bytes() / 2**20)
+    for key in ("latency_p99_s", "queue_wait_p99_s", "decode_step_p99_s",
+                "prefill_p99_s", "queue_drain_rate_rows_per_s",
+                "queue_rejected_at_admission", "queue_expired_in_queue"):
+        assert key in st, key
+    assert set(st["queue_lane_depths"]) == {"high", "normal", "low"}
+    assert st["tenant_tokens"].get("acme", 0) >= 3
+    top = engine.stats()
+    assert top["tenants"]["acme"]["in_flight"] == 0
+    assert any(h.startswith("shared@") for h in top["hosted"])
+    # the per-tenant counters are real registry series (scrapable), not
+    # snapshot-only bookkeeping
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    text = obs_metrics.registry().to_text()
+    assert "serving_tenant_tokens_total" in text
+    assert "serving_queue_lane_depth" in text

@@ -13,7 +13,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("name", ["fit_a_line", "recognize_digits",
-                                  "serve_transformer", "wide_deep"])
+                                  "serve_transformer", "serve_generation",
+                                  "wide_deep"])
 def test_example_runs(name):
     env = dict(os.environ)
     env["PADDLE_TPU_FORCE_CPU"] = "1"

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Serving load generator: closed-loop and open-loop over ServingEngine.
+"""Serving load generator: closed/open loop over ServingEngine, and
+open-loop continuous-batching decode over GenerationEngine.
 
 Closed loop (`--mode closed`): N concurrent clients, each submitting its
 next request the moment the previous one returns — measures saturated
@@ -11,14 +12,27 @@ throughput, p50/p99 queue+total latency, mean batch occupancy,
 rejection/deadline counters, and the post-warmup compile-cache hit rate
 (anything < 1.0 means the bucket lattice is mis-sized for the traffic).
 
+Decode (`--decode`): open-loop autoregressive generation through the
+continuous-batching engine (serving/decode) — Poisson arrivals of
+mixed-length prompts from weighted tenants, optionally swept over
+`--rates`. Reports slot occupancy, tokens/step, tokens/s, per-tenant
+token counts and completion ranks, and the occupancy gain over a
+request-at-a-time baseline (the PR-2 bucketing discipline: the same
+completed requests grouped into admission-order batches of S, each
+holding every slot for max(tokens) iterations — what the engine would
+have done without iteration-level retirement).
+
 `--smoke` runs a seconds-scale configuration and asserts the invariants
-(all served, zero retrace) — wired into tier-1 CI by
-tests/test_serving.py.
+(all served, zero retrace after warmup; for --decode also continuous-
+vs-offline bit-identity and occupancy gain > 1.5x) — wired into tier-1
+CI by tests/test_serving.py and tests/test_decode.py.
 
 Usage:
   python tools/bench_serving.py [--mode closed|open] [--requests 512]
       [--clients 8] [--rate 200] [--replicas 2] [--max-batch 8]
       [--seq 0] [--deadline-ms 0] [--smoke]
+  python tools/bench_serving.py --decode [--requests 128] [--slots 8]
+      [--max-len 64] [--rates 50,200,800] [--smoke]
 """
 
 import argparse
@@ -124,6 +138,196 @@ def run_open(engine, args, rng):
     return served, errors, time.perf_counter() - t0
 
 
+# ---------------------------------------------------------------------------
+# continuous-batching decode (--decode)
+# ---------------------------------------------------------------------------
+
+TENANT_WEIGHTS = {"gold": 2.0, "silver": 1.0}
+
+
+def _decode_workload(rng, n, max_len, vocab):
+    """Alternating short/long requests (the shape where request-at-a-time
+    bucketing wastes the most slot-steps: every short request waits for
+    the long batchmate to drain)."""
+    reqs = []
+    tenants = sorted(TENANT_WEIGHTS)
+    for i in range(n):
+        plen = int(rng.randint(1, 5))
+        prompt = [int(t) for t in rng.randint(0, vocab, size=plen)]
+        room = max_len - plen
+        if i % 2:
+            max_new = int(rng.randint(max(room - 4, 1), room + 1))
+        else:
+            max_new = int(rng.randint(1, 4))
+        reqs.append((prompt, max_new,
+                     tenants[int(rng.randint(len(tenants)))]))
+    return reqs
+
+
+def _baseline_occupancy(token_counts, slots):
+    """Request-at-a-time occupancy on the SAME completed requests: batches
+    of S in admission order, each running max(tokens) iterations with no
+    mid-flight retirement or admission."""
+    total = wasted_steps = 0
+    for i in range(0, len(token_counts), slots):
+        group = token_counts[i:i + slots]
+        total += sum(group)
+        wasted_steps += slots * max(group)
+    return total / float(max(wasted_steps, 1))
+
+
+def _jit_count():
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    m = obs_metrics.registry().get("lowering_jit_total")
+    return int(m.value) if m is not None else 0
+
+
+def run_decode(args, rng):
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    engine = GenerationEngine(queue_depth=args.queue_depth,
+                              breaker_threshold=0)
+    for tenant, weight in TENANT_WEIGHTS.items():
+        engine.set_tenant(tenant, weight=weight)
+    t0 = time.perf_counter()
+    entry = engine.register_model(lambda: build_decoder_model(
+        vocab_size=args.vocab, hidden=args.hidden, num_layers=args.layers,
+        slots=args.slots, max_len=args.max_len, name="bench", version="1",
+    ))
+    engine.start()
+    # warmup: one request per slot, drained — steady-state executables
+    for r in [engine.submit([1, 2], max_new_tokens=2)
+              for _ in range(args.slots)]:
+        r.result(timeout=120)
+    warm_s = time.perf_counter() - t0
+    jits_warm = _jit_count()
+
+    m = entry.metrics
+    sweep = []
+    mismatches = errors = served = verified = 0
+    sample = None if args.smoke else args.verify  # None = every request
+    for rate in args.rates:
+        reqs = _decode_workload(rng, args.requests, args.max_len, args.vocab)
+        steps0 = m.count("decode_steps")
+        active0 = m.count("active_slot_steps")
+        tokens0 = m.count("generated_tokens")
+        t0 = time.perf_counter()
+        resps = []
+        for prompt, max_new, tenant in reqs:
+            time.sleep(float(rng.exponential(1.0 / rate)))
+            try:
+                resps.append(engine.submit(prompt, max_new_tokens=max_new,
+                                           tenant=tenant))
+            except Exception:
+                # open-loop overload IS the measured regime: a rejected
+                # submit (queue full / quota) is an error datum, not a
+                # bench crash
+                resps.append(None)
+        outs = []
+        for r in resps:
+            if r is None:
+                outs.append(None)
+                errors += 1
+                continue
+            try:
+                outs.append([int(t) for t in r.result(timeout=300)["tokens"]])
+                served += 1
+            except Exception:
+                outs.append(None)
+                errors += 1
+        wall = time.perf_counter() - t0
+        counts = [len(o) for o in outs if o is not None]
+        steps = m.count("decode_steps") - steps0
+        occupancy = ((m.count("active_slot_steps") - active0)
+                     / float(max(steps, 1) * args.slots))
+        baseline = _baseline_occupancy(counts, args.slots)
+        # bit-identity vs the offline whole-sequence reference (every
+        # request under --smoke; a sample otherwise — offline replays the
+        # full prefill per token, so it dominates the bench runtime)
+        for (prompt, max_new, _t), out in list(zip(reqs, outs))[:sample]:
+            if out is None:
+                continue
+            verified += 1
+            if out != entry.offline_decode(prompt, max_new):
+                mismatches += 1
+        sweep.append({
+            "rate_req_per_s": rate,
+            "occupancy": round(occupancy, 3),
+            "baseline_occupancy": round(baseline, 3),
+            "occupancy_gain": round(occupancy / max(baseline, 1e-9), 2),
+            "tokens_per_step": round(
+                (m.count("generated_tokens") - tokens0) / max(steps, 1), 2),
+            "tokens_per_sec": round(sum(counts) / max(wall, 1e-9), 1),
+            "decode_steps": steps,
+        })
+
+    # fairness burst: equal offered load per tenant under full contention;
+    # the weight-2 tenant's requests should finish earlier (smaller mean
+    # completion rank), tokens split tracking the 2:1 stride shares
+    burst = []
+    for i in range(args.slots * 4):
+        tenant = sorted(TENANT_WEIGHTS)[i % 2]
+        try:
+            burst.append((tenant, engine.submit(
+                [int(x) for x in rng.randint(0, args.vocab, size=2)],
+                max_new_tokens=6, tenant=tenant)))
+        except Exception:
+            errors += 1
+    done = []
+    for tenant, resp in burst:
+        try:
+            resp.result(timeout=300)
+            done.append((tenant, resp))
+        except Exception:
+            errors += 1
+    ranks = {}
+    for rank, (tenant, _r) in enumerate(
+            sorted(done, key=lambda x: x[1].finish_time)):
+        ranks.setdefault(tenant, []).append(rank)
+    mean_rank = {t: round(sum(r) / len(r), 2) for t, r in ranks.items()}
+
+    jits_end = _jit_count()
+    stats = entry.stats()
+    engine.shutdown()
+    last = sweep[-1]
+    report = {
+        "metric": "serving_decode_tokens_per_sec",
+        "value": last["tokens_per_sec"],
+        "unit": "tok/s",
+        "extra": {
+            "mode": "decode",
+            "slots": args.slots, "max_len": args.max_len,
+            "arena_mib": round(stats["arena_mib"], 3),
+            "served": served, "errors": errors,
+            "offline_mismatches": mismatches,
+            "verified_bit_identical": verified,
+            "sweep": sweep,
+            "warmup_seconds": round(warm_s, 2),
+            "retraces_after_warmup": jits_end - jits_warm,
+            "compile_sources": stats["compile_sources"],
+            "prefix_hits": stats["prefix_hits"],
+            "tenant_tokens": stats["tenant_tokens"],
+            "tenant_weights": TENANT_WEIGHTS,
+            "fairness_mean_completion_rank": mean_rank,
+            "latency_p50_s": round(stats["latency_p50_s"], 5),
+            "latency_p99_s": round(stats["latency_p99_s"], 5),
+            "queue_wait_p99_s": round(stats["queue_wait_p99_s"], 5),
+            "decode_step_p99_s": round(stats["decode_step_p99_s"], 5),
+        },
+    }
+    print(json.dumps(report))
+    if args.smoke:
+        assert errors == 0 and served == args.requests * len(args.rates), \
+            (served, errors)
+        assert mismatches == 0, f"{mismatches} continuous!=offline"
+        assert jits_end == jits_warm, \
+            f"{jits_end - jits_warm} retraces after warmup"
+        assert last["occupancy_gain"] > 1.5, sweep
+        print("DECODE_SMOKE_OK")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
@@ -139,16 +343,40 @@ def main(argv=None):
     ap.add_argument("--queue-depth", type=int, default=512)
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
     ap.add_argument("--deadline-ms", type=float, default=0.0)
+    ap.add_argument("--decode", action="store_true",
+                    help="continuous-batching decode over GenerationEngine")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode: KV arena slots (the iteration batch)")
+    ap.add_argument("--max-len", type=int, default=64,
+                    help="decode: KV arena length per slot")
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--rates", type=str, default=None,
+                    help="decode: comma-separated arrival-rate sweep, req/s")
+    ap.add_argument("--verify", type=int, default=8,
+                    help="decode: requests/rate checked against offline "
+                         "(--smoke checks every request)")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale run + invariant asserts (CI)")
     args = ap.parse_args(argv)
     if args.smoke:
         args.requests, args.clients, args.replicas = 32, 4, 1
         args.max_batch = 4
+    if args.decode:
+        if args.smoke:
+            args.requests, args.slots, args.max_len = 48, 4, 24
+            args.vocab, args.hidden, args.layers = 32, 8, 2
+            args.rates = args.rates or "500"
+        args.rates = [float(r) for r in
+                      (args.rates or str(args.rate)).split(",")]
 
     from paddle_tpu.core.places import ensure_backend_or_cpu
 
     on_tpu, diag = ensure_backend_or_cpu()
+
+    if args.decode:
+        return run_decode(args, np.random.RandomState(0))
 
     from paddle_tpu import inference
     from paddle_tpu.serving import BucketLattice, ServingEngine
