@@ -1,0 +1,1012 @@
+"""Static concurrency lint: lock inventory, lock-order graph, races.
+
+The ThreadSanitizer/lockdep discipline applied to the SOURCE, before any
+thread runs (the static half of the r11 concurrency gates; the runtime
+half is observability/lockdep.py). Over a set of Python files this pass:
+
+* inventories every lock — ``threading.Lock/RLock/Condition`` attributes
+  and module globals, plus ``lockdep.named_lock("...")`` adoptions (the
+  named class becomes the graph node, exactly as at runtime);
+* builds the **may-acquire-while-holding graph**: ``with`` nesting and
+  explicit ``.acquire()`` inside held regions, INCLUDING one level of
+  interprocedural resolution — a call to ``self.m()`` (or to a method
+  reachable through a typed ``self.`` attribute, or a repo-unique method
+  name) while holding L adds edges from L to every lock ``m`` acquires
+  directly;
+* reports three finding classes, each with file:line and the held-chain
+  attribution:
+    - ``lock-order-cycle``      an SCC in the graph (ABBA potential);
+    - ``blocking-under-lock``   a blocking call (queue get/put, thread
+      join, future result, Event wait, time.sleep, jit_compile /
+      lower_step / aot_compile) inside a held region;
+    - ``unguarded-shared-mutation``  a ``self.`` collection/counter
+      mutated on a thread-entry path (``threading.Thread(target=...)``
+      bodies, executor-submitted closures, and the self-call closure of
+      those methods) with no lock held, where the attribute is also
+      visible outside that thread context.
+
+False-positive escape hatch: a finding whose line (or whose enclosing
+``with`` line) carries ``# lockdep: ok(reason)`` is reported as
+suppressed, with the reason — the CI gate counts only unsuppressed
+findings (tools/lint_concurrency.py).
+
+Heuristics are deliberately conservative: an acquisition that cannot be
+resolved to a known lock contributes nothing (no edges, no findings), so
+every reported chain names real locks. Both synthetic positive controls
+(an injected ABBA pair and an unguarded-dict mutation) are asserted to
+fire by the ``--smoke`` gate, proving the lint live.
+"""
+
+import ast
+import os
+import re
+
+__all__ = [
+    "Finding",
+    "LockDef",
+    "Edge",
+    "Report",
+    "scan_paths",
+    "scan_sources",
+    "SUPPRESS_RE",
+]
+
+# greedy to the LAST ')' on the line: reasons routinely contain calls
+# like "stop()" — a lazy match would truncate them mid-sentence
+SUPPRESS_RE = re.compile(r"#\s*lockdep:\s*ok\((.*)\)")
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock"}
+_IGNORED_TYPES = {"Event", "Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore", "Barrier"}
+_MUTATORS = {
+    "append", "appendleft", "add", "update", "pop", "popitem", "remove",
+    "discard", "clear", "extend", "insert", "setdefault", "move_to_end",
+    "difference_update", "intersection_update", "symmetric_difference_update",
+    "sort", "reverse", "popleft",
+}
+_BLOCKING_NAME_CALLS = {"jit_compile", "lower_step"}
+_BLOCKING_ATTR_CALLS = {"result", "aot_compile"}
+
+
+class LockDef:
+    __slots__ = ("id", "kind", "file", "line", "named")
+
+    def __init__(self, id, kind, file, line, named):
+        self.id = id
+        self.kind = kind
+        self.file = file
+        self.line = line
+        self.named = named
+
+    def to_json(self):
+        return {"id": self.id, "kind": self.kind, "file": self.file,
+                "line": self.line, "named": self.named}
+
+
+class Edge:
+    """One may-acquire-while-holding observation: `a` held when `b` is
+    acquired at file:line (chain = the full held stack, via = the callee
+    acquisition for interprocedural edges)."""
+
+    __slots__ = ("a", "b", "file", "line", "chain", "via")
+
+    def __init__(self, a, b, file, line, chain, via=None):
+        self.a = a
+        self.b = b
+        self.file = file
+        self.line = line
+        self.chain = tuple(chain)
+        self.via = via
+
+    def describe(self):
+        tail = f" via {self.via}" if self.via else ""
+        return (f"{self.file}:{self.line}: acquires '{self.b}' while "
+                f"holding {' -> '.join(self.chain)}{tail}")
+
+    def to_json(self):
+        return {"a": self.a, "b": self.b, "file": self.file,
+                "line": self.line, "chain": list(self.chain),
+                "via": self.via}
+
+
+class Finding:
+    __slots__ = ("kind", "file", "line", "message", "held",
+                 "suppress_reason")
+
+    def __init__(self, kind, file, line, message, held=()):
+        self.kind = kind
+        self.file = file
+        self.line = line
+        self.message = message
+        self.held = tuple(held)
+        self.suppress_reason = None
+
+    def __str__(self):
+        held = f" [holding {' -> '.join(self.held)}]" if self.held else ""
+        sup = (f" (suppressed: {self.suppress_reason})"
+               if self.suppress_reason is not None else "")
+        return f"{self.file}:{self.line}: [{self.kind}]{held} " \
+               f"{self.message}{sup}"
+
+    def to_json(self):
+        return {"kind": self.kind, "file": self.file, "line": self.line,
+                "message": self.message, "held": list(self.held),
+                "suppressed": self.suppress_reason is not None,
+                "suppress_reason": self.suppress_reason}
+
+
+class _ClassModel:
+    def __init__(self, name, module, node):
+        self.name = name
+        self.module = module          # _ModuleModel
+        self.node = node
+        self.bases = [_dotted_last(b) for b in node.bases]
+        self.locks = {}               # attr -> lock id
+        self.cond_exprs = {}          # attr -> ast expr (Condition(expr))
+        self.attr_types = {}          # attr -> type name (last segment)
+        self.methods = {}             # name -> FunctionDef
+        self.entry_names = set()      # thread-entry method names
+        self.thread_bodies = []       # nested FunctionDef nodes run on threads
+
+    @property
+    def qual(self):
+        return f"{self.module.stem}.{self.name}"
+
+
+class _ModuleModel:
+    def __init__(self, path, rel, tree, lines):
+        self.path = path
+        self.rel = rel
+        self.stem = rel[:-3].replace(os.sep, ".") if rel.endswith(".py") \
+            else rel.replace(os.sep, ".")
+        self.tree = tree
+        self.lines = lines
+        self.classes = {}             # name -> _ClassModel
+        self.functions = {}           # module-level name -> FunctionDef
+        self.locks = {}               # module global name -> lock id
+        self.suppressions = {}        # line -> reason
+
+
+class _FuncInfo:
+    """Everything one walked function contributes."""
+
+    def __init__(self, qual, file):
+        self.qual = qual
+        self.file = file
+        self.acquisitions = []        # (lock_id, line) direct acquires
+        self.edges = []               # intra-function Edge
+        self.calls = []               # (callee _FuncKey-resolvable, line, held)
+        self.blockings = []           # (line, desc, held)
+        self.mutations = []           # (attr, line, held, desc)
+        self.self_calls = set()       # method names invoked on self
+
+
+def _dotted_last(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _call_ctor(node):
+    """('threading', 'Lock') style (module_hint, ctor name) for a Call."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        return None, f.attr
+    return None, None
+
+
+def _is_named_lock_call(node):
+    mod, name = _call_ctor(node)
+    if name not in ("named_lock", "named_condition"):
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _lock_ctor_kind(node):
+    """'lock'/'rlock'/'condition' for threading.X() ctors, else None."""
+    mod, name = _call_ctor(node)
+    if name in _LOCK_CTORS and (mod in (None, "threading")):
+        return _LOCK_CTORS[name]
+    if name == "Condition" and (mod in (None, "threading")):
+        return "condition"
+    return None
+
+
+def _type_of_ctor(node):
+    """Type name a constructor call assigns ('RequestQueue', 'Thread',
+    'Queue', 'Event', 'ThreadPoolExecutor', ...)."""
+    mod, name = _call_ctor(node)
+    if name and name[:1].isupper():
+        return name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# phase A: per-module collection
+# ---------------------------------------------------------------------------
+
+
+def _collect_module(path, rel, source):
+    tree = ast.parse(source, filename=rel)
+    lines = source.splitlines()
+    mod = _ModuleModel(path, rel, tree, lines)
+    for i, ln in enumerate(lines, 1):
+        m = SUPPRESS_RE.search(ln)
+        if m:
+            mod.suppressions[i] = m.group(1).strip() or "unspecified"
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = _collect_class(node, mod)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = node
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            name = node.targets[0].id
+            nm = _is_named_lock_call(node.value)
+            kind = _lock_ctor_kind(node.value)
+            if nm is not None:
+                mod.locks[name] = nm
+            elif kind in ("lock", "rlock"):
+                mod.locks[name] = f"{mod.stem}.{name}"
+            elif kind == "condition" and not node.value.args:
+                mod.locks[name] = f"{mod.stem}.{name}"
+    return mod
+
+
+def _collect_class(node, mod):
+    cls = _ClassModel(node.name, mod, node)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls.methods[item.name] = item
+    for meth in cls.methods.values():
+        for stmt in ast.walk(meth):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and isinstance(stmt.value, ast.Call)):
+                    attr = tgt.attr
+                    nm = _is_named_lock_call(stmt.value)
+                    kind = _lock_ctor_kind(stmt.value)
+                    if nm is not None:
+                        cls.locks.setdefault(attr, nm)
+                    elif kind in ("lock", "rlock"):
+                        cls.locks.setdefault(attr, f"{cls.qual}.{attr}")
+                    elif kind == "condition":
+                        if stmt.value.args:
+                            cls.cond_exprs.setdefault(attr,
+                                                      stmt.value.args[0])
+                        else:
+                            cls.locks.setdefault(attr, f"{cls.qual}.{attr}")
+                    else:
+                        t = _type_of_ctor(stmt.value)
+                        if t:
+                            cls.attr_types.setdefault(attr, t)
+            if isinstance(stmt, ast.Call):
+                _note_thread_targets(stmt, cls, meth)
+    return cls
+
+
+def _note_thread_targets(call, cls, enclosing):
+    """threading.Thread(target=...) and executor .submit(fn) mark
+    thread-entry methods / thread-body closures."""
+    mod_hint, name = _call_ctor(call)
+    target = None
+    if name == "Thread" and mod_hint in (None, "threading"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+    elif name == "submit" and isinstance(call.func, ast.Attribute):
+        if call.args:
+            target = call.args[0]
+    if target is None:
+        return
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        cls.entry_names.add(target.attr)
+    elif isinstance(target, ast.Name):
+        # a nested def in the same enclosing function body
+        for sub in ast.walk(enclosing):
+            if isinstance(sub, ast.FunctionDef) and sub.name == target.id:
+                cls.thread_bodies.append(sub)
+                break
+
+
+# ---------------------------------------------------------------------------
+# phase B: global indexes + alias resolution
+# ---------------------------------------------------------------------------
+
+
+class _Index:
+    def __init__(self, modules):
+        self.modules = modules
+        self.classes = {}          # name -> [_ClassModel]
+        self.attr_locks = {}       # attr -> set(lock ids)
+        self.attr_types = {}       # attr -> set(type names)
+        self.methods = {}          # name -> [(cls, FunctionDef)]
+        for mod in modules:
+            for cls in mod.classes.values():
+                self.classes.setdefault(cls.name, []).append(cls)
+                for attr, t in cls.attr_types.items():
+                    self.attr_types.setdefault(attr, set()).add(t)
+                for name, fn in cls.methods.items():
+                    self.methods.setdefault(name, []).append((cls, fn))
+        # resolve Condition(expr) aliases once types are known (two
+        # rounds, rebuilding the attr->lock map between them: an alias
+        # may point at another class's lock attr)
+        for _ in range(2):
+            self._rebuild_attr_locks()
+            for mod in modules:
+                for cls in mod.classes.values():
+                    for attr, expr in list(cls.cond_exprs.items()):
+                        lid = self._resolve_lock_expr_early(expr, cls)
+                        if lid is not None:
+                            cls.locks[attr] = lid
+                            del cls.cond_exprs[attr]
+        self._rebuild_attr_locks()
+
+    def _rebuild_attr_locks(self):
+        self.attr_locks = {}
+        for mod in self.modules:
+            for cls in mod.classes.values():
+                for attr, lid in cls.locks.items():
+                    self.attr_locks.setdefault(attr, set()).add(lid)
+
+    def unique_class(self, name):
+        hits = self.classes.get(name, [])
+        return hits[0] if len(hits) == 1 else None
+
+    def unique_attr_lock(self, attr):
+        ids = self.attr_locks.get(attr, ())
+        return next(iter(ids)) if len(ids) == 1 else None
+
+    def unique_attr_type(self, attr):
+        ts = self.attr_types.get(attr, ())
+        return next(iter(ts)) if len(ts) == 1 else None
+
+    def unique_method(self, name):
+        hits = self.methods.get(name, [])
+        return hits[0] if len(hits) == 1 else None
+
+    def _resolve_lock_expr_early(self, expr, cls):
+        """Alias-time resolution: self.X / self.X.Y chains only."""
+        return _resolve_lock(expr, cls, self, locals_types={},
+                             locals_locks={})
+
+    def resolve_method(self, cls, name, _depth=0):
+        """Method lookup through the (scanned) base-class chain."""
+        if cls is None or _depth > 3:
+            return None
+        fn = cls.methods.get(name)
+        if fn is not None:
+            return cls, fn
+        for b in cls.bases:
+            base = self.unique_class(b) if b else None
+            got = self.resolve_method(base, name, _depth + 1)
+            if got is not None:
+                return got
+        return None
+
+
+def _resolve_lock(expr, cls, index, locals_types, locals_locks):
+    """Lock id for an expression used as a lock (with-item, acquire
+    receiver, Condition arg), or None."""
+    if isinstance(expr, ast.Name):
+        if expr.id in locals_locks:
+            return locals_locks[expr.id]
+        if cls is not None and expr.id in cls.module.locks:
+            return cls.module.locks[expr.id]
+        return None
+    if not isinstance(expr, ast.Attribute):
+        return None
+    attr = expr.attr
+    base = expr.value
+    if isinstance(base, ast.Name) and base.id == "self" and cls is not None:
+        if attr in cls.locks:
+            return cls.locks[attr]
+        t = cls.attr_types.get(attr)
+        if t is None:
+            return index.unique_attr_lock(attr)
+        return None
+    # typed chains: <expr>.attr where <expr>'s class is known
+    t = _resolve_type(base, cls, index, locals_types)
+    if t is not None:
+        c2 = index.unique_class(t)
+        if c2 is not None and attr in c2.locks:
+            return c2.locks[attr]
+    return index.unique_attr_lock(attr)
+
+
+def _resolve_type(expr, cls, index, locals_types):
+    """Class-name string for an expression, or None."""
+    if isinstance(expr, ast.Name):
+        if expr.id == "self" and cls is not None:
+            return cls.name
+        return locals_types.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        base_t = _resolve_type(expr.value, cls, index, locals_types)
+        if base_t is not None:
+            c2 = index.unique_class(base_t)
+            if c2 is not None:
+                return c2.attr_types.get(expr.attr)
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and cls is not None:
+            return cls.attr_types.get(expr.attr)
+        return index.unique_attr_type(expr.attr)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# phase C: function walker
+# ---------------------------------------------------------------------------
+
+
+class _Walker:
+    def __init__(self, fn_node, cls, index, file, qual):
+        self.fn = fn_node
+        self.cls = cls
+        self.index = index
+        self.file = file
+        self.info = _FuncInfo(qual, file)
+        self.locals_types = {}
+        self.locals_locks = {}
+
+    def run(self):
+        self._stmts(self.fn.body, held=())
+        return self.info
+
+    # -- statement dispatch -------------------------------------------------
+    def _stmts(self, body, held):
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt, held):
+        if isinstance(stmt, ast.With):
+            self._with(stmt, held)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run later, on their own thread context
+        elif isinstance(stmt, (ast.If,)):
+            self._exprs_of(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exprs_of(stmt.iter, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.While):
+            self._exprs_of(stmt.test, held)
+            self._stmts(stmt.body, held)
+            self._stmts(stmt.orelse, held)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, held)
+            for h in stmt.handlers:
+                self._stmts(h.body, held)
+            self._stmts(stmt.orelse, held)
+            self._stmts(stmt.finalbody, held)
+        elif isinstance(stmt, ast.Assign):
+            self._assign(stmt, held)
+        elif isinstance(stmt, ast.AugAssign):
+            self._augassign(stmt, held)
+        elif isinstance(stmt, ast.Delete):
+            self._delete(stmt, held)
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            val = stmt.value
+            if val is not None:
+                self._exprs_of(val, held)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._exprs_of(stmt.exc, held)
+        elif isinstance(stmt, ast.Assert):
+            self._exprs_of(stmt.test, held)
+        # pass/break/continue/import/global: nothing to do
+
+    def _with(self, stmt, held):
+        new_held = list(held)
+        for item in stmt.items:
+            ctx = item.context_expr
+            lid = _resolve_lock(ctx, self.cls, self.index,
+                               self.locals_types, self.locals_locks)
+            if lid is not None:
+                self._acquire(lid, ctx.lineno, new_held)
+                new_held.append((lid, ctx.lineno))
+            else:
+                self._exprs_of(ctx, tuple(new_held))
+        self._stmts(stmt.body, tuple(new_held))
+
+    def _acquire(self, lid, line, held):
+        self.info.acquisitions.append((lid, line))
+        for h, _hl in held:
+            if h != lid:
+                self.info.edges.append(
+                    Edge(h, lid, self.file, line,
+                         [x for x, _l in held]))
+
+    # -- assignments / mutations --------------------------------------------
+    def _assign(self, stmt, held):
+        self._exprs_of(stmt.value, held)
+        if len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name) and isinstance(stmt.value, ast.Call):
+                lid = _is_named_lock_call(stmt.value)
+                kind = _lock_ctor_kind(stmt.value)
+                if lid is not None:
+                    self.locals_locks[tgt.id] = lid
+                elif kind in ("lock", "rlock"):
+                    self.locals_locks[tgt.id] = \
+                        f"{self.info.qual}.<local:{tgt.id}>"
+                else:
+                    t = _type_of_ctor(stmt.value)
+                    if t:
+                        self.locals_types[tgt.id] = t
+            elif isinstance(tgt, ast.Name):
+                # local alias of a lock: `lock = self._lock`
+                lid = _resolve_lock(stmt.value, self.cls, self.index,
+                                    self.locals_types, self.locals_locks)
+                if lid is not None:
+                    self.locals_locks[tgt.id] = lid
+            elif isinstance(tgt, ast.Subscript):
+                attr = self._self_attr_of(tgt.value)
+                if attr is not None:
+                    self.info.mutations.append(
+                        (attr, stmt.lineno, tuple(h for h, _l in held),
+                         f"self.{attr}[...] = ..."))
+                self._exprs_of(tgt, held)
+
+    def _augassign(self, stmt, held):
+        self._exprs_of(stmt.value, held)
+        tgt = stmt.target
+        attr = self._self_attr_of(tgt) or (
+            self._self_attr_of(tgt.value)
+            if isinstance(tgt, ast.Subscript) else None)
+        if attr is not None:
+            self.info.mutations.append(
+                (attr, stmt.lineno, tuple(h for h, _l in held),
+                 f"self.{attr} augmented-assign"))
+
+    def _delete(self, stmt, held):
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Subscript):
+                attr = self._self_attr_of(tgt.value)
+                if attr is not None:
+                    self.info.mutations.append(
+                        (attr, stmt.lineno, tuple(h for h, _l in held),
+                         f"del self.{attr}[...]"))
+
+    def _self_attr_of(self, expr):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    # -- expression scan (calls) --------------------------------------------
+    def _exprs_of(self, expr, held):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._call(node, held)
+
+    def _call(self, node, held):
+        f = node.func
+        # explicit .acquire() / .release()
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            lid = _resolve_lock(f.value, self.cls, self.index,
+                                self.locals_types, self.locals_locks)
+            if lid is not None and f.attr == "acquire":
+                self._acquire(lid, node.lineno, list(held))
+            return
+        # blocking classification
+        desc = self._blocking_desc(node, held)
+        if desc is not None and held:
+            self.info.blockings.append(
+                (node.lineno, desc, tuple(h for h, _l in held)))
+        # interprocedural candidates: record resolvable method calls
+        callee = self._resolve_callee(node)
+        if callee is not None:
+            self.info.calls.append((callee, node.lineno,
+                                    tuple(h for h, _l in held)))
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            self.info.self_calls.add(f.attr)
+        # mutating collection method on a direct self attribute
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = self._self_attr_of(f.value)
+            if attr is not None and self.cls is not None:
+                t = self.cls.attr_types.get(attr)
+                if t not in _IGNORED_TYPES and attr not in self.cls.locks:
+                    self.info.mutations.append(
+                        (attr, node.lineno, tuple(h for h, _l in held),
+                         f"self.{attr}.{f.attr}(...)"))
+
+    def _blocking_desc(self, node, held):
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _BLOCKING_NAME_CALLS:
+                return f"{f.id}() (compile)"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        attr = f.attr
+        if attr == "sleep" and isinstance(f.value, ast.Name) \
+                and f.value.id == "time":
+            return "time.sleep()"
+        if attr in _BLOCKING_ATTR_CALLS:
+            return f".{attr}() (blocks on a future/compile)"
+        recv_t = _resolve_type(f.value, self.cls, self.index,
+                               self.locals_types)
+        if attr == "join" and recv_t == "Thread":
+            return "Thread.join()"
+        if attr in ("get", "put") and recv_t == "Queue":
+            return f"Queue.{attr}()"
+        if attr == "wait" and recv_t == "Event":
+            return "Event.wait()"
+        if attr in ("wait", "wait_for"):
+            # Condition.wait while holding ONLY that condition's lock is
+            # the one legitimate sleep-with-lock; waiting with extra
+            # locks above it keeps those locks held through the sleep
+            lid = _resolve_lock(f.value, self.cls, self.index,
+                                self.locals_types, self.locals_locks)
+            if lid is not None:
+                held_ids = [h for h, _l in held]
+                if lid in held_ids and len(held_ids) > 1:
+                    return (f"Condition.wait() on '{lid}' while holding "
+                            f"outer locks")
+        return None
+
+    def _resolve_callee(self, node):
+        """(cls, FunctionDef) for one-level interprocedural expansion."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            if self.cls is not None and \
+                    f.id in self.cls.module.functions:
+                return (None, self.cls.module.functions[f.id],
+                        self.cls.module)
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = f.attr
+        if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.cls is not None:
+            got = self.index.resolve_method(self.cls, name)
+            if got is not None:
+                return (got[0], got[1], got[0].module)
+        t = _resolve_type(f.value, self.cls, self.index, self.locals_types)
+        if t is not None:
+            c2 = self.index.unique_class(t)
+            if c2 is not None:
+                got = self.index.resolve_method(c2, name)
+                if got is not None:
+                    return (got[0], got[1], got[0].module)
+        got = self.index.unique_method(name)
+        if got is not None:
+            return (got[0], got[1], got[0].module)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# phase D/E: analysis + report
+# ---------------------------------------------------------------------------
+
+
+class Report:
+    def __init__(self):
+        self.files = 0
+        self.locks = []
+        self.edges = []
+        self.cycles = []
+        self.findings = []
+        self.suppressed = []
+
+    def to_json(self):
+        return {
+            "files": self.files,
+            "locks": [l.to_json() for l in self.locks],
+            "edges": [e.to_json() for e in self.edges],
+            "cycles": self.cycles,
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+        }
+
+
+def _tarjan_sccs(nodes, succ):
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(succ.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(succ.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in nodes:
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def _thread_context(cls, index):
+    """Function nodes that run on background threads for this class:
+    entry methods (incl. inherited entry names), the transitive closure
+    of their same-class self-calls, and executor/Thread closures."""
+    entry_names = set(cls.entry_names)
+    seen_bases = set()
+
+    def inherit(c, depth=0):
+        if c is None or c.name in seen_bases or depth > 3:
+            return
+        seen_bases.add(c.name)
+        entry_names.update(c.entry_names)
+        for b in c.bases:
+            inherit(index.unique_class(b) if b else None, depth + 1)
+
+    inherit(cls)
+    ctx = {}
+    queue = list(entry_names)
+    visited = set()
+    while queue:
+        name = queue.pop()
+        if name in visited:
+            continue
+        visited.add(name)
+        got = index.resolve_method(cls, name)
+        if got is None:
+            continue
+        _owner, fn = got
+        ctx[fn] = name
+        info = fn._cc_info if hasattr(fn, "_cc_info") else None
+        if info is not None:
+            for callee in info.self_calls:
+                queue.append(callee)
+    bodies = list(cls.thread_bodies)
+    seen_b = set()
+
+    def inherit_bodies(c, depth=0):
+        if c is None or id(c) in seen_b or depth > 3:
+            return
+        seen_b.add(id(c))
+        bodies.extend(c.thread_bodies)
+        for b in c.bases:
+            inherit_bodies(index.unique_class(b) if b else None, depth + 1)
+
+    inherit_bodies(cls)
+    for body in bodies:
+        ctx[body] = body.name
+    return ctx, visited
+
+
+def _analyze(modules):
+    index = _Index(modules)
+    report = Report()
+    report.files = len(modules)
+
+    # lock inventory
+    seen_locks = {}
+    for mod in modules:
+        for name, lid in mod.locks.items():
+            seen_locks.setdefault(lid, LockDef(
+                lid, "lock", mod.rel, 0, not lid.startswith(mod.stem)))
+        for cls in mod.classes.values():
+            for attr, lid in cls.locks.items():
+                named = not lid.startswith(cls.qual)
+                seen_locks.setdefault(lid, LockDef(
+                    lid, "lock", mod.rel, cls.node.lineno, named))
+    report.locks = sorted(seen_locks.values(), key=lambda l: l.id)
+
+    # walk every function (methods, module functions, thread bodies)
+    infos = []
+    for mod in modules:
+        for cls in mod.classes.values():
+            for name, fn in cls.methods.items():
+                w = _Walker(fn, cls, index, mod.rel, f"{cls.qual}.{name}")
+                fn._cc_info = w.run()
+                infos.append((fn, cls, fn._cc_info))
+            for body in cls.thread_bodies:
+                if not hasattr(body, "_cc_info"):
+                    w = _Walker(body, cls, index, mod.rel,
+                                f"{cls.qual}.<closure:{body.name}>")
+                    body._cc_info = w.run()
+                    infos.append((body, cls, body._cc_info))
+        for name, fn in mod.functions.items():
+            holder = _ClassModel(f"<module>", mod, ast.ClassDef(
+                name="<module>", bases=[], keywords=[], body=[],
+                decorator_list=[]))
+            holder.module = mod
+            w = _Walker(fn, holder, index, mod.rel, f"{mod.stem}.{name}")
+            fn._cc_info = w.run()
+            infos.append((fn, None, fn._cc_info))
+
+    # interprocedural edges (one level: callee DIRECT acquisitions)
+    edges = []
+    for fn, cls, info in infos:
+        edges.extend(info.edges)
+        for callee, line, held in info.calls:
+            if not held:
+                continue
+            _ccls, cfn, _cmod = callee
+            cinfo = getattr(cfn, "_cc_info", None)
+            if cinfo is None:
+                continue
+            for lid, acq_line in cinfo.acquisitions:
+                if lid in held:
+                    continue
+                edges.append(Edge(
+                    held[-1], lid, info.file, line, held,
+                    via=f"{cinfo.qual}:{acq_line}"))
+    report.edges = edges
+
+    # cycles
+    succ = {}
+    nodes = set()
+    for e in edges:
+        for h in e.chain:
+            if h != e.b:
+                succ.setdefault(h, set()).add(e.b)
+                nodes.add(h)
+        nodes.add(e.b)
+    findings = []
+    for scc in _tarjan_sccs(sorted(nodes), succ):
+        if len(scc) < 2:
+            continue
+        members = set(scc)
+        cyc_edges = [e for e in edges
+                     if e.b in members and any(h in members
+                                               for h in e.chain)]
+        detail = "; ".join(sorted({e.describe() for e in cyc_edges}))
+        first = min(cyc_edges, key=lambda e: (e.file, e.line))
+        report.cycles.append(sorted(members))
+        findings.append((Finding(
+            "lock-order-cycle", first.file, first.line,
+            f"lock-order cycle between {{{', '.join(sorted(members))}}}: "
+            f"{detail}",
+            held=first.chain),
+            [(e.file, e.line) for e in cyc_edges]))
+
+    # blocking under lock
+    for fn, cls, info in infos:
+        for line, desc, held in info.blockings:
+            findings.append((Finding(
+                "blocking-under-lock", info.file, line,
+                f"{info.qual}: blocking call {desc} while holding "
+                f"{' -> '.join(held)}", held=held),
+                [(info.file, line)]))
+
+    # unguarded shared mutation
+    for mod in modules:
+        for cls in mod.classes.values():
+            ctx, ctx_names = _thread_context(cls, index)
+            if not ctx:
+                continue
+            # attrs visible outside the thread context
+            outside_access = set()
+            for name, fn in cls.methods.items():
+                if fn in ctx or name == "__init__":
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id == "self":
+                        outside_access.add(node.attr)
+            for fn, entry in ctx.items():
+                info = getattr(fn, "_cc_info", None)
+                if info is None:
+                    continue
+                for attr, line, held, desc in info.mutations:
+                    if held:
+                        continue
+                    shared = attr in outside_access or \
+                        not attr.startswith("_")
+                    if not shared:
+                        continue
+                    lock_hint = ", ".join(sorted(set(cls.locks.values()))) \
+                        or "none"
+                    findings.append((Finding(
+                        "unguarded-shared-mutation", mod.rel, line,
+                        f"{info.qual}: {desc} on thread path "
+                        f"'{entry}' with no lock held; attribute is "
+                        f"visible outside the thread (class locks: "
+                        f"{lock_hint})"), [(mod.rel, line)]))
+
+    # suppression filter
+    sup_by_file = {mod.rel: mod.suppressions for mod in modules}
+    for finding, sites in findings:
+        reason = None
+        # a suppression comment sits on the finding line itself or on
+        # the comment line directly above it — matched in EACH site's
+        # OWN file (a cycle's edges usually span files)
+        candidates = []
+        for f, ln in [(finding.file, finding.line)] + list(sites):
+            candidates += [(f, ln), (f, ln - 1)]
+        for f, ln in candidates:
+            reason = sup_by_file.get(f, {}).get(ln)
+            if reason is not None:
+                break
+        if reason is not None:
+            finding.suppress_reason = reason
+            report.suppressed.append(finding)
+        else:
+            report.findings.append(finding)
+    report.findings.sort(key=lambda f: (f.file, f.line))
+    report.suppressed.sort(key=lambda f: (f.file, f.line))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def scan_sources(sources):
+    """Analyze {label: python_source}. Labels stand in for file paths in
+    findings (the synthetic-control path)."""
+    modules = []
+    for label, src in sorted(sources.items()):
+        modules.append(_collect_module(label, label, src))
+    return _analyze(modules)
+
+
+def scan_paths(paths, exclude=()):
+    """Analyze every .py file under the given files/directories."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        elif p.endswith(".py"):
+            files.append(p)
+    files = [f for f in sorted(set(files))
+             if not any(x in f for x in exclude)]
+    common = os.path.commonpath(files) if len(files) > 1 else \
+        os.path.dirname(files[0]) if files else ""
+    modules = []
+    for f in files:
+        rel = os.path.relpath(f, common) if common else f
+        with open(f, encoding="utf-8") as fh:
+            modules.append(_collect_module(f, rel, fh.read()))
+    return _analyze(modules)

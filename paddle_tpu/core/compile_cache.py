@@ -39,6 +39,8 @@ import threading
 import time
 import zlib
 
+from paddle_tpu.observability import lockdep as _lockdep
+
 __all__ = [
     "program_fingerprint",
     "cache_dir",
@@ -60,10 +62,12 @@ _ENTRY_SUFFIX = ".ptcc"
 _MEM_CAP = 512
 _mem = {}  # insertion/use-ordered: dict move-to-end via pop+reinsert
 _inflight = {}
-_lock = threading.Lock()
+_lock = _lockdep.named_lock("compile.cache")
 
-# lazily-created metric handles (observability may not be imported yet at
-# module import time in subprocess workers)
+# lazily-created metric handles: avoid registering registry series in
+# processes that never build an entry (the lockdep import above pulls
+# the observability package at module import, so availability is no
+# longer the concern — series hygiene is)
 _counters = {}
 
 

@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.observability import lockdep
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.utils.enforce import EnforceError
 
@@ -243,8 +244,6 @@ class LoweredStep:
     )
 
     def __init__(self, fn, plan, fingerprint, source, build_seconds):
-        import threading
-
         (self.feed_names, self.fetch_names, self.donated, self.readonly,
          self.written, self.ops) = plan
         self.fn = fn
@@ -254,7 +253,7 @@ class LoweredStep:
         self.executed = False
         self.meta = {}
         self._aot = None
-        self._aot_lock = threading.Lock()
+        self._aot_lock = lockdep.named_lock("compile.aot")
 
     @property
     def scope_names(self):

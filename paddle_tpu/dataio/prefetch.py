@@ -156,3 +156,14 @@ class DevicePrefetcher:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            # bounded shutdown: the producer sees `stop` within one
+            # abortable-put poll; a daemon thread that outlives this is
+            # a bug we want joined-or-surfaced, not leaked silently
+            t.join(timeout=5.0)
+            if t.is_alive():
+                from paddle_tpu.observability.logger import get_logger
+
+                get_logger("dataio.prefetch").warning(
+                    "prefetch producer %s still alive 5s after abandon "
+                    "(blocked in device_put?); leaking daemon thread",
+                    t.name)

@@ -13,10 +13,10 @@ back as padded [B, maxlen] arrays plus a `<name>.lens` int64 vector
 
 import ctypes
 import os
-import threading
 
 import numpy as np
 
+from paddle_tpu.observability import lockdep
 from paddle_tpu.utils.enforce import enforce
 from paddle_tpu.utils.native import NativeBuildError, load_native
 
@@ -229,12 +229,12 @@ class DatasetBase:
         self._pad_to = {}
         self._truncated_rows = {}
         self._warned_truncate = set()
-        self._truncate_lock = threading.Lock()
+        self._truncate_lock = lockdep.named_lock("dataio.dataset.truncate")
         # the feed backend is a stateful cursor; passes may be driven
         # from pipeline threads (num_workers / DevicePrefetcher), so
         # access is lock-serialized and generation-stamped: starting a
         # new pass invalidates any still-running producer of the old one
-        self._feed_lock = threading.Lock()
+        self._feed_lock = lockdep.named_lock("dataio.dataset.feed")
         self._pass_gen = 0
 
     def truncated_row_counts(self):

@@ -27,6 +27,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.retry import RetryPolicy
 from paddle_tpu.utils.enforce import EnforceError, enforce
@@ -63,6 +64,11 @@ def _with_retry(fn, *args):
 _active = None
 _lock = threading.Lock()
 
+# intended hierarchy: prefetch-map lock before the push fence — today
+# every use is sequential (scan under one, wait under the other), and
+# the declaration keeps a future nesting honest
+lockdep.declare_order("lookup.prefetch", "lookup.push")
+
 
 def activate(ctx):
     global _active
@@ -92,8 +98,8 @@ class RemoteLookupContext:
         self._tables = {}  # table_name -> {"table_id", "dim"}
         self._pending = {}  # (name, ids digest) -> Future
         self._pool = ThreadPoolExecutor(max_workers=8)
-        self._plock = threading.Lock()
-        self._push_cv = threading.Condition()
+        self._plock = lockdep.named_lock("lookup.prefetch")
+        self._push_cv = lockdep.named_condition("lookup.push")
         self._last_fence = 0
         self._closed = False
         # observability: sync pulls vs prefetch hits (tests assert on these)

@@ -356,6 +356,7 @@ class Communicator:
             try:
                 self._flush(batch)
             except BaseException as e:
+                # lockdep: ok(single append from the one loop thread before it exits; list.append is atomic under the GIL and readers only probe emptiness then index 0)
                 self._err.append(e)
                 return
 

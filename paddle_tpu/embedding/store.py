@@ -39,11 +39,19 @@ import numpy as np
 
 from paddle_tpu.embedding.gather import dedup_ids, next_bucket
 from paddle_tpu.embedding.table import TableConfig
+from paddle_tpu.observability import lockdep
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.resilience import faults
 from paddle_tpu.utils.enforce import EnforceError, enforce
 
 __all__ = ["HostStore", "EmbeddingEngine", "STORE_PREFIX"]
+
+# The write-back discipline (PR 8 prose, now declared): the host-tier
+# TABLE lock comes before the PENDING-marker lock — a push worker
+# finishes store.push() before touching markers, and nothing may pull
+# from the table while holding the marker map (the stale-read guard
+# waits on futures OUTSIDE the lock instead).
+lockdep.declare_order("embedding.table", "embedding.pending")
 
 #: checkpoint array-name prefix — names carrying it are engine state, not
 #: scope variables (incubate/checkpoint.py routes them to the engine)
@@ -68,7 +76,7 @@ class HostStore:
     def __init__(self, cfg):
         self.cfg = cfg
         self._shards = [dict() for _ in range(cfg.ep)]
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("embedding.table")
 
     def __len__(self):
         with self._lock:
@@ -426,7 +434,7 @@ class EmbeddingEngine:
             max_workers=push_workers,
             thread_name_prefix="embedding-push",
         )
-        self._push_lock = threading.Lock()
+        self._push_lock = lockdep.named_lock("embedding.pending")
 
     @property
     def tables(self):

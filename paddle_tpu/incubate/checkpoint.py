@@ -54,8 +54,18 @@ import numpy as np
 from paddle_tpu.core.scope import global_scope
 from paddle_tpu.dataio.state import STATE_KEY, decode_state, encode_state
 from paddle_tpu.io import array_crc32
+from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.retry import RetryPolicy
+
+# The save path's declared hierarchy: the checkpoint writer-state lock
+# ("manifest") is ABOVE the sharded stores it snapshots through
+# extra_state.checkpoint_arrays() (the embedding host tier + its
+# pending-marker map) — never take manifest state while holding a shard
+# store, and never hold "checkpoint.manifest" across the (blocking)
+# flush itself.
+lockdep.declare_order("checkpoint.manifest", "embedding.table",
+                      "embedding.pending")
 
 __all__ = [
     "AutoCheckpoint",
@@ -653,7 +663,11 @@ class AutoCheckpoint:
         self._data_state = data_state
         self._extra_state = extra_state
         self._thread = None
-        self._lock = threading.Lock()
+        # guards _last_error/_pending: the async writer thread sets them
+        # while save()/close() on the training thread read-and-clear
+        # (found by the r11 concurrency audit — the lock existed but
+        # nothing acquired it)
+        self._lock = lockdep.named_lock("checkpoint.manifest")
         self._last_error = None
         self._pending = None  # (step, snap) of an in-flight/failed write
         self._retry = retry if retry is not None else _DEFAULT_IO_RETRY
@@ -804,9 +818,11 @@ class AutoCheckpoint:
                 snap[STATE_KEY] = encode_state(st)
         # one async writer at a time; a newer save supersedes a pending one
         self._join()
-        if self._last_error is not None:
+        with self._lock:
             err, self._last_error = self._last_error, None
-            self._pending = None
+            if err is not None:
+                self._pending = None
+        if err is not None:
             raise RuntimeError(
                 f"previous async checkpoint write failed: {err}"
             )
@@ -814,17 +830,22 @@ class AutoCheckpoint:
         def guarded():
             try:
                 self._write(step, snap)
-                self._pending = None
+                with self._lock:
+                    self._pending = None
             except Exception as e:  # surfaced on the NEXT save, or close()
                 log.error("async checkpoint write failed: %s", e)
-                self._last_error = e
+                with self._lock:
+                    self._last_error = e
 
         if blocking:
-            self._pending = (step, snap)
+            with self._lock:
+                self._pending = (step, snap)
             self._write(step, snap)
-            self._pending = None
+            with self._lock:
+                self._pending = None
         else:
-            self._pending = (step, snap)
+            with self._lock:
+                self._pending = (step, snap)
             self._thread = threading.Thread(target=guarded, daemon=True)
             self._thread.start()
 
@@ -883,13 +904,16 @@ class AutoCheckpoint:
         snapshot is still pending, retry it as a final blocking save
         first — only raise when the state truly could not be persisted."""
         self._join()
-        if self._last_error is not None:
+        with self._lock:
             err, self._last_error = self._last_error, None
-            if self._pending is not None:
-                step, snap = self._pending
+            pending = self._pending
+        if err is not None:
+            if pending is not None:
+                step, snap = pending
                 try:
                     self._write(step, snap)
-                    self._pending = None
+                    with self._lock:
+                        self._pending = None
                     log.warning(
                         "final blocking save of step %d recovered the "
                         "failed async write (%s)", step, err,
@@ -924,6 +948,8 @@ class HeartBeatMonitor:
         self._stop = threading.Event()
         self._thread = None
         self._seen = set()
+        # guards `lost`: the monitor thread adds while callers read
+        self._mu = lockdep.named_lock("resilience.heartbeat")
         self.lost = set()
 
     def _loop(self):
@@ -947,8 +973,11 @@ class HeartBeatMonitor:
                     ages = dict(ages)
                     ages[wid] = elapsed
             for wid, age in ages.items():
-                if age > self._timeout and wid not in self.lost:
-                    self.lost.add(wid)
+                with self._mu:
+                    newly = age > self._timeout and wid not in self.lost
+                    if newly:
+                        self.lost.add(wid)
+                if newly:
                     hb_log.warning(
                         "worker %d LOST: no heartbeat for %.1fs "
                         "(timeout %.1fs)", wid, age, self._timeout,
@@ -958,6 +987,17 @@ class HeartBeatMonitor:
             self._stop.wait(self._period)
 
     def start(self):
+        # idempotent while the monitor is RUNNING, restartable once it
+        # is not: a loop that self-terminated (heartbeat RPC failure)
+        # leaves a dead _thread behind, and a stop() whose join timed
+        # out keeps the stuck thread pinned here so start() cannot
+        # clear _stop underneath it (which would revive it NEXT TO a
+        # fresh one)
+        if self._thread is not None:
+            if self._thread.is_alive():
+                return self
+            self._thread = None
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -966,3 +1006,5 @@ class HeartBeatMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if not self._thread.is_alive():
+                self._thread = None

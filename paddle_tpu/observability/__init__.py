@@ -14,6 +14,9 @@ FetchHandler stack, rebuilt TPU-native):
   call fans out to the log, an instant trace event, and a counter).
 * ``fetcher``   — background periodic fetchers for long training loops
   (FetchHandlerMonitor) and registry scrapes (PeriodicMetricsDump).
+* ``lockdep``   — runtime lock-order witness: named lock classes, one
+  global may-acquire-while-holding graph, cycle + declared-hierarchy
+  violations raised at acquire time (env-gated, PADDLE_TPU_LOCKDEP=1).
 
 The legacy surfaces (``paddle_tpu.profiler``, ``serving.metrics``,
 ``resilience.supervisor`` events) are thin shims over this layer, so
@@ -54,6 +57,13 @@ from paddle_tpu.observability.fetcher import (
     FetchHandlerMonitor,
     PeriodicMetricsDump,
 )
+from paddle_tpu.observability import lockdep
+from paddle_tpu.observability.lockdep import (
+    LockOrderError,
+    declare_order,
+    named_condition,
+    named_lock,
+)
 
 __all__ = [
     "Tracer",
@@ -79,4 +89,9 @@ __all__ = [
     "sanitize_nan_inf",
     "FetchHandlerMonitor",
     "PeriodicMetricsDump",
+    "lockdep",
+    "LockOrderError",
+    "declare_order",
+    "named_condition",
+    "named_lock",
 ]

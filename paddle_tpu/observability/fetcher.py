@@ -17,6 +17,7 @@ with no scraper.
 import threading
 
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.lockdep import named_lock
 
 __all__ = ["FetchHandlerMonitor", "PeriodicMetricsDump"]
 
@@ -32,8 +33,14 @@ class _PeriodicThread:
         self._thread = None
 
     def start(self):
+        # idempotent while RUNNING, restartable once the thread is dead;
+        # a stop() whose join timed out keeps the stuck thread pinned
+        # here so start() cannot clear _stopping underneath it (which
+        # would revive it NEXT TO a fresh one)
         if self._thread is not None:
-            return self
+            if self._thread.is_alive():
+                return self
+            self._thread = None
         self._stopping = False
         self._thread = threading.Thread(
             target=self._run, name=type(self).__name__, daemon=True
@@ -55,6 +62,11 @@ class _PeriodicThread:
         self._stopping = True
         self._wake.set()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            # stuck in a user handler: keep it pinned (start() then
+            # refuses to revive it) and SKIP the final tick — running it
+            # here would make two concurrent _tick writers
+            return
         self._thread = None
         if final_tick:
             self._tick()
@@ -82,7 +94,7 @@ class FetchHandlerMonitor(_PeriodicThread):
         super().__init__(period_secs if period_secs is not None
                          else getattr(handler, "period_secs", 60))
         self.handler = handler
-        self._lock = threading.Lock()
+        self._lock = named_lock("observability.fetcher")
         self._latest = None
         self.deliveries = 0
 
@@ -100,6 +112,7 @@ class FetchHandlerMonitor(_PeriodicThread):
             return
         try:
             self.handler.handler(latest)
+            # lockdep: ok(one writer at a time: the loop thread, or stop()'s final tick strictly AFTER a successful join — stop() skips the final tick when the join times out)
             self.deliveries += 1
         except Exception:
             # a user handler must not kill the monitor (nor the loop)
@@ -132,4 +145,5 @@ class PeriodicMetricsDump(_PeriodicThread):
             import os
 
             os.replace(tmp, self._target)
+        # lockdep: ok(one writer at a time: the loop thread, or stop()'s final tick strictly after a successful join)
         self.dumps += 1

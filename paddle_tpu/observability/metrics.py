@@ -15,6 +15,8 @@ import bisect
 import re
 import threading
 
+from paddle_tpu.observability.lockdep import named_lock
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -60,6 +62,11 @@ class _Metric:
         self.name = name
         self.help = help
         self.labels = labels  # sorted (k, v) tuple
+        # deliberately a RAW lock, not a lockdep named one: series locks
+        # sit on per-op hot paths (every counter inc), and they are a
+        # statically-proven LEAF — no acquisition ever nests inside one
+        # (tools/lint_concurrency.py would report an edge if that
+        # changed), so they cannot participate in a cycle
         self._lock = threading.Lock()
 
 
@@ -251,7 +258,7 @@ class MetricsRegistry:
     Prometheus exposition invariant)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.registry")
         self._series = {}   # (name, label_key) -> metric
         self._families = {}  # name -> (kind, help)
 

@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from paddle_tpu import profiler
+from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import NEG_INF, DecodeModel
@@ -58,6 +59,12 @@ from paddle_tpu.serving.request import (
 )
 
 __all__ = ["GenerationEngine", "GenerationRequest"]
+
+# The scheduler takes the queue lock, then the tenant table inside it
+# (_admit_free_slots -> _pick); PR 10's ABBA fix (quota rejects estimate
+# retry-after OUTSIDE _tenant_lock) exists precisely to preserve this.
+# Declared so a future inversion names the RULE, not just the cycle.
+lockdep.declare_order("serving.queue", "decode.tenant")
 
 
 class GenerationRequest:
@@ -174,18 +181,21 @@ class _ModelEntry:
                                          for n in kv], False),
             ("inject", m.inject_program, m.inject_feed_sig(), [], True),
         )
+        sources = dict(self.compile_sources)
         with profiler.RecordEvent("decode::warmup"):
             for kind, prog, feed_sig, fetches, donate in plans:
                 entry, source = lowering.lower_step(
                     prog, self._scope, feed_sig, fetches, donate=donate,
                     label=f"decode:{m.label}:{kind}",
                 )
-                self.compile_sources[source] = (
-                    self.compile_sources.get(source, 0) + 1)
+                sources[source] = sources.get(source, 0) + 1
                 executable = entry.aot_compile(
                     lowering.abstract_signature(entry, feed_sig,
                                                 self._scope))
                 self._entries[kind] = (entry, executable)
+        # atomic rebind, not in-place mutation: a breaker relaunch runs
+        # this on the loop thread while stats() dict-copies concurrently
+        self.compile_sources = sources
 
     def _run(self, kind, feeds):
         """Execute one lowered program against the entry scope; written
@@ -604,11 +614,11 @@ class GenerationEngine:
         self._entries = {}        # (name, version) -> _ModelEntry
         self._latest = {}         # name -> version (last registered)
         self._tenants = {}        # tenant -> _TenantState
-        self._tenant_lock = threading.Lock()
+        self._tenant_lock = lockdep.named_lock("decode.tenant")
         self._vclock = 0.0        # engine-wide virtual time (last dispatch)
         self._started = False
         self._next_id = 0
-        self._id_lock = threading.Lock()
+        self._id_lock = lockdep.named_lock("decode.ids")
 
     # -- model registry ---------------------------------------------------
     def register_model(self, model):

@@ -15,10 +15,11 @@ tests/test_decode.py.
 """
 
 import hashlib
-import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from paddle_tpu.observability import lockdep
 
 __all__ = ["SlotPool", "PrefixCache", "prompt_key"]
 
@@ -81,7 +82,7 @@ class PrefixCache:
     def __init__(self, capacity=64):
         self.capacity = int(capacity)
         self._map = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("decode.prefix")
         self.hits = 0
         self.misses = 0
 

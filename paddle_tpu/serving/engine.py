@@ -36,6 +36,7 @@ import time
 import numpy as np
 
 from paddle_tpu import profiler
+from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
 from paddle_tpu.serving.batcher import BatchPlan, BucketLattice, DynamicBatcher
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -64,7 +65,7 @@ class _ReplicaBreaker:
         self.state = "closed"
         self.consecutive = 0
         self.opened_at = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.named_lock("serving.breaker")
 
     def gate(self):
         """Dispatch decision: ('dispatch' | 'probe' | 'wait', wait_s)."""
@@ -161,7 +162,7 @@ class ServingEngine:
         self._stop = False
         self._started = False
         self._next_id = 0
-        self._id_lock = threading.Lock()
+        self._id_lock = lockdep.named_lock("serving.ids")
         self._warm_base = {"hits": 0, "misses": 0}
 
     # -- lifecycle ---------------------------------------------------------

@@ -23,9 +23,9 @@ numbers flow through `stats()` into the C-ABI stats JSON.
 """
 
 import itertools
-import threading
 
 from paddle_tpu import profiler
+from paddle_tpu.observability import lockdep
 from paddle_tpu.observability import metrics as obs_metrics
 from paddle_tpu.serving.request import Priority
 
@@ -85,10 +85,10 @@ class ServingMetrics:
             for lane, name in LANE_NAMES.items()
         }
         self._tenant_counts = {}  # (counter_name, tenant) -> Counter
-        self._tenant_lock = threading.Lock()
+        self._tenant_lock = lockdep.named_lock("serving.metrics.tenant")
         # batches/batched_rows/occupancy must move together for the
         # derived averages in snapshot() to be consistent
-        self._batch_lock = threading.Lock()
+        self._batch_lock = lockdep.named_lock("serving.metrics.batch")
         # a ServingMetrics instance is one engine LIFETIME: re-creating an
         # engine under a reused label must start from zero (the registry
         # series are get-or-create, so without this a restart would resume

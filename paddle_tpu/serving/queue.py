@@ -20,12 +20,16 @@ caller's SLO died waiting" are different capacity problems.
 Locking: the queue owns an RLock (`queue.lock`); single calls take it
 internally, and the engine's batcher takes it around compound
 scan-and-remove operations (and builds its dispatch Condition on it).
+The lock is lockdep-named ``serving.queue`` — under
+``PADDLE_TPU_LOCKDEP=1`` every acquisition order against other named
+classes (``decode.tenant`` et al.) is witnessed; see README
+"Concurrency discipline".
 """
 
-import threading
 import time
 from collections import deque
 
+from paddle_tpu.observability import lockdep
 from paddle_tpu.serving.request import Priority, RejectedError
 
 __all__ = ["RequestQueue"]
@@ -39,7 +43,7 @@ _EWMA_ALPHA = 0.3
 class RequestQueue:
     def __init__(self, max_depth=256):
         self.max_depth = int(max_depth)
-        self.lock = threading.RLock()
+        self.lock = lockdep.named_lock("serving.queue", rlock=True)
         self._lanes = {p: deque() for p in Priority.LANES}
         self._depth = 0
         self._closed = False

@@ -157,6 +157,15 @@ def _queued(queue, rid, tenant, priority=Priority.NORMAL):
     return req
 
 
+def _pick_locked(engine, queue):
+    """_pick's documented contract: the caller holds queue.lock (the
+    scheduler calls it under its dispatch Condition). The lockdep witness
+    enforces the declared serving.queue -> decode.tenant order, so the
+    hand-stepped tests must honor the contract too."""
+    with queue.lock:
+        return engine._pick(queue)
+
+
 def test_weighted_fair_pick_honors_stride_shares():
     """Under contention, a weight-2 tenant wins two slots for every one a
     weight-1 tenant wins (deterministic stride scheduling on the picker,
@@ -169,7 +178,7 @@ def test_weighted_fair_pick_honors_stride_shares():
         _queued(queue, i, "a" if i % 2 == 0 else "b")
     wins = {"a": 0, "b": 0}
     for _ in range(30):
-        wins[engine._pick(queue).tenant] += 1
+        wins[_pick_locked(engine, queue).tenant] += 1
     assert wins["a"] == 20 and wins["b"] == 10, wins
 
 
@@ -181,10 +190,10 @@ def test_pick_strict_priority_lanes_before_fairness():
     queue = RequestQueue(max_depth=64)
     for i in range(4):
         _queued(queue, i, "busy")
-        engine._pick(queue)  # banks virtual time for 'busy'
+        _pick_locked(engine, queue)  # banks virtual time for 'busy'
     _queued(queue, 100, "fresh")                      # NORMAL lane
     _queued(queue, 101, "busy", priority=Priority.HIGH)
-    assert engine._pick(queue).id == 101
+    assert _pick_locked(engine, queue).id == 101
 
 
 def test_pick_skips_tenant_at_in_flight_cap():
@@ -194,11 +203,11 @@ def test_pick_skips_tenant_at_in_flight_cap():
     queue = RequestQueue(max_depth=64)
     _queued(queue, 1, "capped")
     _queued(queue, 2, "other")
-    assert engine._pick(queue).tenant == "other"
+    assert _pick_locked(engine, queue).tenant == "other"
     # only the capped tenant queued -> nothing admissible, req stays queued
-    assert engine._pick(queue) is None
+    assert _pick_locked(engine, queue) is None
     engine._tenant("capped").in_flight = 0
-    assert engine._pick(queue).tenant == "capped"
+    assert _pick_locked(engine, queue).tenant == "capped"
 
 
 def test_pick_reserves_in_flight_so_one_round_cannot_exceed_cap():
@@ -210,14 +219,14 @@ def test_pick_reserves_in_flight_so_one_round_cannot_exceed_cap():
     queue = RequestQueue(max_depth=64)
     _queued(queue, 1, "capped")
     _queued(queue, 2, "capped")
-    first = engine._pick(queue)
+    first = _pick_locked(engine, queue)
     assert first.tenant == "capped"
     assert engine._tenant("capped").in_flight == 1
     # same round, second free slot: the reservation blocks the pick
-    assert engine._pick(queue) is None
+    assert _pick_locked(engine, queue) is None
     # retire the first -> the second request becomes admissible
     engine._tenant_unflight("capped")
-    assert engine._pick(queue).id == 2
+    assert _pick_locked(engine, queue).id == 2
 
 
 def test_idle_tenant_reenters_at_vtime_floor():
@@ -230,10 +239,10 @@ def test_idle_tenant_reenters_at_vtime_floor():
     queue = RequestQueue(max_depth=256)
     for i in range(10):
         _queued(queue, i, "active")
-        engine._pick(queue)  # active's vtime climbs to 10
+        _pick_locked(engine, queue)  # active's vtime climbs to 10
     for i in range(10, 18):
         _queued(queue, i, "active" if i % 2 == 0 else "idle")
-    picks = [engine._pick(queue).tenant for _ in range(8)]
+    picks = [_pick_locked(engine, queue).tenant for _ in range(8)]
     # never more than 2 consecutive wins for the returning tenant
     for k in range(len(picks) - 2):
         assert len(set(picks[k:k + 3])) > 1, picks
@@ -491,11 +500,11 @@ def test_pick_rounds_sample_drain_rate_once_per_round():
     for i in range(8):
         _queued(q, i, "t")
     for _ in range(4):                 # admission round 1 (4 free slots)
-        assert engine._pick(q) is not None
+        assert _pick_locked(engine, q) is not None
     q.note_drained()
     time.sleep(0.02)
     for _ in range(4):                 # admission round 2
-        assert engine._pick(q) is not None
+        assert _pick_locked(engine, q) is not None
     q.note_drained()
     rate = q.stats()["drain_rate_rows_per_s"]
     # 4 rows per ~20ms round is O(200) rows/s; per-pick sampling would
