@@ -45,9 +45,14 @@ def spawn_gang(
     coordinator=None,
     extra_env=None,
     devices_per_proc=None,
+    ranks=None,
 ):
-    """Spawn `nproc` worker processes running `script_args` with the fleet
-    env contract injected; returns the list of Popen handles (rank order)."""
+    """Spawn worker processes running `script_args` with the fleet env
+    contract injected; returns the list of Popen handles (in `ranks`
+    order). `ranks` defaults to the whole gang; passing a subset spawns
+    only those ranks into the SAME endpoint layout (pin `started_port`
+    so a respawned rank rejoins the original endpoints) — the
+    supervisor's replica-grained `restart(rank)` relies on this."""
     started_port = started_port or _free_port()
     endpoints = ",".join(
         f"127.0.0.1:{started_port + i}" for i in range(nproc)
@@ -60,7 +65,7 @@ def spawn_gang(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     procs = []
-    for rank in range(nproc):
+    for rank in (range(nproc) if ranks is None else ranks):
         env = dict(os.environ)
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (pkg_root, env.get("PYTHONPATH")) if p

@@ -21,7 +21,8 @@ from paddle_tpu.resilience.retry import RetryPolicy
 from paddle_tpu.utils.enforce import enforce
 from paddle_tpu.utils.native import load_native
 
-__all__ = ["PSServer", "PSClient", "Communicator"]
+__all__ = ["PSServer", "PSClient", "Communicator", "frame_send",
+           "frame_recv"]
 
 CMD_CREATE = 1
 CMD_PULL_SPARSE = 2
@@ -38,6 +39,34 @@ CMD_STATS = 12
 
 OPT_SGD = 0
 OPT_ADAGRAD = 1
+
+
+# -- the shared wire framing -------------------------------------------------
+# ONE definition of the '<I'-length-prefixed frame protocol: the PS
+# client below and the fleet replica transport
+# (serving/fleet/{replica,worker}.py) all speak it — a framing fix
+# lands once, here.
+
+
+def _read_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def frame_send(sock, body):
+    """Send one length-prefixed frame."""
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def frame_recv(sock):
+    """Read one length-prefixed frame; ConnectionError on EOF."""
+    (blen,) = struct.unpack("<I", _read_exact(sock, 4))
+    return _read_exact(sock, blen)
 
 
 class PSServer:
@@ -112,15 +141,12 @@ class PSClient:
     # -- wire helpers ------------------------------------------------------
     def _rpc(self, server, cmd, table_id, payload=b""):
         body = struct.pack("<BI", cmd, table_id) + payload
-        msg = struct.pack("<I", len(body)) + body
 
         def exchange():
             faults.fire("ps.rpc")
             s = self._socks[server]
-            s.sendall(msg)
-            hdr = self._read_full(s, 4)
-            (blen,) = struct.unpack("<I", hdr)
-            return self._read_full(s, blen)
+            frame_send(s, body)
+            return frame_recv(s)
 
         def repair(exc, attempt):
             if isinstance(exc, (ConnectionError, OSError)) and not isinstance(
@@ -154,16 +180,6 @@ class PSClient:
                 f"PS rpc cmd={cmd} failed: {body[1:].decode(errors='replace')}"
             )
         return body[1:]
-
-    @staticmethod
-    def _read_full(s, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = s.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("PS connection closed")
-            buf += chunk
-        return buf
 
     # -- API ---------------------------------------------------------------
     def create_table(self, table_id, dim=0, dense_size=0, init_range=0.01,

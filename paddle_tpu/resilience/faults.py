@@ -34,6 +34,25 @@ fires; ``times`` bounds how often (default 1, -1 = unlimited);
 file so a RESTARTED process replaying the same steps does not re-fire
 them — that is what makes kill-at-step-N schedules convergent under a
 supervised restart loop.
+
+Fleet failover sites (r12, ``serving/fleet/`` + tools/chaos_serve.py):
+
+* ``fleet.dispatch`` — fired before every router->replica dispatch
+  (``rank`` = target replica index). ``raise`` makes THIS dispatch
+  attempt fail: the router fails over to another replica, invisible to
+  the caller.
+* ``fleet.health``  — fired before every router heartbeat probe
+  (``rank`` = probed replica index). ``raise`` is a failed probe:
+  consecutive ones open the replica's circuit breaker (quarantine).
+* ``replica.kill``  — the replica-death site. In-process replicas fire
+  it on every heartbeat: ``action: "raise"`` latches the handle DEAD
+  (simulated crash — the router re-dispatches its in-flight work).
+  Subprocess workers fire it at the top of every RPC they serve:
+  ``action: "kill"`` hard-exits the worker process (``os._exit``)
+  mid-traffic, the real thing. ``rank`` selects WHICH replica dies;
+  note ``at_call`` counts ALL calls at the site across ranks, so pair
+  it with ``rank`` only in single-replica-firing setups (e.g. one
+  worker process counting its own RPCs).
 """
 
 import json

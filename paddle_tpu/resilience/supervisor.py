@@ -22,6 +22,18 @@ knows where to touch. Hang detection is opt-in via ``hang_timeout_s``.
     sup = GangSupervisor(["train.py"], nproc=4, max_restarts=2,
                          checkpoint_dirs=["/ckpt"], hang_timeout_s=300)
     codes = sup.run()   # [0, 0, 0, 0] or raises GangFailedError
+
+Replica-grained restarts (serving fleets, not SPMD gangs): a training
+gang is all-or-nothing — one dead rank wedges every collective, so
+``run()`` restarts the WHOLE gang. A fleet of serving replicas is the
+opposite: replicas share nothing, so killing the survivors to revive
+one is an outage invented by the supervisor. ``launch()`` spawns the
+gang without the watch loop and ``restart(rank)`` terminates +
+respawns exactly ONE rank into the same endpoint slot (same
+PADDLE_TRAINER_ID, same port), leaving the rest undisturbed — each
+restart is a structured ``rank_restart`` event and a
+``resilience_events_total{kind=rank_restart}`` counter, with per-rank
+counts in ``rank_restarts``.
 """
 
 import logging
@@ -89,6 +101,9 @@ class GangSupervisor:
         self.heartbeat_dir = heartbeat_dir
         self.events = []
         self.restarts = 0
+        self.rank_restarts = {}   # rank -> replica-grained restart count
+        self._procs = None
+        self._spawn_port = None
 
     # -- events ----------------------------------------------------------
     def _emit(self, kind, **fields):
@@ -154,25 +169,86 @@ class GangSupervisor:
                 log.warning("checkpoint dir %s unreadable: %s", d, e)
         return resume
 
+    # -- spawning --------------------------------------------------------
+    def _gang_env(self):
+        env = dict(self.extra_env)
+        if self.heartbeat_dir:
+            env[HEARTBEAT_DIR_ENV] = self.heartbeat_dir
+        return env
+
+    def launch(self, attempt=0):
+        """Spawn the gang WITHOUT the watch/relaunch loop — the
+        fleet-router usage: replicas are supervised individually via
+        `restart(rank)` rather than gang-atomically. Returns the Popen
+        list (also kept as `self._procs`)."""
+        from paddle_tpu.distributed.launch import _free_port, spawn_gang
+
+        if self.heartbeat_dir:
+            self._clear_heartbeats()
+        if self._spawn_port is None:
+            # pin the endpoint layout now so a respawned rank rejoins
+            # the SAME slot later
+            self._spawn_port = self.started_port or _free_port()
+        self._procs = spawn_gang(
+            self.script_args, nproc=self.nproc,
+            started_port=self._spawn_port, extra_env=self._gang_env(),
+            devices_per_proc=self.devices_per_proc,
+        )
+        self._emit("gang_start", attempt=attempt,
+                   pids=[p.pid for p in self._procs])
+        return self._procs
+
+    def restart(self, rank):
+        """Replica-grained restart: terminate + respawn exactly ONE
+        rank into its original endpoint slot, leaving every other rank
+        undisturbed. Clears only that rank's heartbeat, counts the
+        restart per-rank, and emits a structured `rank_restart` event
+        (mirrored to the metrics registry and profiler like every
+        supervisor decision)."""
+        from paddle_tpu.distributed.launch import spawn_gang, terminate_gang
+
+        if self._procs is None:
+            raise RuntimeError("no gang launched; call launch()/run() first")
+        rank = int(rank)
+        old = self._procs[rank]
+        if old.poll() is None:
+            terminate_gang([old], grace_s=self.grace_s)
+        exit_code = old.poll()
+        if self.heartbeat_dir:
+            try:
+                os.remove(os.path.join(self.heartbeat_dir, f"hb_{rank}"))
+            except OSError:
+                pass
+        new = spawn_gang(
+            self.script_args, nproc=self.nproc,
+            started_port=self._spawn_port, extra_env=self._gang_env(),
+            devices_per_proc=self.devices_per_proc, ranks=[rank],
+        )[0]
+        self._procs[rank] = new
+        self.rank_restarts[rank] = self.rank_restarts.get(rank, 0) + 1
+        self._emit("rank_restart", rank=rank, old_code=exit_code,
+                   pid=new.pid, count=self.rank_restarts[rank])
+        return new
+
+    def procs(self):
+        return list(self._procs or [])
+
+    def terminate(self):
+        """Stop every live rank (fleet shutdown path)."""
+        from paddle_tpu.distributed.launch import terminate_gang
+
+        if self._procs:
+            terminate_gang(self._procs, grace_s=self.grace_s)
+
     # -- the loop --------------------------------------------------------
     def run(self):
-        from paddle_tpu.distributed.launch import spawn_gang, terminate_gang
+        from paddle_tpu.distributed.launch import terminate_gang
 
         backoff = self.restart_backoff_s
         attempt = 0
         while True:
-            env = dict(self.extra_env)
-            if self.heartbeat_dir:
-                self._clear_heartbeats()
-                env[HEARTBEAT_DIR_ENV] = self.heartbeat_dir
             attempt_start = time.monotonic()
-            procs = spawn_gang(
-                self.script_args, nproc=self.nproc,
-                started_port=self.started_port, extra_env=env,
-                devices_per_proc=self.devices_per_proc,
-            )
-            self._emit("gang_start", attempt=attempt,
-                       pids=[p.pid for p in procs])
+            procs = self.launch(attempt=attempt)
             failure = self._watch(procs, attempt_start)
             if failure is None:
                 codes = [p.poll() for p in procs]
@@ -194,6 +270,12 @@ class GangSupervisor:
             resume = self._validate_checkpoints()
             self._emit("restart", attempt=attempt, backoff_s=backoff,
                        resume_from=resume, failure=failure)
+            if self.started_port is None:
+                # whole-gang restart: take a FRESH port layout per
+                # attempt (the crashed gang's listeners may sit in
+                # TIME_WAIT). Pinning is only for replica-grained
+                # restart(rank), which rejoins a LIVE gang's slots.
+                self._spawn_port = None
             time.sleep(backoff)
             backoff *= self.backoff_multiplier
 

@@ -31,6 +31,10 @@ Layers (each its own module, composable and separately testable):
   v2): iteration-level scheduler over a slotted KV arena, multi-tenant
   model registry, AOT warm start (`GenerationEngine`, `DecodeModel`,
   `build_decoder_model`).
+* `fleet`    — the multi-replica tier (serving v3): `FleetRouter` over
+  N engine replicas with prefix-affinity routing, health-tracked
+  at-most-once-visible re-dispatch, load shedding, autoscaling, and
+  rolling deploys (`LocalReplica`, `SubprocessReplica`).
 """
 
 from paddle_tpu.serving.batcher import BucketLattice, DynamicBatcher
@@ -40,12 +44,18 @@ from paddle_tpu.serving.decode import (
     build_decoder_model,
 )
 from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.fleet import (
+    FleetRouter,
+    LocalReplica,
+    SubprocessReplica,
+)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.queue import RequestQueue
 from paddle_tpu.serving.request import (
     DeadlineExceededError,
     Priority,
     RejectedError,
+    ReplicaLostError,
     Request,
     RequestError,
     Response,
@@ -57,10 +67,14 @@ __all__ = [
     "DeadlineExceededError",
     "DecodeModel",
     "DynamicBatcher",
+    "FleetRouter",
     "GenerationEngine",
+    "LocalReplica",
+    "SubprocessReplica",
     "build_decoder_model",
     "Priority",
     "RejectedError",
+    "ReplicaLostError",
     "Request",
     "RequestError",
     "RequestQueue",

@@ -54,6 +54,7 @@ from paddle_tpu.serving.request import (
     DeadlineExceededError,
     Priority,
     RejectedError,
+    ReplicaLostError,
     RequestError,
     Response,
 )
@@ -364,7 +365,7 @@ class _ModelEntry:
                     self._breaker_event(self._breaker.record_failure())
                 for s, st in enumerate(self._slots):
                     if st is not None:
-                        self._reject_in_flight(st.request, RequestError(
+                        self._reject_in_flight(st.request, ReplicaLostError(
                             f"request {st.request.id} lost to arena "
                             f"failure during admission: {e}"), slot=s)
                 self._reset_arenas()
@@ -473,7 +474,7 @@ class _ModelEntry:
                 self._breaker_event(self._breaker.record_failure())
             for s in list(active):
                 st = self._slots[s]
-                self._reject_in_flight(st.request, RequestError(
+                self._reject_in_flight(st.request, ReplicaLostError(
                     f"request {st.request.id} lost to decode-step failure: "
                     f"{e}"), slot=s)
             self._reset_arenas()
@@ -613,6 +614,7 @@ class GenerationEngine:
         self._hbm_budget_mb = hbm_budget_mb
         self._entries = {}        # (name, version) -> _ModelEntry
         self._latest = {}         # name -> version (last registered)
+        self._reg_order = []      # keys in registration order (latest wins)
         self._tenants = {}        # tenant -> _TenantState
         self._tenant_lock = lockdep.named_lock("decode.tenant")
         self._vclock = 0.0        # engine-wide virtual time (last dispatch)
@@ -636,9 +638,50 @@ class GenerationEngine:
         ).build()
         self._entries[model.key] = entry
         self._latest[model.name] = model.version
+        self._reg_order.append(model.key)
         if self._started:
             entry.start()
         return entry
+
+    def unregister_model(self, name, version, timeout=60.0):
+        """Retire one hosted (model, version): graceful DRAIN-BEFORE-
+        RETIRE — admission to the entry closes, queued and in-flight
+        generations finish, THEN the entry leaves the registry. The
+        rolling-deploy path calls this for the old version once the new
+        one serves; `latest` falls back to the newest still-hosted
+        version of the name (registration order)."""
+        key = (str(name), str(version))
+        entry = self._entries.get(key)
+        if entry is None:
+            raise ValueError(
+                f"no model {name}@{version} to unregister; hosted: "
+                f"{['@'.join(k) for k in sorted(self._entries)]}")
+        entry.shutdown(timeout)
+        del self._entries[key]
+        self._reg_order.remove(key)
+        remaining = [v for n, v in self._reg_order if n == key[0]]
+        if remaining:
+            self._latest[key[0]] = remaining[-1]
+        else:
+            self._latest.pop(key[0], None)
+        return entry
+
+    def reroute_queued(self, name=None, version=None):
+        """Pull every QUEUED (not yet prefilled) request off one entry's
+        admission queue for re-dispatch elsewhere — the fleet router's
+        drain accelerator: instead of waiting for a retiring/deploying
+        replica to chew through its backlog, the backlog moves to
+        healthy replicas with its original deadlines intact. In-flight
+        slots are untouched (they finish here). Returns the removed
+        GenerationRequests; their responses never complete — the caller
+        owns re-dispatching them."""
+        entry = self._resolve(name, version)
+        with entry._cond:
+            reqs = [r for r in entry._queue.iter_requests()]
+            entry._queue.reroute(reqs)
+        for r in reqs:
+            self._tenant_unqueue(r.tenant)
+        return reqs
 
     def _check_hbm(self, model):
         """Static pre-compile gate: decode-step peak HBM (the arena is
@@ -792,11 +835,16 @@ class GenerationEngine:
     # -- admission --------------------------------------------------------
     def submit(self, prompt_ids, model=None, version=None, tenant="default",
                priority=Priority.NORMAL, max_new_tokens=16,
-               deadline_ms=None):
+               deadline_ms=None, deadline_at=None):
         """Admit one generation request; returns its Response future
         (``result()`` -> ``{"tokens": int64 array}``). Raises structured
         RejectedError on invalid prompts, over-quota tenants, or a full
-        queue (with a measured retry-after)."""
+        queue (with a measured retry-after). ``deadline_at`` is an
+        ABSOLUTE ``time.perf_counter()`` deadline (it wins over
+        ``deadline_ms``): a re-dispatched request carries its ORIGINAL
+        deadline through the retry instead of being granted a fresh
+        budget — the fleet router's at-most-once-visible failover
+        depends on this."""
         entry = self._resolve(model, version)
         m = entry.model
         tenant = str(tenant)
@@ -823,8 +871,11 @@ class GenerationEngine:
                 f"({quota[0]}/{quota[1]} queued)",
                 retry_after_s=entry._queue.retry_after_estimate(1),
             )
-        deadline = (time.perf_counter() + deadline_ms / 1e3
-                    if deadline_ms is not None else None)
+        if deadline_at is not None:
+            deadline = float(deadline_at)
+        else:
+            deadline = (time.perf_counter() + deadline_ms / 1e3
+                        if deadline_ms is not None else None)
         with self._id_lock:
             self._next_id += 1
             rid = self._next_id

@@ -152,6 +152,7 @@ class ServingMetrics:
             "queue_drain_rate_rows_per_s": qs["drain_rate_rows_per_s"],
             "queue_rejected_at_admission": qs["rejected_at_admission"],
             "queue_expired_in_queue": qs["expired_in_queue"],
+            "queue_rerouted": qs["rerouted"],
         }
 
     def observe_batch(self, plan, run_seconds):

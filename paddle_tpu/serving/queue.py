@@ -15,7 +15,11 @@ THIS request. Callers may still pass an explicit hint (the engine's
 batch-rate model) — the queue reports whichever is larger, so backoff
 never undershoots either signal. Deadline expiries are counted apart
 from admission rejections (`stats()`): "we were too full" and "the
-caller's SLO died waiting" are different capacity problems.
+caller's SLO died waiting" are different capacity problems. Requests
+pulled back out for re-dispatch on another replica (`reroute()`, the
+fleet router's failover and drain-before-retire paths) are a third
+outcome — counted separately again, because a rerouted request is
+still served, just elsewhere.
 
 Locking: the queue owns an RLock (`queue.lock`); single calls take it
 internally, and the engine's batcher takes it around compound
@@ -53,6 +57,7 @@ class RequestQueue:
         self._deferred_rows = 0
         self._rejected_full = 0
         self._expired_in_queue = 0
+        self._rerouted = 0
 
     # -- admission ---------------------------------------------------------
     def put(self, request, retry_after_s=None):
@@ -190,6 +195,18 @@ class RequestQueue:
             else:
                 self._note_drained(rows, time.perf_counter())
 
+    def reroute(self, requests):
+        """Remove admitted requests for RE-DISPATCH on another replica
+        (fleet failover / drain-before-retire): the rows leave this
+        queue like any dispatch, but the outcome is counted apart from
+        both rejections and expiries — a rerouted request is still going
+        to be SERVED, just elsewhere. The request objects keep their
+        absolute deadline, so the re-dispatching caller inherits the
+        remaining budget rather than a fresh one."""
+        self.remove(requests)
+        with self.lock:
+            self._rerouted += len(requests)
+
     def note_drained(self):
         """Sample the rows of `remove(batch=True)` calls accumulated
         since the last sample as ONE drain event (call once per
@@ -221,6 +238,7 @@ class RequestQueue:
                 "drain_rate_rows_per_s": self._drain_rate,
                 "rejected_at_admission": self._rejected_full,
                 "expired_in_queue": self._expired_in_queue,
+                "rerouted": self._rerouted,
             }
 
     def empty(self):

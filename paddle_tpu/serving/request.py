@@ -17,6 +17,7 @@ __all__ = [
     "RejectedError",
     "DeadlineExceededError",
     "RequestError",
+    "ReplicaLostError",
     "Request",
     "Response",
 ]
@@ -72,6 +73,19 @@ class RequestError(ServingError):
     guarantee: a RequestError never propagates to batchmates."""
 
     code = "request_failed"
+
+
+class ReplicaLostError(RequestError):
+    """The REPLICA failed while this request was in flight (a donated
+    decode step or arena inject died, or the process hosting it went
+    away) — the request itself was fine. Distinguished from
+    `RequestError` because the fleet router's failover treats the two
+    oppositely: a replica-lost request is transparently re-dispatched to
+    a healthy replica (decode is bit-deterministic, so the retried
+    answer is byte-identical), while a request-attributed failure is
+    delivered — retrying a poison request elsewhere just spreads it."""
+
+    code = "replica_lost"
 
 
 class Response:

@@ -459,6 +459,36 @@ def _drive_dataio_evidence():
     _dataio_digest(0, num_workers=2, prefetch=True)
 
 
+def _drive_fleet_evidence():
+    """Fleet router + local replicas with NO pump or scheduler threads:
+    submit (routing reads the replica queue depth under fleet.router —
+    the hierarchy's top edge), a replica death, the parked re-dispatch,
+    a hand-stepped completion, and the delivering tick — every
+    acquisition on this thread."""
+    from paddle_tpu.serving.decode import GenerationEngine
+    from paddle_tpu.serving.fleet import FleetRouter, LocalReplica
+
+    router = FleetRouter(health_interval_s=0.0)  # health pass each tick
+    for i in range(2):
+        engine = GenerationEngine(queue_depth=8, breaker_threshold=0,
+                                  label=f"lockdep-fleet-{i}")
+        engine.register_model(
+            lambda: _small_decode_model("evidence", slots=2, max_len=8))
+        router.add_replica(LocalReplica(f"r{i}", i, engine))
+    resp = router.submit([1, 2], max_new_tokens=1)
+    (rr,) = router._inflight.values()
+    victim = rr.replica
+    router._replicas[victim].kill()
+    router._mark_dead(victim, "evidence")
+    router._tick()          # health pass + re-dispatch of the parked rr
+    assert rr.replica is not None and rr.replica != victim
+    entry = router._replicas[rr.replica].engine.entry("evidence", "1")
+    entry._admit_free_slots()   # prefill fast path finishes max_new=1
+    router._tick()              # poll + deliver
+    assert resp.done() and resp.error() is None
+    router.stats()
+
+
 def evidence_sections(tmpdir=None):
     """Run every deterministic driver under an armed, reset lockdep and
     return the evidence payload {lockdep, static}. The SAME function
@@ -491,6 +521,7 @@ def evidence_sections(tmpdir=None):
         _drive_embedding_evidence(tmpdir)
         _drive_metrics_evidence()
         _drive_dataio_evidence()
+        _drive_fleet_evidence()
         snap = lockdep.snapshot()
     finally:
         lockdep.reset()
