@@ -922,6 +922,14 @@ def _r_gather(ctx):
     ctx.set("Out", tuple(idx_shape) + tuple(x.shape[1:]), x.dtype)
 
 
+@rule("scatter")
+def _r_scatter(ctx):
+    x = ctx.first("X")
+    if x is None or x.shape is None:
+        return
+    ctx.set("Out", tuple(x.shape), x.dtype)
+
+
 @rule("slice")
 def _r_slice(ctx):
     x = ctx.first("Input")

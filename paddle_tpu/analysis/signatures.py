@@ -147,6 +147,7 @@ _SIGNATURES = {
     "one_hot": OpSignature(dtype_family={"X": "int"}),
     "batched_gather": OpSignature(dtype_family={"Index": "int"}),
     "gather": OpSignature(dtype_family={"Index": "int"}),
+    "scatter": OpSignature(dtype_family={"Ids": "int"}),
     "stack": OpSignature(same_dtype=[("X",)]),
     "slice": OpSignature(),
     "split": OpSignature(),

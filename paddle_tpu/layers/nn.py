@@ -28,6 +28,8 @@ __all__ = [
     "kv_cache_write",
     "masked_write",
     "cached_attention",
+    "block_gather",
+    "block_scatter_write",
     "moe_ffn",
     "dropout",
     "softmax",
@@ -583,7 +585,9 @@ def kv_cache_write(cache, new_kv, write_onehot, name=None):
     cache[s, l]``. ``cache`` is ``[S, L, H]``, ``new_kv`` ``[S, H]``, and
     ``write_onehot`` a ``[S, L]`` float mask that is one-hot at each
     sequence's write cursor (an all-zero row leaves that sequence's cache
-    bit-untouched — how the decode engine freezes inactive slots).
+    bit-untouched — how a dense slotted cache freezes inactive slots;
+    the serving engine's PAGED arena uses `block_scatter_write` with
+    row indices instead, same exactness contract).
 
     Returns the updated cache; callers persist it with
     ``layers.assign(out, output=cache_var)`` so the lowering donates the
@@ -596,8 +600,9 @@ def kv_cache_write(cache, new_kv, write_onehot, name=None):
 def masked_write(cache, new, mask, name=None):
     """``cache*(1-mask) + new*mask`` for a 0/1 float ``mask``
     broadcastable against both operands — THE bit-exactness-critical
-    masked update shared by every slotted-arena write (`kv_cache_write`'s
-    per-position one-hot, the decode inject program's per-slot mask).
+    masked update for dense slotted-arena writes (`kv_cache_write`'s
+    per-position one-hot; the paged decode programs scatter by row
+    index instead — `block_scatter_write`).
 
     Composes multiply/add on existing ops instead of a scatter. Both
     branches are exact in IEEE arithmetic (``x*1.0 == x``,
@@ -609,6 +614,39 @@ def masked_write(cache, new, mask, name=None):
         elementwise_mul(cache, keep),
         elementwise_mul(new, mask),
     )
+
+
+def block_gather(arena, rows, seqs, length, name=None):
+    """Gather a per-sequence KV view out of a flat paged arena:
+    ``arena`` ``[R, H]`` + flat row indices ``rows`` ``[seqs * length]``
+    -> ``[seqs, length, H]``. The row feed is the device half of a block
+    table (vLLM's PagedAttention layout): position ``p`` of sequence
+    ``s`` reads arena row ``rows[s * length + p]`` =
+    ``block_table[s][p // bs] * bs + p % bs``. Rows at masked positions
+    (beyond the sequence's cursor) may point anywhere — the additive
+    ``-1e9`` attention bias makes their contribution exactly 0.0, the
+    same contract that hides stale rows in the slotted design.
+
+    Gather relocates rows byte-for-byte, so attention over the gathered
+    view is bit-identical to attention over a dense per-slot arena
+    holding the same rows — the paged rebuild's exactness argument."""
+    from paddle_tpu.layers.tensor import gather, reshape
+
+    flat = gather(arena, rows, name=name)              # [seqs*length, H]
+    return reshape(flat, [int(seqs), int(length), -1])
+
+
+def block_scatter_write(arena, rows, new_rows, name=None):
+    """Write ``new_rows`` ``[N, H]`` into flat paged arena ``arena``
+    ``[R, H]`` at row indices ``rows`` ``[N]``, functionally (callers
+    persist with ``assign`` so the lowering donates the arena and XLA
+    updates in place). An index >= R means "this row writes NOWHERE"
+    (``mode="drop"``) — how retired/inactive batch slots stay
+    bit-untouched without changing the compiled shape."""
+    from paddle_tpu.layers.tensor import scatter
+
+    return scatter(arena, rows, new_rows, overwrite=True, mode="drop",
+                   name=name)
 
 
 def cached_attention(q, k_cache, v_cache, attn_bias, sm_scale=1.0,

@@ -332,14 +332,19 @@ def gather_nd(input, index, name=None):
     return out
 
 
-def scatter(input, index, updates, overwrite=True, name=None):
+def scatter(input, index, updates, overwrite=True, mode=None, name=None):
+    """Row scatter. ``mode="drop"`` skips out-of-range indices instead
+    of clamping — the paged KV arena's "write nowhere" encoding."""
     helper = LayerHelper("scatter", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"overwrite": overwrite}
+    if mode is not None:
+        attrs["mode"] = mode
     helper.append_op(
         "scatter",
         {"X": [input.name], "Ids": [index.name], "Updates": [updates.name]},
         {"Out": [out.name]},
-        {"overwrite": overwrite},
+        attrs,
     )
     return out
 

@@ -480,22 +480,41 @@ def _dgc_momentum(ins, attrs):
         keep = (jnp.arange(k_max) < jnp.maximum(k_dyn, 1)).astype(v_acc.dtype)
         vals = v_acc[top_idx] * keep
         n = lax.psum(1, dgc_axis)
-        # THE wire: 2*k*n floats instead of `size` — the honest DGC saving
-        all_idx = lax.all_gather(top_idx, dgc_axis)           # [n, k]
-        all_vals = lax.all_gather(vals, dgc_axis)             # [n, k]
-        sparse_update = (
-            jnp.zeros((size,), v_acc.dtype)
-            .at[all_idx.reshape(-1)]
-            .add(all_vals.reshape(-1)) / n
-        ).reshape(p.shape)
-        dense_update = lax.pmean(contrib, dgc_axis)
-        update = jnp.where(is_dense, dense_update, sparse_update)
-        sent = jnp.zeros((size,), bool).at[top_idx].set(keep > 0)
-        sent = sent.reshape(p.shape)
-        u_out = jnp.where(is_dense, u_new, jnp.where(sent, 0.0, u_new))
-        v_out = jnp.where(
-            is_dense, v, jnp.where(sent, 0.0, v_acc.reshape(p.shape))
+
+        def _sparse(_):
+            # THE wire: 2*k*n floats instead of `size` — the honest DGC
+            # saving
+            all_idx = lax.all_gather(top_idx, dgc_axis)       # [n, k]
+            all_vals = lax.all_gather(vals, dgc_axis)         # [n, k]
+            sparse_update = (
+                jnp.zeros((size,), v_acc.dtype)
+                .at[all_idx.reshape(-1)]
+                .add(all_vals.reshape(-1)) / n
+            ).reshape(p.shape)
+            sent = jnp.zeros((size,), bool).at[top_idx].set(keep > 0)
+            sent = sent.reshape(p.shape)
+            return (sparse_update,
+                    jnp.where(sent, 0.0, u_new),
+                    jnp.where(sent, 0.0, v_acc.reshape(p.shape)))
+
+        def _dense(_):
+            return lax.pmean(contrib, dgc_axis), u_new, v
+
+        # phase select around lax.cond, not jnp.where: where() evaluates
+        # BOTH sides, so the rampup pmean put a dense all-reduce on the
+        # wire during the sparse phase. A schedule that is STATICALLY
+        # sparse (rampup_begin <= 0 and every sparsity entry > 0 — the
+        # production DGC config) prunes the dense branch entirely: the
+        # compiled module carries no dense all-reduce at all; a genuinely
+        # dynamic schedule keeps both branches but executes only one.
+        statically_sparse = (
+            float(begin) <= 0.0
+            and min(float(x) for x in attrs.get("sparsity", [0.999])) > 0.0
         )
+        if statically_sparse:
+            update, u_out, v_out = _sparse(None)
+        else:
+            update, u_out, v_out = lax.cond(is_dense, _dense, _sparse, None)
         return {
             "ParamOut": [p - lr.astype(p.dtype) * update],
             "UOut": [u_out[None]],

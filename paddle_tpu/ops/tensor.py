@@ -240,10 +240,13 @@ def _gather_nd(ins, attrs):
 @register_op("scatter", nondiff_inputs=("Ids",))
 def _scatter(ins, attrs):
     x, ids, updates = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")
+    # mode="drop" silently skips out-of-range rows — the paged decode
+    # arena's "this batch slot writes nowhere" encoding (feed row R)
+    kw = {"mode": attrs["mode"]} if attrs.get("mode") else {}
     if attrs.get("overwrite", True):
-        out = x.at[ids.reshape(-1)].set(updates)
+        out = x.at[ids.reshape(-1)].set(updates, **kw)
     else:
-        out = x.at[ids.reshape(-1)].add(updates)
+        out = x.at[ids.reshape(-1)].add(updates, **kw)
     return {"Out": [out]}
 
 

@@ -1,27 +1,35 @@
-"""Continuous-batching decode subsystem (online serving v2).
+"""Continuous-batching decode subsystem (online serving v3).
 
 The PR-2 engine (serving/engine.py) schedules at REQUEST granularity:
 whole requests coalesce into fixed (batch, seq) buckets and a finished
 sequence holds its rows until the slowest batchmate drains. This package
-schedules at ITERATION granularity (Orca, OSDI'22) over a slotted KV
-arena (the fixed-shape analog of vLLM's paged KV, SOSP'23): a decode
-batch of S slots is stepped once per model iteration through ONE
-compiled ``[S, 1]`` executable, finished sequences retire between
-iterations, and admitted prompts prefill into free slots mid-flight.
+schedules at ITERATION granularity (Orca, OSDI'22) over a **paged KV
+arena** (vLLM's PagedAttention block tables, SOSP'23): a decode batch of
+S slots is stepped once per model iteration through ONE compiled
+``[S, 1]`` executable, finished sequences retire between iterations,
+admitted prompts prefill into free slots mid-flight, KV storage is
+allocated block-by-block so memory scales with USED tokens, and prompts
+sharing a prefix share PHYSICAL blocks through a radix tree over chained
+block hashes (copy-on-write at divergence). Long prompts stream through
+a budgeted chunk-prefill program interleaved with decode iterations, and
+a draft-model **speculative decoding** path (Leviathan et al.) emits
+multiple greedy-exact tokens per target forward.
 
 Modules:
 
-* `model`  — `DecodeModel`: the three-program (decode step / prefill /
-  inject) fixed-shape contract + `build_decoder_model`, the canonical
-  cached-attention decoder builder.
-* `pool`   — host-side slot allocator + content-hash prefix cache over
-  prefill results (shared-prefix dedup).
+* `model`  — `DecodeModel`: the fixed-shape paged-program contract
+  (decode step / prefill / inject / optional chunk prefill) +
+  `build_decoder_model`, the canonical cached-attention decoder builder.
+* `pool`   — host-side slot allocator, block allocator + radix prefix
+  index (storage dedup), and the content-hash prefill cache (compute
+  dedup).
 * `engine` — `GenerationEngine`: multi-tenant model registry, weighted-
   fair admission over the queue's priority lanes, the per-entry
-  scheduler loop, circuit-breaker relaunch, and AOT warm start through
-  the compile cache.
+  scheduler loop (decode steps, chunked prefill, speculative verify
+  cycles), circuit-breaker relaunch, and AOT warm start through the
+  compile cache.
 * `metrics`— `DecodeMetrics`: the serving counter set + occupancy /
-  tokens-per-step / step-latency series.
+  tokens-per-step / block-pool / speculative-acceptance series.
 """
 
 from paddle_tpu.serving.decode.engine import (
@@ -30,15 +38,23 @@ from paddle_tpu.serving.decode.engine import (
 )
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import DecodeModel, build_decoder_model
-from paddle_tpu.serving.decode.pool import PrefixCache, SlotPool, prompt_key
+from paddle_tpu.serving.decode.pool import (
+    BlockPool,
+    PrefixCache,
+    SlotPool,
+    block_hashes,
+    prompt_key,
+)
 
 __all__ = [
+    "BlockPool",
     "DecodeMetrics",
     "DecodeModel",
     "GenerationEngine",
     "GenerationRequest",
     "PrefixCache",
     "SlotPool",
+    "block_hashes",
     "build_decoder_model",
     "prompt_key",
 ]

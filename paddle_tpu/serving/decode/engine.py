@@ -1,4 +1,4 @@
-"""GenerationEngine: continuous-batching decode over slotted KV arenas.
+"""GenerationEngine: continuous-batching decode over a paged KV arena.
 
 The PR-2 ServingEngine batches whole requests into fixed buckets — a
 finished sequence holds its rows until the whole bucket drains. This
@@ -8,33 +8,53 @@ of S slots is stepped once per model iteration through ONE compiled
 iterations and admitted prompts prefill into free slots mid-flight, so
 occupancy tracks offered load instead of the slowest batchmate.
 
-Correctness contract (tested, not asserted by construction alone):
-generation is bit-identical to offline whole-sequence decode for the
-same prompt, regardless of admission order, slot assignment, or what the
-other slots are doing — because (a) retired/foreign slots touch the
-arena only through multiply-by-zero writes (exact no-ops in IEEE
-arithmetic), and (b) the additive ``-1e9`` attention bias makes
-positions beyond a slot's cursor contribute exactly 0.0 (the repo-wide
-padding contract).
+Storage is a **block-granular paged arena** (vLLM's PagedAttention,
+SOSP'23): KV rows live in fixed-size blocks handed out by
+``pool.BlockPool``; the compiled programs see only flat row-index feeds,
+so HBM scales with USED tokens, prompts sharing a prefix share PHYSICAL
+blocks through the radix index (copy-on-write at divergence), and the
+arena is sized against ``analysis/memory.py``'s pre-compile HBM gate
+instead of reserving a dense ``slots x max_len`` grid.
+
+Scheduling modes, all bit-identical to the offline whole-sequence
+reference for any admission order (tested, not asserted by construction
+alone):
+
+* **decode** — the ``[S, 1]`` hot path, as in PR 10.
+* **chunked prefill** — a prompt longer than the chunk budget streams
+  through the ``[1, C]`` chunk program ONE chunk per engine iteration,
+  interleaved with decode steps, so a 32k-token admission never stalls
+  in-flight generations for more than one chunk's compute. Chunks fully
+  covered by radix-shared blocks are skipped (shared prefixes share
+  prefill work AND storage).
+* **speculative** — a draft model (just another ``(model, version)``
+  registry entry) greedily proposes k tokens; the target verifies all
+  of them in ONE batch-prefill forward and emits the longest matching
+  prefix plus its own correction token. Greedy acceptance makes the
+  output BIT-IDENTICAL to target-only decode; the win is target
+  steps-per-emitted-token < 1.
+
+Correctness contract: (a) retired/foreign slots touch the arena only
+through dropped or disjoint row scatters (exact no-ops), and (b) the
+additive ``-1e9`` attention bias makes positions beyond a slot's cursor
+contribute exactly 0.0 (the repo-wide padding contract); gather/scatter
+relocate rows byte-for-byte, so the paged rebuild preserves PR 10's
+bit-exactness property for every block size.
 
 Multi-tenancy: one engine hosts N ``(model, version)`` entries, each with
 its own slot batch, queue, and scheduler thread. Admission applies
 per-tenant quotas (queued rows reject at the door; in-flight caps make
 the picker skip, not reject) and WEIGHTED-FAIR selection layered over the
-queue's strict priority lanes: within the head non-empty lane, the
-tenant with the smallest virtual time wins the free slot and pays
-``1/weight`` virtual time for it (stride scheduling), so a tenant with
-weight 2 gets two slots for every one a weight-1 tenant gets — under
-contention, and only then.
+queue's strict priority lanes (stride scheduling).
 
-Cold start: the three executables per entry lower through
-``core/lowering.py`` into the content-addressed compile cache. With
-``PADDLE_TPU_CACHE_DIR`` set, a fresh replica (or the circuit breaker's
-relaunched replacement) restores decode/prefill/inject from the
-``jax.export`` disk tier with ZERO traces — subprocess-asserted in
-tests/test_decode.py. Before anything compiles, the KV arena is sized
-against the peak-HBM budget via ``analysis/memory.py`` — an oversized
-``slots x max_len`` grid fails with sizing advice, not an XLA OOM.
+Cold start: the executables per entry lower through ``core/lowering.py``
+into the content-addressed compile cache. With ``PADDLE_TPU_CACHE_DIR``
+set, a fresh replica (or the circuit breaker's relaunched replacement)
+restores them from the ``jax.export`` disk tier with ZERO traces —
+subprocess-asserted in tests/test_decode.py. Before anything compiles,
+the paged arena is sized against the peak-HBM budget via
+``analysis/memory.py`` — an oversized block pool fails with sizing
+advice, not an XLA OOM.
 """
 
 import threading
@@ -47,7 +67,12 @@ from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import NEG_INF, DecodeModel
-from paddle_tpu.serving.decode.pool import PrefixCache, SlotPool, prompt_key
+from paddle_tpu.serving.decode.pool import (
+    BlockPool,
+    PrefixCache,
+    SlotPool,
+    prompt_key,
+)
 from paddle_tpu.serving.engine import _ReplicaBreaker
 from paddle_tpu.serving.queue import RequestQueue
 from paddle_tpu.serving.request import (
@@ -71,12 +96,16 @@ lockdep.declare_order("serving.queue", "decode.tenant")
 class GenerationRequest:
     """One admitted generation request (rows is always 1: a request holds
     one slot). `response.result()` yields ``{"tokens": int64 array}`` —
-    the generated tokens, including the stop token when eos fired."""
+    the generated tokens, including the stop token when eos fired.
+    ``draft_key`` (a registry ``(name, version)``) opts the request into
+    speculative decoding with ``spec_k`` proposals per verify cycle."""
 
     __slots__ = ("id", "prompt", "max_new", "tenant", "priority", "deadline",
-                 "submit_time", "dispatch_time", "response", "rows")
+                 "submit_time", "dispatch_time", "response", "rows",
+                 "draft_key", "spec_k")
 
-    def __init__(self, rid, prompt, max_new, tenant, priority, deadline):
+    def __init__(self, rid, prompt, max_new, tenant, priority, deadline,
+                 draft_key=None, spec_k=0):
         self.id = rid
         self.prompt = list(prompt)
         self.max_new = int(max_new)
@@ -87,6 +116,8 @@ class GenerationRequest:
         self.dispatch_time = None
         self.response = Response()
         self.rows = 1
+        self.draft_key = draft_key
+        self.spec_k = int(spec_k)
 
     def expired(self, now=None):
         if self.deadline is None:
@@ -95,8 +126,8 @@ class GenerationRequest:
 
 
 class _ArenaInvalidError(RuntimeError):
-    """A DONATED arena update (inject) failed mid-execution: the old
-    buffers were consumed and the new ones never materialized, so the
+    """A DONATED arena update (inject/chunk) failed mid-execution: the
+    old buffers were consumed and the new ones never materialized, so the
     whole KV pool — not just the admitting request — is undefined."""
 
 
@@ -114,21 +145,36 @@ class _TenantState:
 
 
 class _Slot:
-    """Host-side state of one live arena slot."""
+    """Host-side state of one live batch slot.
 
-    __slots__ = ("request", "cursor", "last_token", "generated")
+    ``mode`` is "decode" (stepping through the [S,1] program),
+    "prefill" (a long prompt streaming through the chunk program), or
+    "spec" (speculative verify cycles — holds no arena blocks).
+    ``blocks`` is the slot's block table; ``row_map[p]`` the physical
+    arena row of position ``p`` (the device half of the table)."""
 
-    def __init__(self, request, cursor, first_token):
+    __slots__ = ("request", "mode", "cursor", "last_token", "generated",
+                 "blocks", "row_map", "plen", "done", "shared_len", "toks")
+
+    def __init__(self, request, mode="decode"):
         self.request = request
-        self.cursor = cursor          # next arena position to write
-        self.last_token = first_token
-        self.generated = [first_token]
+        self.mode = mode
+        self.cursor = 0
+        self.last_token = None
+        self.generated = []
+        self.blocks = []
+        self.row_map = None
+        self.plen = len(request.prompt)
+        self.done = 0           # chunked prefill: prompt positions landed
+        self.shared_len = 0     # positions served by radix-shared blocks
+        self.toks = None        # spec mode: prompt + emitted so far
 
 
 class _ModelEntry:
     """One hosted (model, version): programs + executables + slot batch +
-    its scheduler thread. All slot/arena mutation happens on the loop
-    thread; admission hand-off goes through the queue."""
+    block pool + its scheduler thread. All slot/arena/block mutation
+    happens on the loop thread; admission hand-off goes through the
+    queue."""
 
     def __init__(self, engine, model, queue_depth, breaker_threshold,
                  breaker_cooldown_s, prefix_cache_size):
@@ -138,6 +184,7 @@ class _ModelEntry:
         self._cond = threading.Condition(self._queue.lock)
         self._pool = SlotPool(model.slots)
         self._slots = [None] * model.slots
+        self._blocks = BlockPool(model.num_blocks, model.block_size)
         self._prefix = PrefixCache(prefix_cache_size)
         self._breaker = (
             _ReplicaBreaker(breaker_threshold, breaker_cooldown_s)
@@ -151,14 +198,15 @@ class _ModelEntry:
         self._stop = False
         self._scope = None
         self._rng0 = None
+        self._pref_rr = 0       # round-robin cursor over prefilling slots
         # half-open relaunch latch: one rebuild per breaker episode
         self._probe_relaunched = False
 
     # -- build / warmup ---------------------------------------------------
     def build(self):
         """Run startup (weights + zeroed arenas into the scope), then
-        lower + AOT-compile the three executables. With a warm compile
-        cache nothing here traces (`compile_sources` says so)."""
+        lower + AOT-compile the executables. With a warm compile cache
+        nothing here traces (`compile_sources` says so)."""
         import paddle_tpu as fluid
         from paddle_tpu.core.lowering import zero_rng_key
 
@@ -174,14 +222,17 @@ class _ModelEntry:
         from paddle_tpu.core import lowering
 
         m = self._model
-        plans = (
+        plans = [
             ("step", m.decode_program, m.decode_feed_sig(),
              [m.logits_fetch], True),
             ("prefill", m.prefill_program, m.prefill_feed_sig(),
              [m.prefill_logits_fetch] + [n for kv in m.prefill_kv_fetches
                                          for n in kv], False),
             ("inject", m.inject_program, m.inject_feed_sig(), [], True),
-        )
+        ]
+        if m.chunk_program is not None:
+            plans.append(("chunk", m.chunk_program, m.chunk_feed_sig(),
+                          [m.chunk_logits_fetch], True))
         sources = dict(self.compile_sources)
         with profiler.RecordEvent("decode::warmup"):
             for kind, prog, feed_sig, fetches, donate in plans:
@@ -219,8 +270,9 @@ class _ModelEntry:
         return fetches
 
     def _reset_arenas(self):
-        """Zero the KV pool and drop all slot state (relaunch path: a
-        failed donated call leaves the old arena buffers invalid)."""
+        """Zero the KV pool and drop all slot/block state (relaunch
+        path: a failed donated call leaves the old arena buffers
+        invalid)."""
         import jax
         import jax.numpy as jnp
 
@@ -228,9 +280,10 @@ class _ModelEntry:
         for kn, vn in m.state_names:
             for n in (kn, vn):
                 self._scope.set(n, jax.device_put(
-                    jnp.zeros((m.slots, m.max_len, m.hidden), jnp.float32),
+                    jnp.zeros((m.rows, m.hidden), jnp.float32),
                     self._engine.device))
         self._pool.reset()
+        self._blocks.reset()
         self._slots = [None] * m.slots
 
     def relaunch(self):
@@ -271,46 +324,58 @@ class _ModelEntry:
 
     # -- scheduler loop ---------------------------------------------------
     def _loop(self):
-        while True:
-            with self._cond:
-                for r in self._queue.expire():
-                    self._reject_expired(r)
-                if (self._stop and self._queue.empty()
-                        and self._pool.active_count == 0):
-                    return
-            if self._breaker is not None and not self._stop:
-                verdict, wait_s = self._breaker.gate()
-                if verdict == "wait":
-                    with self._cond:
-                        for r in self._queue.expire():
-                            self._reject_expired(r)
-                        if not self._stop:
-                            self._cond.wait(timeout=min(wait_s, 0.1))
-                    continue
-                if verdict == "probe" and not self._probe_relaunched:
-                    # re-admission probe IS a relaunch: fresh programs,
-                    # zeroed arena, executables from the compile cache —
-                    # ONCE per half-open episode (the flag); the probe
-                    # STEP's outcome then closes or reopens the breaker,
-                    # so an idle engine doesn't rebuild every loop tick
-                    self._metrics.incr("breaker_probes")
-                    try:
-                        self.relaunch()
-                        self._probe_relaunched = True
-                    except Exception:
-                        self._breaker_event(self._breaker.record_failure())
-                        continue
-            admitted = self._admit_free_slots()
-            if self._pool.active_count == 0:
-                # nothing decodable AND this round admitted nothing —
-                # either the queue is empty, or everything queued is
-                # blocked on a tenant cap held by another entry's
-                # in-flight work; poll, don't spin
+        while not self._iterate():
+            pass
+
+    def _iterate(self):
+        """ONE scheduler iteration: expire, (breaker), admit up to the
+        free slots, advance AT MOST ONE prefill chunk, run one verify
+        cycle per speculative slot, then one decode step. Extracted so
+        tests can hand-step the interleaving deterministically. Returns
+        True when the loop should exit."""
+        with self._cond:
+            for r in self._queue.expire():
+                self._reject_expired(r)
+            if (self._stop and self._queue.empty()
+                    and self._pool.active_count == 0):
+                return True
+        if self._breaker is not None and not self._stop:
+            verdict, wait_s = self._breaker.gate()
+            if verdict == "wait":
                 with self._cond:
-                    if not self._stop and not admitted:
+                    for r in self._queue.expire():
+                        self._reject_expired(r)
+                    if not self._stop:
+                        self._cond.wait(timeout=min(wait_s, 0.1))
+                return False
+            if verdict == "probe" and not self._probe_relaunched:
+                # re-admission probe IS a relaunch: fresh programs,
+                # zeroed arena, executables from the compile cache —
+                # ONCE per half-open episode (the flag); the probe
+                # STEP's outcome then closes or reopens the breaker,
+                # so an idle engine doesn't rebuild every loop tick
+                self._metrics.incr("breaker_probes")
+                try:
+                    self.relaunch()
+                    self._probe_relaunched = True
+                except Exception:
+                    self._breaker_event(self._breaker.record_failure())
+                    return False
+        admitted = self._admit_free_slots()
+        progressed = self._advance_prefills() + self._advance_spec()
+        if not any(st is not None and st.mode == "decode"
+                   for st in self._slots):
+            # nothing decodable AND this round moved nothing — either
+            # the queue is empty, or everything queued is blocked on a
+            # tenant cap held by another entry's in-flight work; poll,
+            # don't spin
+            if not admitted and not progressed:
+                with self._cond:
+                    if not self._stop:
                         self._cond.wait(timeout=0.02)
-                continue
-            self._step()
+            return False
+        self._step()
+        return False
 
     def _reject_expired(self, request):
         self._metrics.incr("deadline_missed")
@@ -324,7 +389,7 @@ class _ModelEntry:
         if event:
             self._metrics.incr(event)
 
-    # -- admission (prefill + inject into a free slot) --------------------
+    # -- admission (blocks + prefill/inject into a free slot) -------------
     def _admit_free_slots(self):
         picked = []
         with self._cond:
@@ -382,10 +447,58 @@ class _ModelEntry:
                 self._metrics.observe_request(req)
         return len(picked)
 
+    def _row_of(self, st, p):
+        b = st.blocks[p // self._model.block_size]
+        return b.row0 + p % self._model.block_size
+
+    def _rebuild_row_map(self, st):
+        m = self._model
+        bs = m.block_size
+        if st.row_map is None:
+            st.row_map = np.zeros(m.max_len, dtype="int64")
+        for i, b in enumerate(st.blocks):
+            lo = i * bs
+            hi = min(lo + bs, m.max_len)
+            st.row_map[lo:hi] = b.row0 + np.arange(hi - lo)
+
+    def _acquire_blocks(self, req):
+        blocks, shared_len = self._blocks.acquire_for_prompt(req.prompt)
+        if blocks is None:
+            self._metrics.incr("blocks_exhausted")
+            raise RuntimeError(
+                f"block pool exhausted ({self._blocks.stats()['blocks_free']}"
+                f" free of {self._model.num_blocks}); shorten the prompt, "
+                "retire traffic, or host the model with more blocks")
+        return blocks, shared_len
+
     def _prefill_into(self, req, slot):
         m = self._model
         req.dispatch_time = time.perf_counter()
+        if req.draft_key is not None:
+            # speculative: no arena footprint — verification re-derives
+            # every KV it needs inside the (stateless) batch prefill
+            st = _Slot(req, mode="spec")
+            st.toks = list(req.prompt)
+            self._slots[slot] = st
+            self._metrics.incr("admitted")
+            self._metrics.tenant_incr("admitted", req.tenant)
+            return
         prompt = req.prompt
+        plen = len(prompt)
+        if (m.chunk_tokens and "chunk" in self._entries
+                and plen > m.chunk_tokens):
+            blocks, shared_len = self._acquire_blocks(req)
+            st = _Slot(req, mode="prefill")
+            st.blocks = blocks
+            st.shared_len = shared_len
+            # the FINAL chunk always runs (it produces the last-position
+            # logits), even when the radix served every block
+            st.done = min(shared_len, plen - 1)
+            self._rebuild_row_map(st)
+            self._slots[slot] = st
+            self._metrics.incr("admitted")
+            self._metrics.tenant_incr("admitted", req.tenant)
+            return
         key = prompt_key(prompt)
         cached = self._prefix.get(key)
         if cached is not None:
@@ -405,26 +518,47 @@ class _ModelEntry:
             logits_row = np.array(logits[0, len(prompt) - 1])
             self._prefix.put(key, kv_rows, logits_row)
             self._metrics.observe_prefill(time.perf_counter() - t0)
-        inj = {DecodeModel.INJ_SLOT:
-               np.eye(m.slots, dtype="float32")[slot][:, None, None]}
-        for i, (kn, vn) in enumerate(m.inject_kv_feeds):
-            inj[kn] = kv_rows[2 * i]
-            inj[vn] = kv_rows[2 * i + 1]
-        try:
-            with profiler.RecordEvent("decode::inject"):
-                faults.fire("decode.inject")
-                self._run("inject", inj)
-        except Exception as e:
-            raise _ArenaInvalidError(str(e)) from e
+        blocks, shared_len = self._acquire_blocks(req)
+        st = _Slot(req, mode="decode")
+        st.blocks = blocks
+        st.shared_len = shared_len
+        self._rebuild_row_map(st)
+        if shared_len < plen:
+            # inject ONLY the non-shared suffix: shared blocks already
+            # hold byte-identical rows (same tokens -> same prefix ->
+            # same KV bytes)
+            inj_rows = np.full((m.max_len,), m.rows, dtype="int64")
+            inj_rows[shared_len:plen] = st.row_map[shared_len:plen]
+            inj = {DecodeModel.INJ_ROWS: inj_rows}
+            for i, (kn, vn) in enumerate(m.inject_kv_feeds):
+                inj[kn] = kv_rows[2 * i]
+                inj[vn] = kv_rows[2 * i + 1]
+            try:
+                with profiler.RecordEvent("decode::inject"):
+                    faults.fire("decode.inject")
+                    self._run("inject", inj)
+            except Exception as e:
+                raise _ArenaInvalidError(str(e)) from e
+
+        def host_rows(start, stop):
+            return [(np.array(kv_rows[2 * i][0, start:stop]),
+                     np.array(kv_rows[2 * i + 1][0, start:stop]))
+                    for i in range(len(m.state_names))]
+
+        self._blocks.register_prompt_blocks(blocks, prompt,
+                                            host_rows=host_rows)
         first = int(np.argmax(logits_row))
-        self._slots[slot] = _Slot(req, len(prompt), first)
+        st.cursor = plen
+        st.last_token = first
+        st.generated = [first]
+        self._slots[slot] = st
         self._metrics.incr("admitted")
         # the prefill's first token: counted apart from generated_tokens
         # so tokens_per_step stays a decode-step quantity (<= S)
         self._metrics.incr("prefill_tokens")
         self._metrics.tenant_incr("admitted", req.tenant)
         self._metrics.tenant_incr("tokens", req.tenant)
-        if self._finished(self._slots[slot]):
+        if self._finished(st):
             self._retire(slot)
 
     def _prefill_feeds(self, prompt):
@@ -438,46 +572,268 @@ class _ModelEntry:
                 DecodeModel.PRE_POSITIONS: pos,
                 DecodeModel.PRE_BIAS: bias}
 
+    # -- chunked prefill ---------------------------------------------------
+    def _advance_prefills(self):
+        """Process ONE budgeted chunk for ONE prefilling slot
+        (round-robin): the per-iteration prompt work is bounded by
+        ``chunk_tokens``, which is the fairness contract — in-flight
+        decode slots stall for at most one chunk's compute per admitted
+        long prompt."""
+        m = self._model
+        pref = [s for s in range(m.slots)
+                if self._slots[s] is not None
+                and self._slots[s].mode == "prefill"]
+        if not pref:
+            return 0
+        s = pref[self._pref_rr % len(pref)]
+        self._pref_rr += 1
+        st = self._slots[s]
+        req = st.request
+        if req.expired():
+            self._reject_in_flight(req, DeadlineExceededError(
+                f"deadline expired during chunked prefill after "
+                f"{st.done}/{st.plen} tokens"), slot=s)
+            return 1
+        C, L, R = m.chunk_tokens, m.max_len, m.rows
+        start = st.done
+        stop = min(start + C, st.plen)
+        real = stop - start
+        toks = np.zeros((1, C), "int64")
+        toks[0, :real] = req.prompt[start:stop]
+        pos = np.zeros((1, C), "int64")
+        pos[0, :real] = np.arange(start, stop)
+        bias = np.full((1, C, L), NEG_INF, "float32")
+        bias[0, :real] = np.where(
+            np.arange(L)[None, :] <= (start + np.arange(real))[:, None],
+            np.float32(0.0), np.float32(NEG_INF))
+        wrows = np.full((C,), R, dtype="int64")
+        for c in range(real):
+            p = start + c
+            if p >= st.shared_len:   # never rewrite radix-shared rows
+                wrows[c] = st.row_map[p]
+        t0 = time.perf_counter()
+        try:
+            with profiler.RecordEvent("decode::chunk"):
+                faults.fire("decode.chunk")
+                fetches = self._run("chunk", {
+                    DecodeModel.CHU_TOKENS: toks,
+                    DecodeModel.CHU_POSITIONS: pos,
+                    DecodeModel.CHU_BIAS: bias,
+                    DecodeModel.CHU_ROWS: st.row_map,
+                    DecodeModel.CHU_WRITE_ROWS: wrows,
+                })
+        except Exception as e:
+            self._arena_lost(f"chunk-prefill failure: {e}")
+            return 1
+        self._metrics.observe_chunk(real, time.perf_counter() - t0)
+        st.done = stop
+        if st.done < st.plen:
+            return 1
+        logits = np.asarray(fetches[0])              # [1, C, V]
+        first = int(np.argmax(logits[0, real - 1]))
+        self._blocks.register_prompt_blocks(st.blocks, req.prompt)
+        st.mode = "decode"
+        st.cursor = st.plen
+        st.last_token = first
+        st.generated = [first]
+        self._metrics.incr("prefill_tokens")
+        self._metrics.tenant_incr("tokens", req.tenant)
+        if self._finished(st):
+            self._retire(s)
+        return 1
+
+    # -- speculative decoding ----------------------------------------------
+    def _advance_spec(self):
+        """One draft-propose + target-verify cycle per speculative slot.
+        The draft greedily proposes up to ``spec_k`` tokens (one
+        stateless draft-prefill forward each); the target verifies ALL
+        of them in ONE batch-prefill forward — logits at position
+        ``n-1+j`` depend only on tokens ``<= n-1+j`` (causal mask,
+        exact-zero padding), so each emitted token equals what
+        target-only greedy decode would emit: bit-identical by
+        construction, fewer target steps per token by measurement."""
+        m = self._model
+        progressed = 0
+        for s in range(m.slots):
+            st = self._slots[s]
+            if st is None or st.mode != "spec":
+                continue
+            progressed += 1
+            req = st.request
+            if req.expired():
+                self._reject_in_flight(req, DeadlineExceededError(
+                    "deadline expired mid-speculation after "
+                    f"{len(st.generated)} tokens"), slot=s)
+                continue
+            draft = self._engine._entries.get(req.draft_key)
+            if draft is None:
+                self._reject_in_flight(req, RequestError(
+                    f"draft model {'@'.join(req.draft_key)} left the "
+                    "registry mid-generation"), slot=s)
+                continue
+            n = len(st.toks)
+            k = min(req.spec_k, req.max_new - len(st.generated),
+                    m.max_len - n, draft.model.max_len - n)
+            k = max(k, 0)
+            # both forwards are STATELESS prefills (donation off): a
+            # failure loses nothing but this cycle, so it is a
+            # request-attributed failure — never a dead scheduler
+            # thread, never an arena loss. (This also contains the
+            # cross-entry read: draft._run from this thread may race a
+            # draft-side breaker relaunch, whose builder contract makes
+            # any observed executable content-identical — and any torn
+            # state it could still surface lands here, on one request.)
+            try:
+                props = []
+                dtoks = list(st.toks)
+                for _ in range(k):
+                    with profiler.RecordEvent("decode::spec_draft"):
+                        fetches = draft._run(
+                            "prefill", draft._prefill_feeds(dtoks))
+                    nxt = int(np.argmax(
+                        np.asarray(fetches[0])[0, len(dtoks) - 1]))
+                    props.append(nxt)
+                    dtoks.append(nxt)
+                self._metrics.incr("spec_draft_steps", k)
+                self._metrics.incr("spec_proposed_tokens", k)
+                t0 = time.perf_counter()
+                with profiler.RecordEvent("decode::spec_verify"):
+                    faults.fire("decode.verify")
+                    fetches = self._run("prefill",
+                                        self._prefill_feeds(dtoks))
+            except Exception as e:
+                self._reject_in_flight(req, RequestError(
+                    f"request {req.id} failed in speculative cycle: "
+                    f"{e}"), slot=s)
+                continue
+            self._metrics.incr("spec_target_steps")
+            self._metrics.observe_prefill(time.perf_counter() - t0)
+            logits = np.asarray(fetches[0])          # [1, L, V]
+            finished = False
+            for j in range(k + 1):
+                t = int(np.argmax(logits[0, n - 1 + j]))
+                st.generated.append(t)
+                st.toks.append(t)
+                st.last_token = t
+                self._metrics.incr("spec_emitted_tokens")
+                self._metrics.tenant_incr("tokens", req.tenant)
+                if j < k and props[j] == t:
+                    self._metrics.incr("spec_accepted_tokens")
+                    accepted = True
+                else:
+                    accepted = False
+                if (len(st.generated) >= req.max_new
+                        or (m.eos_id is not None and t == m.eos_id)
+                        or len(st.toks) >= m.max_len):
+                    finished = True
+                    break
+                if not accepted:
+                    break   # t was the correction token: later positions
+                            # saw the wrong draft prefix
+            st.cursor = len(st.toks)
+            if finished:
+                self._retire(s)
+        return progressed
+
     # -- the decode iteration ---------------------------------------------
+    def _arena_lost(self, why):
+        """A donated call failed: the arena is undefined. Fail every
+        in-flight sequence loudly, drive the breaker, reset."""
+        self._metrics.incr("step_failures")
+        self._probe_relaunched = False
+        if self._breaker is not None:
+            self._breaker_event(self._breaker.record_failure())
+        for s, st in enumerate(list(self._slots)):
+            if st is not None:
+                self._reject_in_flight(st.request, ReplicaLostError(
+                    f"request {st.request.id} lost to {why}"), slot=s)
+        self._reset_arenas()
+
+    def _apply_cow(self, st, cow):
+        """Copy-on-write landed a fresh block: re-inject the shared
+        partial's retained host rows into it, then remap the slot."""
+        m = self._model
+        u = cow.size_used
+        inj_rows = np.full((m.max_len,), m.rows, dtype="int64")
+        inj_rows[:u] = cow.block.row0 + np.arange(u)
+        inj = {DecodeModel.INJ_ROWS: inj_rows}
+        for i, (kn, vn) in enumerate(m.inject_kv_feeds):
+            karr = np.zeros((1, m.max_len, m.hidden), "float32")
+            varr = np.zeros((1, m.max_len, m.hidden), "float32")
+            karr[0, :u] = cow.host_rows[i][0]
+            varr[0, :u] = cow.host_rows[i][1]
+            inj[kn] = karr
+            inj[vn] = varr
+        with profiler.RecordEvent("decode::cow_inject"):
+            self._run("inject", inj)
+        self._rebuild_row_map(st)
+
     def _step(self):
         m = self._model
-        S, L = m.slots, m.max_len
+        S, L, R = m.slots, m.max_len, m.rows
         tok = np.zeros((S, 1), "int64")
         pos = np.zeros((S, 1), "int64")
         bias = np.full((S, 1, L), NEG_INF, "float32")
-        write = np.zeros((S, L), "float32")
+        rows = np.zeros((S, L), "int64")
+        wrows = np.full((S,), R, dtype="int64")
         active = []
         for s in range(S):
             st = self._slots[s]
-            if st is None:
+            if st is None or st.mode != "decode":
                 continue
+            # make the cursor position writable: allocate a fresh block
+            # when it opens a new chunk, COW when it lands in a SHARED
+            # partial tail (divergence), unregister an exclusively-owned
+            # partial before mutating it
+            try:
+                blocks, _nb, cow = self._blocks.ensure_appendable(
+                    st.blocks, st.cursor)
+            except RuntimeError as e:
+                # pool invariant violation: loud per-request failure,
+                # never a dead scheduler thread
+                self._reject_in_flight(st.request, RequestError(
+                    f"request {st.request.id} failed: {e}"), slot=s)
+                continue
+            if blocks is None:
+                self._metrics.incr("blocks_exhausted")
+                self._reject_in_flight(st.request, RequestError(
+                    f"request {st.request.id} failed: block pool "
+                    "exhausted mid-generation"), slot=s)
+                continue
+            st.blocks = blocks
+            if cow is not None:
+                try:
+                    self._apply_cow(st, cow)
+                except Exception as e:
+                    # the COW re-inject is a DONATED call: its failure
+                    # invalidates the whole arena, not one request
+                    self._arena_lost(f"copy-on-write inject failure: {e}")
+                    return
+            elif _nb is not None:
+                self._rebuild_row_map(st)
             active.append(s)
             tok[s, 0] = st.last_token
             pos[s, 0] = st.cursor
             bias[s, 0, :st.cursor + 1] = 0.0
-            write[s, st.cursor] = 1.0
+            rows[s] = st.row_map
+            wrows[s] = self._row_of(st, st.cursor)
+        if not active:
+            return
         t0 = time.perf_counter()
         try:
             with profiler.RecordEvent("decode::step"):
                 faults.fire("decode.step")
                 fetches = self._run("step", {
                     DecodeModel.DEC_TOKEN: tok, DecodeModel.DEC_POSITION: pos,
-                    DecodeModel.DEC_BIAS: bias, DecodeModel.DEC_WRITE: write,
+                    DecodeModel.DEC_BIAS: bias,
+                    DecodeModel.DEC_ROWS: rows.reshape(-1),
+                    DecodeModel.DEC_WRITE_ROWS: wrows,
                 })
         except Exception as e:
             # a failed donated call leaves the arena undefined: every
             # in-flight sequence is lost (failed loudly), the batch-level
             # outcome drives the breaker, and the arena resets
-            self._metrics.incr("step_failures")
-            self._probe_relaunched = False
-            if self._breaker is not None:
-                self._breaker_event(self._breaker.record_failure())
-            for s in list(active):
-                st = self._slots[s]
-                self._reject_in_flight(st.request, ReplicaLostError(
-                    f"request {st.request.id} lost to decode-step failure: "
-                    f"{e}"), slot=s)
-            self._reset_arenas()
+            self._arena_lost(f"decode-step failure: {e}")
             return
         if self._breaker is not None:
             self._breaker_event(self._breaker.record_success())
@@ -485,6 +841,8 @@ class _ModelEntry:
         now = time.perf_counter()
         for s in active:
             st = self._slots[s]
+            self._blocks.note_append(
+                st.blocks[st.cursor // m.block_size])
             nxt = int(np.argmax(logits[s, 0]))
             st.generated.append(nxt)
             st.cursor += 1
@@ -512,6 +870,8 @@ class _ModelEntry:
         st = self._slots[slot]
         self._slots[slot] = None
         self._pool.release(slot)
+        if st.blocks:
+            self._blocks.release(st.blocks)
         req = st.request
         self._engine._tenant_unflight(req.tenant)
         req.response._complete(outputs={
@@ -524,8 +884,11 @@ class _ModelEntry:
 
     def _reject_in_flight(self, req, error, slot=None):
         if slot is not None:
+            st = self._slots[slot]
             self._slots[slot] = None
             self._pool.release(slot)
+            if st is not None and st.blocks:
+                self._blocks.release(st.blocks)
         self._engine._tenant_unflight(req.tenant)
         self._metrics.incr(
             "deadline_missed" if isinstance(error, DeadlineExceededError)
@@ -538,7 +901,8 @@ class _ModelEntry:
         """Offline whole-sequence reference: re-run the full causal
         prefill forward per generated token (no KV cache, no slots) with
         identical finish rules. The bit-exactness tests compare
-        continuous output against THIS."""
+        continuous output — in EVERY mode (paged decode, chunked
+        prefill, speculative) — against THIS."""
         m = self._model
         toks = list(prompt)
         out = []
@@ -557,14 +921,27 @@ class _ModelEntry:
     # -- observability ----------------------------------------------------
     def stats(self):
         m = self._model
+        pool = self._blocks.stats()
+        spec_t = self._metrics.count("spec_target_steps")
+        spec_e = self._metrics.count("spec_emitted_tokens")
+        spec_p = self._metrics.count("spec_proposed_tokens")
         return self._metrics.snapshot(extra={
             **self._metrics.queue_snapshot(self._queue),
             "model": m.name, "version": m.version,
             "slots": m.slots, "max_len": m.max_len,
+            "block_size": m.block_size, "num_blocks": m.num_blocks,
             "active_slots": self._pool.active_count,
             "occupancy": self._metrics.occupancy(m.slots),
             "tokens_per_step": self._metrics.tokens_per_step(),
             "arena_mib": m.arena_bytes() / 2**20,
+            "slotted_equivalent_mib":
+                m.slotted_equivalent_bytes() / 2**20,
+            "block_pool": pool,
+            "block_dedup_ratio": pool["dedup_ratio"],
+            "spec_steps_per_token": (spec_t / spec_e) if spec_e else None,
+            "spec_acceptance_rate": (
+                self._metrics.count("spec_accepted_tokens") / spec_p
+                if spec_p else None),
             "prefix_cache_entries": len(self._prefix),
             "prefix_hits": self._prefix.hits,
             "prefix_misses": self._prefix.misses,
@@ -586,6 +963,10 @@ class _ModelEntry:
     @property
     def prefix_cache(self):
         return self._prefix
+
+    @property
+    def block_pool(self):
+        return self._blocks
 
 
 class GenerationEngine:
@@ -624,9 +1005,10 @@ class GenerationEngine:
 
     # -- model registry ---------------------------------------------------
     def register_model(self, model):
-        """Host one (model, version). Sizes the KV arena against the HBM
-        budget BEFORE any compile, then builds + warms the entry (from
-        the compile cache when one is populated). Returns the entry."""
+        """Host one (model, version). Sizes the paged arena against the
+        HBM budget BEFORE any compile, then builds + warms the entry
+        (from the compile cache when one is populated). Returns the
+        entry."""
         if not isinstance(model, DecodeModel):
             model = model()        # zero-arg builder
         if model.key in self._entries:
@@ -684,8 +1066,9 @@ class GenerationEngine:
         return reqs
 
     def _check_hbm(self, model):
-        """Static pre-compile gate: decode-step peak HBM (the arena is
-        persistable state, so it dominates) must fit the budget."""
+        """Static pre-compile gate: decode-step peak HBM (the paged
+        arena is persistable state, so it dominates) must fit the
+        budget."""
         if not self._hbm_budget_mb:
             return
         from paddle_tpu.analysis.memory import (
@@ -835,7 +1218,8 @@ class GenerationEngine:
     # -- admission --------------------------------------------------------
     def submit(self, prompt_ids, model=None, version=None, tenant="default",
                priority=Priority.NORMAL, max_new_tokens=16,
-               deadline_ms=None, deadline_at=None):
+               deadline_ms=None, deadline_at=None, draft_model=None,
+               draft_version=None, spec_k=4):
         """Admit one generation request; returns its Response future
         (``result()`` -> ``{"tokens": int64 array}``). Raises structured
         RejectedError on invalid prompts, over-quota tenants, or a full
@@ -844,13 +1228,35 @@ class GenerationEngine:
         ``deadline_ms``): a re-dispatched request carries its ORIGINAL
         deadline through the retry instead of being granted a fresh
         budget — the fleet router's at-most-once-visible failover
-        depends on this."""
+        depends on this. ``draft_model`` (+ optional ``draft_version``)
+        opts into speculative decoding: the draft must be a hosted
+        registry entry sharing the target's vocabulary; greedy
+        acceptance keeps the output bit-identical to non-speculative
+        decode."""
         entry = self._resolve(model, version)
         m = entry.model
         tenant = str(tenant)
         entry.metrics.incr("submitted")
         entry.metrics.tenant_incr("submitted", tenant)
         self._validate(m, prompt_ids, max_new_tokens, priority, entry)
+        draft_key = None
+        if draft_model is not None:
+            draft_entry = self._resolve(draft_model, draft_version)
+            dm = draft_entry.model
+            if dm.key == m.key:
+                self._bad(entry, "draft model must differ from the target")
+            if dm.vocab_size != m.vocab_size:
+                self._bad(entry,
+                          f"draft vocab {dm.vocab_size} != target vocab "
+                          f"{m.vocab_size}")
+            need = len(list(prompt_ids)) + int(max_new_tokens)
+            if need > dm.max_len:
+                self._bad(entry,
+                          f"prompt + max_new_tokens ({need}) exceeds the "
+                          f"draft model's max_len {dm.max_len}")
+            if int(spec_k) < 1:
+                self._bad(entry, f"spec_k must be >= 1, got {spec_k}")
+            draft_key = dm.key
         with self._tenant_lock:
             st = self._tenant(tenant)
             over_quota = (st.max_queued is not None
@@ -880,7 +1286,8 @@ class GenerationEngine:
             self._next_id += 1
             rid = self._next_id
         req = GenerationRequest(rid, prompt_ids, max_new_tokens, tenant,
-                                priority, deadline)
+                                priority, deadline, draft_key=draft_key,
+                                spec_k=spec_k)
         try:
             with entry._cond:
                 entry._queue.put(req)
@@ -894,11 +1301,15 @@ class GenerationEngine:
             raise
         return req.response
 
+    @staticmethod
+    def _bad(entry, msg):
+        entry.metrics.incr("rejected")
+        entry.metrics.incr("rejected_invalid")
+        raise RejectedError(msg)
+
     def _validate(self, m, prompt_ids, max_new, priority, entry):
         def bad(msg):
-            entry.metrics.incr("rejected")
-            entry.metrics.incr("rejected_invalid")
-            raise RejectedError(msg)
+            self._bad(entry, msg)
 
         try:
             prompt = [int(t) for t in prompt_ids]

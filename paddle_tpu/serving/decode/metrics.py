@@ -25,7 +25,13 @@ class DecodeMetrics(ServingMetrics):
         # admission / KV pool (prefix hit/miss totals live on
         # PrefixCache itself — stats() reports them from that one
         # source; only the per-tenant prefix_hits series is a counter)
-        "prefills", "rejected_quota",
+        "prefills", "rejected_quota", "blocks_exhausted",
+        # chunked prefill (one budgeted chunk per engine iteration)
+        "chunk_runs", "chunk_tokens",
+        # speculative decoding: target verify forwards vs emitted tokens
+        # is the headline ratio; accepted/proposed is the acceptance rate
+        "spec_target_steps", "spec_draft_steps", "spec_proposed_tokens",
+        "spec_accepted_tokens", "spec_emitted_tokens",
         # circuit breaker relaunch (AOT-warmed replacement replicas)
         "relaunches",
     )
@@ -41,7 +47,11 @@ class DecodeMetrics(ServingMetrics):
             "serving_prefill_seconds",
             "prompt prefill forward latency", labels=labels,
         )
-        for h in (self._step, self._prefill):
+        self._chunk = self._registry.histogram(
+            "serving_chunk_prefill_seconds",
+            "one budgeted chunk-prefill forward", labels=labels,
+        )
+        for h in (self._step, self._prefill, self._chunk):
             h.reset()
 
     def observe_step(self, active_slots, new_tokens, seconds):
@@ -53,6 +63,11 @@ class DecodeMetrics(ServingMetrics):
     def observe_prefill(self, seconds):
         self.incr("prefills")
         self._prefill.observe(seconds)
+
+    def observe_chunk(self, tokens, seconds):
+        self.incr("chunk_runs")
+        self.incr("chunk_tokens", tokens)
+        self._chunk.observe(seconds)
 
     def occupancy(self, slots):
         steps = self.count("decode_steps")
@@ -70,6 +85,7 @@ class DecodeMetrics(ServingMetrics):
         out = super().snapshot(extra=None)
         out.update(self._step.snapshot("decode_step"))
         out.update(self._prefill.snapshot("prefill"))
+        out.update(self._chunk.snapshot("chunk_prefill"))
         if extra:
             out.update(extra)
         return out

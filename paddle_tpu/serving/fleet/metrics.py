@@ -25,7 +25,7 @@ class FleetMetrics:
         "rejected_shed", "rejected_invalid", "drained_unserved",
         # failover lifecycle
         "rerouted", "dispatch_faults", "health_probe_failures",
-        "replica_deaths", "replicas_revived",
+        "replica_deaths", "replicas_revived", "supervisor_restarts",
         # per-replica circuit breaker (PR-2 contract at fleet scope)
         "breaker_opened", "breaker_probes", "breaker_closed",
         "breaker_reopened",

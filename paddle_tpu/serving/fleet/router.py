@@ -59,7 +59,7 @@ import time
 
 from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
-from paddle_tpu.serving.decode.pool import prompt_key
+from paddle_tpu.serving.decode.pool import block_hashes, prompt_key
 from paddle_tpu.serving.fleet.metrics import FleetMetrics
 from paddle_tpu.serving.fleet.replica import ReplicaError
 from paddle_tpu.serving.request import (
@@ -118,7 +118,8 @@ class FleetRouter:
                  pump_interval_s=0.002, breaker_threshold=3,
                  breaker_cooldown_s=1.0, min_replicas=1, max_replicas=8,
                  autoscale=False, scale_up_rows_per_replica=16,
-                 scale_down_idle_ticks=40, label=None):
+                 scale_down_idle_ticks=40, supervisor=None,
+                 revive_factory=None, label=None):
         FleetRouter._SEQ += 1
         self.label = label or f"fleet-{FleetRouter._SEQ}"
         self._factory = replica_factory
@@ -148,6 +149,13 @@ class FleetRouter:
         self._last_health = 0.0
         self._idle_ticks = 0
         self.last_scaleup_traces = None
+        # router-initiated supervisor integration: a DEAD replica whose
+        # rank a GangSupervisor owns is restarted INTO ITS OWN slot
+        # (supervisor.restart(rank) + revive_factory(rid, index) ->
+        # revive_replica) instead of being replaced by a scale-up
+        self._supervisor = supervisor
+        self._revive_factory = revive_factory
+        self._revive_failed = set()   # rids: one attempt per death episode
 
     # -- replica set -------------------------------------------------------
     def add_replica(self, handle):
@@ -350,13 +358,21 @@ class FleetRouter:
 
     def _route(self, rr, exclude):
         """Caller holds the lock: affinity target by rendezvous hash of
-        the prompt prefix, spilled to least-loaded when the target is
-        saturated. Load reads a local replica's queue depth — the
-        witnessed ``fleet.router -> serving.queue`` edge."""
+        the prompt's leading KV BLOCK, spilled to least-loaded when the
+        target is saturated. The affinity key is the chained block hash
+        (`pool.block_hashes` with ``affinity_prefix`` as the block
+        size) — the SAME digest family the paged engine's radix tree
+        keys physical blocks by, so two prompts the router co-locates
+        are exactly two prompts whose first block the replica can serve
+        from shared storage (zero prefill AND zero extra rows). Prompts
+        shorter than one block fall back to the whole-prompt hash. Load
+        reads a local replica's queue depth — the witnessed
+        ``fleet.router -> serving.queue`` edge."""
         cands = self._routable(exclude)
         if not cands:
             return None
-        key = prompt_key(rr.prompt[: self._affinity_prefix])
+        chain = block_hashes(rr.prompt, self._affinity_prefix)
+        key = chain[0] if chain else prompt_key(rr.prompt)
         target = max(cands,
                      key=lambda rid: self._rendezvous_score(key, rid))
         sat = self._saturation_rows
@@ -477,12 +493,47 @@ class FleetRouter:
             first = not health.dead
             if first:
                 health.mark_dead(reason)
+                # fresh death episode: the revive path gets one attempt
+                self._revive_failed.discard(rid)
             for rr in self._inflight.values():
                 if rr.replica == rid and rr.state == "inflight":
                     rr.state = "parked"
                     rr.replica = rr.ticket = None
         if first:
             self._metrics.incr("replica_deaths")
+
+    def _maybe_revive(self):
+        """Router-initiated supervisor integration: every DEAD replica
+        whose rank a GangSupervisor owns is terminated+respawned INTO
+        ITS ORIGINAL endpoint slot (``supervisor.restart(rank)`` — a
+        structured ``rank_restart`` event and
+        ``resilience_events_total{kind=rank_restart}``), then a fresh
+        handle from ``revive_factory(rid, index)`` re-enters routing via
+        ``revive_replica``. One attempt per death episode; a failed
+        attempt leaves the slot dead for autoscale replacement. All
+        process/transport I/O runs OUTSIDE the router lock."""
+        if self._supervisor is None or self._revive_factory is None:
+            return
+        with self._lock:
+            dead = [(rid, self._replicas[rid].index)
+                    for rid in sorted(self._replicas)
+                    if self._health[rid].dead
+                    and rid not in self._revive_failed]
+            for rid, _ in dead:
+                self._revive_failed.add(rid)   # claimed; cleared on success
+        for rid, index in dead:
+            try:
+                self._supervisor.restart(index)
+                self._metrics.incr("supervisor_restarts")
+                handle = self._revive_factory(rid, index)
+                self.revive_replica(handle)
+            except Exception:
+                log.exception(
+                    "supervisor restart of replica %s (rank %d) failed; "
+                    "slot stays dead for autoscale replacement", rid, index)
+                continue
+            with self._lock:
+                self._revive_failed.discard(rid)
 
     # -- the pump ----------------------------------------------------------
     def _pump_loop(self):
@@ -510,6 +561,9 @@ class FleetRouter:
             self._last_health = now
             self._health_pass()
         self._flush_parked(now)
+        # restart-in-place runs BEFORE autoscale: a supervised rank
+        # returns to its own endpoint slot instead of being replaced
+        self._maybe_revive()
         self._maybe_scale()
 
     def _poll_inflight(self):

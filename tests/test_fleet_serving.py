@@ -808,3 +808,94 @@ def test_chaos_serve_smoke_cli():
     assert payload["invariants"]["lost"] == 0
     assert payload["invariants"]["bit_identical"] is True
     assert payload["measured"]["rerouted"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# r13 satellites: block-hash affinity + router-initiated supervisor restart
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_key_is_block_hash_chain():
+    """The affinity key is the chained hash of the prompt's first KV
+    block (`pool.block_hashes` with affinity_prefix as the block size)
+    — the SAME digest family the paged engine's radix tree keys
+    physical blocks by. Every prompt sharing its first full block lands
+    on one replica regardless of tail; a sub-block prompt falls back to
+    the whole-prompt hash."""
+    from paddle_tpu.serving.decode.pool import block_hashes
+
+    router = FleetRouter(affinity_prefix=4)
+    for i in range(4):
+        router.add_replica(_FakeHandle(f"r{i}", i))
+    first_block = [9, 2, 7, 4]
+    targets = {_route_of(router, first_block + list(tail))
+               for tail in ([], [1], [3, 3, 3], list(range(8)))}
+    assert len(targets) == 1, targets
+    # the chain hash, not the raw tokens, is the key: identical first
+    # chunk => identical chain head
+    h1 = block_hashes(first_block + [1, 2], 4)[0]
+    h2 = block_hashes(first_block + [8], 4)[0]
+    assert h1 == h2
+    # sub-block prompts still route deterministically (whole-prompt key)
+    assert (_route_of(router, [1, 2]) == _route_of(router, [1, 2]))
+
+
+def test_dead_replica_restarts_in_place_via_supervisor():
+    """ROADMAP item 3 (d): a DEAD replica whose rank a GangSupervisor
+    owns is terminated+respawned INTO ITS OWN endpoint slot
+    (supervisor.restart(rank), counted in
+    resilience_events_total{kind=rank_restart}) and re-enters routing
+    via revive_replica — autoscale replacement never fires. Hand-stepped
+    (no pump thread) for determinism."""
+    from paddle_tpu.distributed.launch import terminate_gang
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.resilience.supervisor import GangSupervisor
+
+    sup = GangSupervisor(["-c", "import time; time.sleep(600)"],
+                         nproc=1, grace_s=0.5)
+    sup.launch()
+    factory = _local_factory()
+
+    def revive_factory(rid, index):
+        assert rid == "r0" and index == 0
+        return factory(index)
+
+    router = FleetRouter(
+        replica_factory=factory, autoscale=True, min_replicas=1,
+        health_interval_s=1e9, supervisor=sup,
+        revive_factory=revive_factory)
+    handle = router.add_replica(factory(0))
+    rank_restart_counter = obs_metrics.registry().counter(
+        "resilience_events_total", "gang supervisor decisions",
+        labels={"kind": "rank_restart"})
+    before = rank_restart_counter.value
+    old_pid = sup.procs()[0].pid
+    try:
+        handle.kill()
+        router._health_pass()             # transport loss -> DEAD latch
+        assert router.replicas()["r0"] == "dead"
+        router._tick()                    # revive runs BEFORE autoscale
+        assert sup.rank_restarts == {0: 1}
+        assert rank_restart_counter.value == before + 1
+        assert sup.procs()[0].pid != old_pid          # same slot, new proc
+        assert router._metrics._counts["supervisor_restarts"].value == 1
+        assert router._metrics._counts["scale_ups"].value == 0, \
+            "restart-in-place must preempt scale-up replacement"
+        assert router.replicas()["r0"] != "dead"
+        # the revived slot serves — and a second tick doesn't restart again
+        router._tick()
+        assert sup.rank_restarts == {0: 1}
+        resp = router.submit([1, 2, 3], max_new_tokens=3, model="fleet_t",
+                             version="1")
+        router._tick()
+        deadline = time.time() + 60
+        while not resp.done() and time.time() < deadline:
+            router._tick()
+            time.sleep(0.005)
+        ref = router._replicas["r0"].engine.entry(
+            "fleet_t", "1").offline_decode([1, 2, 3], 3)
+        assert [int(t) for t in resp.result(timeout=5)["tokens"]] == ref
+    finally:
+        terminate_gang(sup.procs(), grace_s=0.5)
+        for h in router._replicas.values():
+            h.close()

@@ -287,8 +287,15 @@ def run_decode(args, rng):
         ranks.setdefault(tenant, []).append(rank)
     mean_rank = {t: round(sum(r) / len(r), 2) for t, r in ranks.items()}
 
+    # the main engine's retrace gate closes HERE: the paged/spec legs
+    # below build their own (new) models, whose first-build traces are
+    # inherent, not retraces
     jits_end = _jit_count()
     stats = entry.stats()
+
+    paged = _paged_sweep(args, rng) if args.paged else None
+    spec = _spec_leg(args, rng) if args.spec else None
+
     engine.shutdown()
     last = sweep[-1]
     report = {
@@ -316,6 +323,10 @@ def run_decode(args, rng):
             "decode_step_p99_s": round(stats["decode_step_p99_s"], 5),
         },
     }
+    if paged is not None:
+        report["extra"]["paged"] = paged
+    if spec is not None:
+        report["extra"]["spec"] = spec
     print(json.dumps(report))
     if args.smoke:
         assert errors == 0 and served == args.requests * len(args.rates), \
@@ -324,8 +335,138 @@ def run_decode(args, rng):
         assert jits_end == jits_warm, \
             f"{jits_end - jits_warm} retraces after warmup"
         assert last["occupancy_gain"] > 1.5, sweep
+        if paged is not None:
+            for leg in paged["sweep"]:
+                assert leg["offline_mismatches"] == 0, leg
+            shared = [leg for leg in paged["sweep"]
+                      if leg["block_size"] < args.max_len]
+            assert any(leg["radix_hits"] > 0 for leg in shared), paged
+            assert any(leg["peak_dedup_ratio"] > 1.0 for leg in shared), \
+                paged
+        if spec is not None:
+            assert spec["offline_mismatches"] == 0, spec
+            assert spec["steps_per_token"] < 1.0, spec
+            assert spec["retraces"] == 0, spec
         print("DECODE_SMOKE_OK")
     return 0
+
+
+def _paged_sweep(args, rng):
+    """Block-size sweep over a SHARE-HEAVY workload (half the prompts
+    extend one common prefix): small blocks let the radix tree dedup
+    physical storage; block_size == max_len is the degenerate slotted
+    design (one block per slot, zero sharing possible beyond whole-slot
+    geometry). Mid-flight pool state is sampled hand-stepped (no
+    scheduler thread) so the dedup numbers are deterministic."""
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    out = []
+    for bs in (4, args.max_len):
+        engine = GenerationEngine(queue_depth=args.queue_depth,
+                                  breaker_threshold=0)
+        entry = engine.register_model(lambda bs=bs: build_decoder_model(
+            vocab_size=args.vocab, hidden=args.hidden,
+            num_layers=args.layers, slots=args.slots, max_len=args.max_len,
+            block_size=bs, name=f"bench_paged{bs}", version="1",
+        ))
+        shared_prefix = [int(t) for t in rng.randint(0, args.vocab, size=8)]
+        reqs = []
+        for i in range(args.slots):
+            if i % 2 == 0:
+                prompt = shared_prefix + [int(rng.randint(0, args.vocab))]
+            else:
+                prompt = [int(t) for t in
+                          rng.randint(0, args.vocab,
+                                      size=int(rng.randint(2, 6)))]
+            reqs.append((prompt, 6))
+        refs = [entry.offline_decode(p, n) for p, n in reqs]
+        resps = [engine.submit(p, max_new_tokens=n) for p, n in reqs]
+        entry._admit_free_slots()
+        mid = entry.block_pool.stats()          # sampled while live
+        for _ in range(args.max_len):
+            if all(r.done() for r in resps):
+                break
+            entry._step()
+        mism = sum(
+            1 for r, ref in zip(resps, refs)
+            if [int(t) for t in r.result(timeout=120)["tokens"]] != ref)
+        st = entry.stats()
+        out.append({
+            "block_size": bs,
+            "num_blocks": entry.model.num_blocks,
+            "arena_mib": round(st["arena_mib"], 3),
+            "slotted_equivalent_mib":
+                round(st["slotted_equivalent_mib"], 3),
+            "peak_occupancy": round(mid["occupancy"], 3),
+            "peak_dedup_ratio": round(mid["dedup_ratio"], 3),
+            "radix_hits": mid["radix_hits"],
+            "cow_copies": st["block_pool"]["cow_copies"],
+            "offline_mismatches": mism,
+        })
+        engine.shutdown()
+    return {"sweep": out}
+
+
+def _spec_leg(args, rng):
+    """Speculative decoding on a repeat-heavy workload: draft = a second
+    registry entry with the TARGET's geometry (deterministic init makes
+    the weights byte-identical — the acceptance upper bound, and the
+    honest way to measure the machinery without a trained draft), plus a
+    distinct-geometry draft leg whose acceptance is reported unasserted."""
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    engine = GenerationEngine(queue_depth=args.queue_depth,
+                              breaker_threshold=0)
+    geom = dict(vocab_size=args.vocab, hidden=args.hidden,
+                num_layers=args.layers, slots=args.slots,
+                max_len=args.max_len)
+    tgt = engine.register_model(lambda: build_decoder_model(
+        name="bench_spec_t", version="1", **geom))
+    engine.register_model(lambda: build_decoder_model(
+        name="bench_spec_d", version="1", **geom))
+    engine.register_model(lambda: build_decoder_model(
+        name="bench_spec_d1", version="1", **{**geom, "num_layers": 1}))
+    # repeat-heavy prompts: short cycles the greedy head locks onto
+    base = [int(t) for t in rng.randint(0, args.vocab, size=2)]
+    reqs = [(base * 2, 12), (base * 3, 10), (base * 2 + [1], 12),
+            (base * 2, 12)]
+    refs = [tgt.offline_decode(p, n) for p, n in reqs]
+    jits0 = _jit_count()
+    engine.start()
+    resps = [engine.submit(p, model="bench_spec_t", max_new_tokens=n,
+                           draft_model="bench_spec_d", spec_k=3)
+             for p, n in reqs]
+    mism = sum(
+        1 for r, ref in zip(resps, refs)
+        if [int(t) for t in r.result(timeout=300)["tokens"]] != ref)
+    st = tgt.stats()
+    identical = {
+        "steps_per_token": round(st["spec_steps_per_token"], 3),
+        "acceptance_rate": round(st["spec_acceptance_rate"], 3),
+    }
+    # distinct-draft leg: acceptance is a property of the models, so it
+    # is REPORTED, never gated
+    d_resps = [engine.submit(p, model="bench_spec_t", max_new_tokens=n,
+                             draft_model="bench_spec_d1", spec_k=3)
+               for p, n in reqs[:2]]
+    mism += sum(
+        1 for r, ref in zip(d_resps, refs[:2])
+        if [int(t) for t in r.result(timeout=300)["tokens"]] != ref)
+    st2 = tgt.stats()
+    engine.shutdown()
+    return {
+        "spec_k": 3,
+        "steps_per_token": identical["steps_per_token"],
+        "acceptance_rate": identical["acceptance_rate"],
+        "distinct_draft_acceptance_rate": round(
+            (st2["spec_accepted_tokens"] - st["spec_accepted_tokens"])
+            / max(st2["spec_proposed_tokens"]
+                  - st["spec_proposed_tokens"], 1), 3),
+        "target_steps": st2["spec_target_steps"],
+        "emitted_tokens": st2["spec_emitted_tokens"],
+        "offline_mismatches": mism,
+        "retraces": _jit_count() - jits0,
+    }
 
 
 def main(argv=None):
@@ -354,6 +495,12 @@ def main(argv=None):
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--rates", type=str, default=None,
                     help="decode: comma-separated arrival-rate sweep, req/s")
+    ap.add_argument("--paged", action="store_true",
+                    help="decode: block-size sweep (pool occupancy, "
+                         "radix dedup, COW) on a share-heavy workload")
+    ap.add_argument("--spec", action="store_true",
+                    help="decode: speculative-decoding leg "
+                         "(steps-per-token, acceptance rate)")
     ap.add_argument("--verify", type=int, default=8,
                     help="decode: requests/rate checked against offline "
                          "(--smoke checks every request)")
