@@ -13,7 +13,11 @@ resumability as first-class properties):
   ``jax.device_put`` batches, sharding-aware for data-parallel meshes.
 * ``state``    — checkpointable iterator position (epoch, shard cursor,
   RNG state, emitted-batch count) riding ``incubate/checkpoint.py``
-  manifests, so ``resume()`` restores data position exactly.
+  manifests, so ``resume()`` restores data position exactly; plus the
+  elastic translation (``elastic_resume``) that projects a per-rank
+  cursor to the epoch-global stream position so a resized gang
+  (``DataEngine(elastic=True)``) resumes the exact global stream with
+  zero samples lost or double-consumed.
 
 DataLoader (``from_generator(num_workers=...)``) and
 ``Dataset.set_num_workers`` ride this layer; everything reports
@@ -30,6 +34,7 @@ from paddle_tpu.dataio.state import (
     STATE_KEY,
     IteratorState,
     decode_state,
+    elastic_resume,
     encode_state,
 )
 
@@ -46,4 +51,5 @@ __all__ = [
     "STATE_KEY",
     "encode_state",
     "decode_state",
+    "elastic_resume",
 ]

@@ -36,7 +36,7 @@ import threading
 import time
 
 from paddle_tpu.dataio.source import ShardedSource, mix_seed
-from paddle_tpu.dataio.state import IteratorState
+from paddle_tpu.dataio.state import IteratorState, elastic_resume
 from paddle_tpu.observability import registry, trace_scope
 from paddle_tpu.observability.logger import RateLimitedLogger
 from paddle_tpu.resilience import faults
@@ -244,7 +244,7 @@ class DataEngine:
     def __init__(self, source, transform=None, batch_size=None,
                  drop_last=False, num_workers=0, queue_depth=8,
                  collate=None, skip_errors=False, max_skips=1024,
-                 name="dataio"):
+                 name="dataio", elastic=False):
         enforce(isinstance(source, ShardedSource),
                 f"source must be a ShardedSource, got {type(source)!r}")
         self._source = source
@@ -258,11 +258,20 @@ class DataEngine:
         self._skip_errors = bool(skip_errors)
         self._max_skips = int(max_skips)
         self._name = name
+        # elastic=True lets load_state_dict accept a checkpoint written
+        # under a DIFFERENT shard geometry by translating its cursor to
+        # the epoch-global stream position (state.elastic_resume);
+        # False keeps the strict same-geometry contract.
+        self._elastic = bool(elastic)
         # position (the checkpointable part). No live RNG object: every
         # random draw (epoch order, per-sample augmentation) is derived
         # from (seed, epoch, idx), so position + seed IS the RNG state.
+        # `_base` is the epoch-global offset this geometry's shards were
+        # cut from — 0 except mid-epoch after an elastic resume, and it
+        # resets to 0 when the epoch (suffix) is fully consumed.
         self._epoch = 0
         self._cursor = 0
+        self._base = 0
         self._emitted_batches = 0
         self._skip_counter = registry().counter(
             "dataio_skipped_records_total",
@@ -297,6 +306,16 @@ class DataEngine:
         return self._cursor
 
     @property
+    def base(self):
+        return self._base
+
+    @property
+    def global_cursor(self):
+        """Epoch-global stream position already consumed (state.py):
+        geometry-free, so it survives elastic resizes."""
+        return self._base + self._cursor * self._source.world
+
+    @property
     def emitted_batches(self):
         return self._emitted_batches
 
@@ -304,6 +323,7 @@ class DataEngine:
         return IteratorState(
             epoch=self._epoch,
             cursor=self._cursor,
+            base=self._base,
             emitted_batches=self._emitted_batches,
             seed=self._source.seed,
             world=self._source.world,
@@ -312,6 +332,19 @@ class DataEngine:
 
     def load_state_dict(self, d):
         st = IteratorState.from_dict(d)
+        if self._elastic and (st.world != self._source.world
+                              or st.rank != self._source.rank):
+            # a checkpoint from a different gang geometry: translate its
+            # cursor to the epoch-global position and re-base this
+            # rank's shards on the remaining stream suffix
+            log.info(
+                "dataio elastic resume: translating state from "
+                "world=%d rank=%d to world=%d rank=%d "
+                "(global cursor %d)", st.world, st.rank,
+                self._source.world, self._source.rank, st.global_cursor(),
+            )
+            st = IteratorState.from_dict(elastic_resume(
+                d, self._source.world, self._source.rank))
         enforce(
             st.world == self._source.world,
             f"checkpointed data state is for world size {st.world}, this "
@@ -332,6 +365,7 @@ class DataEngine:
             self._source.seed = st.seed
         self._epoch = st.epoch
         self._cursor = st.cursor
+        self._base = st.base
         self._emitted_batches = st.emitted_batches
 
     # -- iteration ---------------------------------------------------------
@@ -361,13 +395,14 @@ class DataEngine:
     def __iter__(self):
         epoch = self._epoch
         start = self._cursor
-        shard = self._source.epoch_shard(epoch)
+        shard = self._source.epoch_shard(epoch, base=self._base)
         limited = RateLimitedLogger(log, max_records=8)
         skips = 0
         buf = []
         bs = self._batch_size
         with trace_scope("dataio::epoch", cat="dataio", epoch=epoch,
-                         start=start, shard_len=len(shard),
+                         start=start, base=self._base,
+                         shard_len=len(shard),
                          workers=self._num_workers):
             results = _pool(
                 self._payloads(shard, epoch, start), self._apply,
@@ -420,6 +455,9 @@ class DataEngine:
                 self._batch_counter.inc()
                 yield batch
             limited.summarize(what="skipped records")
-        # epoch fully consumed: advance
+        # epoch fully consumed: advance (a mid-epoch elastic base only
+        # lives until its suffix is drained — the next epoch re-shards
+        # the full order)
         self._epoch = epoch + 1
         self._cursor = 0
+        self._base = 0

@@ -107,8 +107,25 @@ class DevicePrefetcher:
         return getter() if getter is not None else None
 
     def load_state_dict(self, d):
+        """Forwards to the wrapped source — including the elastic
+        geometry translation when the source is a
+        ``DataEngine(elastic=True)`` (the prefetcher proxies position,
+        it never owns geometry)."""
         self._batches.load_state_dict(d)
         self._last_state = None
+
+    def global_cursor(self):
+        """Epoch-global stream position as of the last batch the
+        CONSUMER received (None when the wrapped source keeps no
+        state) — the geometry-free coordinate an elastic resize hands
+        to the next gang generation. Read from the consumer-exact proxy
+        state, NOT the producer's read-ahead position."""
+        st = self.state_dict()
+        if st is None:
+            return None
+        from paddle_tpu.dataio.state import IteratorState
+
+        return IteratorState.from_dict(st).global_cursor()
 
     # -- iteration ---------------------------------------------------------
     def __iter__(self):

@@ -85,13 +85,27 @@ class ShardedSource:
             random.Random(mix_seed(self.seed, epoch)).shuffle(order)
         return order
 
-    def epoch_shard(self, epoch):
+    def epoch_shard(self, epoch, base=0):
         """This rank's slice of the epoch order. The order is first
         padded by cyclic tiling to a multiple of `world`, so every rank
         gets exactly ceil(n / world) samples — equal step counts keep
         data-parallel collectives in lockstep even when the dataset is
-        smaller than the world size."""
+        smaller than the world size.
+
+        ``base`` (elastic resume, state.py) cuts the shards from the
+        stream SUFFIX ``order[base:]`` instead of the whole epoch: a
+        gang resized mid-epoch re-shards exactly the positions the old
+        geometry had not consumed, under the same padding rule (the
+        suffix wraps onto itself so every rank stays equal-length).
+        ``base=0`` is byte-identical to the pre-elastic behavior."""
         order = self.epoch_order(epoch)
+        base = int(base)
+        if base < 0:
+            raise ValueError(f"base must be >= 0, got {base}")
+        if base:
+            # positions past the real epoch length are wrap-padding the
+            # old geometry already consumed — nothing left to re-shard
+            order = order[base:] if base < len(order) else []
         if self.world > 1 and order:
             per_rank = -(-len(order) // self.world)
             total = per_rank * self.world

@@ -10,6 +10,21 @@ state, emitted-batch count — plus the codec that rides the existing
 blob stored as a uint8 array under ``STATE_KEY`` inside ``state.npz``,
 so it inherits the per-array CRC32, the whole-file CRC, the atomic
 rename, and the corrupt-walkback behavior for free.
+
+Elastic translation (r14): a per-rank cursor is only meaningful under
+the shard geometry that produced it, but the GLOBAL stream position is
+geometry-free. Ranks consume the epoch's padded order round-robin
+(position p belongs to rank ``p % world`` at shard index ``p // world``,
+source.py), so a gang whose ranks all sit at shard cursor ``c`` has
+consumed exactly the first ``base + c * world`` positions of the epoch
+stream. ``IteratorState.global_cursor()`` performs that projection and
+``elastic_resume()`` re-bases a checkpointed state onto a NEW
+(world, rank): the translated state starts a fresh shard slice of the
+REMAINING stream (``base`` = the global cursor, ``cursor`` = 0), which
+``ShardedSource.epoch_shard(epoch, base=...)`` turns back into per-rank
+sample indices. The round trip loses nothing and repeats nothing: the
+old geometry consumed positions ``[0, g)``, the new one consumes
+``[g, ...)`` — the contract tools/chaos_elastic.py proves end to end.
 """
 
 import json
@@ -22,12 +37,16 @@ __all__ = [
     "IteratorState",
     "encode_state",
     "decode_state",
+    "elastic_resume",
 ]
 
 # array name inside state.npz; dunder-prefixed so it can never collide
 # with a program variable name (verifier rejects those)
 STATE_KEY = "__dataio_state__"
-STATE_VERSION = 1
+# version 2 adds `base` (the epoch-global offset this geometry's shards
+# started from — 0 except after an elastic resize); version-1 states
+# decode with base=0, so pre-elastic checkpoints keep resuming exactly
+STATE_VERSION = 2
 
 
 class IteratorState:
@@ -38,6 +57,10 @@ class IteratorState:
                      by emitted batches (skipped records count: the
                      cursor is a position in shard order, not a count of
                      good samples)
+    base             epoch-global position this geometry's shards were
+                     cut from (0 except after an elastic resize: the
+                     resumed geometry re-shards the stream suffix
+                     starting at `base`)
     emitted_batches  lifetime batch count across epochs (monotonic)
     seed             base seed the per-epoch orders derive from
     world / rank     shard geometry the cursor is valid under
@@ -48,20 +71,31 @@ class IteratorState:
     """
 
     def __init__(self, epoch=0, cursor=0, emitted_batches=0, seed=0,
-                 world=1, rank=0, rng=None):
+                 world=1, rank=0, rng=None, base=0):
         self.epoch = int(epoch)
         self.cursor = int(cursor)
+        self.base = int(base)
         self.emitted_batches = int(emitted_batches)
         self.seed = int(seed)
         self.world = int(world)
         self.rank = int(rank)
         self.rng = rng
 
+    def global_cursor(self):
+        """Project the per-rank shard cursor to the epoch-global stream
+        position: a gang whose ranks all sit at shard cursor `cursor`
+        has consumed exactly the positions ``[0, base + cursor * world)``
+        of the epoch stream (ranks consume the padded order round-robin,
+        source.py). This is the geometry-free coordinate an elastic
+        resize hands to the next gang generation."""
+        return self.base + self.cursor * self.world
+
     def to_dict(self):
         return {
             "version": STATE_VERSION,
             "epoch": self.epoch,
             "cursor": self.cursor,
+            "base": self.base,
             "emitted_batches": self.emitted_batches,
             "seed": self.seed,
             "world": self.world,
@@ -80,12 +114,46 @@ class IteratorState:
         return cls(
             epoch=d.get("epoch", 0),
             cursor=d.get("cursor", 0),
+            base=d.get("base", 0),
             emitted_batches=d.get("emitted_batches", 0),
             seed=d.get("seed", 0),
             world=d.get("world", 1),
             rank=d.get("rank", 0),
             rng=d.get("rng"),
         )
+
+
+def elastic_resume(d, world, rank):
+    """Translate a checkpointed state dict onto a NEW shard geometry.
+
+    The old geometry's per-rank cursor projects to the epoch-global
+    position ``g = base + cursor * old_world`` (every rank of a
+    step-synchronized gang checkpoints the same ``cursor`` at the same
+    step, so any rank's blob yields the same ``g``); the translated
+    state re-bases rank ``rank`` of the NEW ``world`` at that position:
+    the new gang's shards are cut from the stream suffix ``[g, ...)``
+    and together consume it exactly once — zero samples lost or
+    double-consumed across the resize. ``emitted_batches`` carries over
+    as the gang-lifetime count; ``epoch``/``seed`` are untouched, so the
+    suffix order is the same permutation the old gang was walking.
+    """
+    st = IteratorState.from_dict(d)
+    world = int(world)
+    rank = int(rank)
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} outside world {world}")
+    return IteratorState(
+        epoch=st.epoch,
+        cursor=0,
+        base=st.global_cursor(),
+        emitted_batches=st.emitted_batches,
+        seed=st.seed,
+        world=world,
+        rank=rank,
+        rng=st.rng,
+    ).to_dict()
 
 
 def encode_state(d):

@@ -169,6 +169,18 @@ def main():
     parser.add_argument("--max_restarts", type=int, default=0,
                         help="supervised gang restarts on failure (0 = "
                              "fail fast with no restart)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic supervision: relaunch at reduced "
+                             "world size on capacity loss and grow back "
+                             "(implies supervision and a restart budget "
+                             "of 4 unless --max_restarts is set; see "
+                             "--min_nproc)")
+    parser.add_argument("--min_nproc", type=int, default=None,
+                        help="elastic world-size floor (implies "
+                             "--elastic; default: 1)")
+    parser.add_argument("--grow_after", type=float, default=30.0,
+                        help="elastic: seconds at reduced world size "
+                             "before probing full capacity again")
     parser.add_argument("--restart_backoff", type=float, default=1.0,
                         help="seconds between restart attempts (doubles)")
     parser.add_argument("--hang_timeout", type=float, default=None,
@@ -185,11 +197,9 @@ def main():
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     script_args = [args.script] + args.script_args
-    if args.max_restarts > 0 or args.hang_timeout:
-        from paddle_tpu.resilience.supervisor import GangSupervisor
-
-        sup = GangSupervisor(
-            script_args,
+    elastic = args.elastic or args.min_nproc is not None
+    if elastic or args.max_restarts > 0 or args.hang_timeout:
+        common = dict(
             nproc=args.nproc,
             max_restarts=args.max_restarts,
             restart_backoff_s=args.restart_backoff,
@@ -199,6 +209,24 @@ def main():
             devices_per_proc=args.devices_per_proc,
             started_port=args.started_port,
         )
+        if elastic:
+            from paddle_tpu.resilience.elastic import ElasticGangSupervisor
+
+            # a zero restart budget would fail the job on the very
+            # capacity loss --elastic exists to survive: the first
+            # shrink decision needs at least one allowed restart
+            if common["max_restarts"] < 1:
+                common["max_restarts"] = 4
+            sup = ElasticGangSupervisor(
+                script_args,
+                min_nproc=args.min_nproc or 1,
+                grow_after_s=args.grow_after,
+                **common,
+            )
+        else:
+            from paddle_tpu.resilience.supervisor import GangSupervisor
+
+            sup = GangSupervisor(script_args, **common)
         try:
             sup.run()
         except Exception as e:

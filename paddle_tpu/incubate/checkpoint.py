@@ -76,11 +76,20 @@ __all__ = [
     "verify_checkpoint",
     "newest_valid_checkpoint",
     "load_checkpoint",
+    "load_data_state",
+    "gang_generations",
+    "GANG_GENERATION_ENV",
 ]
 
 log = logging.getLogger("paddle_tpu.checkpoint")
 
 MANIFEST_NAME = "manifest.json"
+# Injected by resilience/elastic.py's ElasticGangSupervisor: a
+# monotonically increasing gang-generation counter, stamped into every
+# manifest + meta.json this process writes so the checkpoint chain
+# records WHICH gang incarnation (and therefore which world size /
+# shard geometry) produced each entry.
+GANG_GENERATION_ENV = "PADDLE_ELASTIC_GANG_GENERATION"
 _DEFAULT_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02,
                                 max_delay_s=0.5)
 
@@ -578,10 +587,19 @@ def newest_valid_checkpoint(dirname, quarantine=True, level="file"):
 
 
 def load_checkpoint(dirname, scope=None, data_state=None, shardings=None,
-                    extra_state=None):
+                    extra_state=None, step=None):
     """Restore the newest VALID checkpoint into the scope, walking back
     past corrupt/torn entries (quarantining them); returns the step
     AFTER the checkpointed one (0 when nothing valid exists).
+
+    ``step`` pins the restore to exactly ``ckpt_<step>`` — the elastic
+    resume contract: a resized gang must come back from the SYNC
+    checkpoint its supervisor validated, identically on every rank, so
+    a rank that silently walked back to a different entry would desync
+    the gang's data stream. A pinned entry that is missing or fails
+    verification is quarantined and raises ``CheckpointCorruptError``
+    (the worker exits nonzero; the supervisor re-validates and picks a
+    new sync step) instead of falling back.
 
     `data_state` (anything with load_state_dict(), e.g. a
     dataio.DataEngine) additionally restores the input-iterator position
@@ -608,10 +626,20 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None,
     scope."""
     scope = scope or global_scope()
     shardings = shardings or {}
-    for name in _candidates(dirname):
+    if step is not None:
+        name = f"ckpt_{int(step)}"
+        d = os.path.join(dirname, name)
+        if not os.path.isdir(d):
+            raise CheckpointCorruptError(
+                f"{dirname}: pinned checkpoint {name} does not exist"
+            )
+        candidates = [name]
+    else:
+        candidates = _candidates(dirname)
+    for name in candidates:
         d = os.path.join(dirname, name)
         try:
-            step, arrays = verify_checkpoint(d, assemble=False)
+            got_step, arrays = verify_checkpoint(d, assemble=False)
             blob = arrays.pop(STATE_KEY, None)
             restored, extra = {}, {}
             for n, a in arrays.items():
@@ -629,6 +657,12 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None,
                     restored[n] = a
         except CheckpointCorruptError as e:
             _quarantine(d, str(e))
+            if step is not None:
+                raise CheckpointCorruptError(
+                    f"{dirname}: pinned checkpoint {name} failed "
+                    f"verification ({e}); quarantined — refusing to "
+                    "fall back past an elastic sync point"
+                )
             continue
         for n, a in restored.items():
             scope.set(n, a)
@@ -636,8 +670,80 @@ def load_checkpoint(dirname, scope=None, data_state=None, shardings=None,
             data_state.load_state_dict(decode_state(blob))
         if extra_state is not None:
             extra_state.restore_arrays(extra)
-        return step + 1
+        return got_step + 1
     return 0
+
+
+def load_data_state(dirname, step=None):
+    """Read ONLY the data-position blob (``__dataio_state__``) from a
+    checkpoint, without touching any scope: the decoded state dict, or
+    None when the checkpoint carries no data state. ``step`` pins the
+    entry exactly like ``load_checkpoint``; without it the newest VALID
+    entry is consulted (corrupt entries are quarantined on the walk).
+
+    This is the grown-rank half of an elastic resume: a rank joining a
+    gang mid-job has no checkpoint of its own at the sync step, so it
+    pulls the CHIEF's data blob, and ``DataEngine(elastic=True)``
+    translates the recorded geometry onto its new (world, rank).
+    Verification runs with ``assemble=False``: the blob is a small
+    plain array, so a multi-GB sharded model is never materialized on
+    the joining host just to read a cursor."""
+    if step is not None:
+        name = f"ckpt_{int(step)}"
+        d = os.path.join(dirname, name)
+        if not os.path.isdir(d):
+            raise CheckpointCorruptError(
+                f"{dirname}: pinned checkpoint {name} does not exist"
+            )
+        try:
+            _, arrays = verify_checkpoint(d, assemble=False)
+        except CheckpointCorruptError as e:
+            # same contract as load_checkpoint's pinned branch: the bad
+            # entry leaves the chain so the supervisor's next sync walk
+            # stops seeing it, and the failure stays loud
+            _quarantine(d, str(e))
+            raise CheckpointCorruptError(
+                f"{dirname}: pinned checkpoint {name} failed "
+                f"verification ({e}); quarantined"
+            )
+        blob = arrays.get(STATE_KEY)
+        return decode_state(blob) if blob is not None else None
+    for name in _candidates(dirname):
+        d = os.path.join(dirname, name)
+        try:
+            _, arrays = verify_checkpoint(d, assemble=False)
+        except CheckpointCorruptError as e:
+            _quarantine(d, str(e))
+            continue
+        blob = arrays.get(STATE_KEY)
+        return decode_state(blob) if blob is not None else None
+    return None
+
+
+def gang_generations(dirname):
+    """[(step, gang_generation)] for every committed ``ckpt_<step>`` in
+    the directory, sorted by step; generation is None for entries
+    written outside an elastic supervisor. The elastic property gate
+    asserts this sequence is monotonically non-decreasing — a
+    generation that moved BACKWARDS would mean a stale gang incarnation
+    wrote over a newer one's chain."""
+    out = []
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return out
+    for name in entries:
+        if not (name.startswith("ckpt_") and _ckpt_step(name) is not None):
+            continue
+        man_p = os.path.join(dirname, name, MANIFEST_NAME)
+        gen = None
+        try:
+            with open(man_p) as f:
+                gen = json.load(f).get("gang_generation")
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass
+        out.append((_ckpt_step(name), gen))
+    return sorted(out)
 
 
 class AutoCheckpoint:
@@ -653,7 +759,7 @@ class AutoCheckpoint:
 
     def __init__(self, exe, program, dirname, save_interval_steps=100,
                  max_to_keep=3, scope=None, retry=None, data_state=None,
-                 extra_state=None):
+                 extra_state=None, gang_generation=None):
         self._exe = exe
         self._program = program
         self._dir = dirname
@@ -662,6 +768,9 @@ class AutoCheckpoint:
         self._scope = scope
         self._data_state = data_state
         self._extra_state = extra_state
+        # explicit value wins; else the elastic supervisor's env
+        # injection (GANG_GENERATION_ENV); else unstamped (byte-compat)
+        self._gang_generation = gang_generation
         self._thread = None
         # guards _last_error/_pending: the async writer thread sets them
         # while save()/close() on the training thread read-and-clear
@@ -686,6 +795,20 @@ class AutoCheckpoint:
             return False
         self.save(step, blocking=blocking)
         return True
+
+    def _generation(self):
+        """gang-generation to stamp, or None: ctor value, else the
+        elastic supervisor's env injection (read at write time so a
+        long-lived process restamped by a resize picks it up)."""
+        if self._gang_generation is not None:
+            return int(self._gang_generation)
+        env = os.environ.get(GANG_GENERATION_ENV)
+        try:
+            return int(env) if env is not None else None
+        except ValueError:
+            log.warning("ignoring non-integer %s=%r",
+                        GANG_GENERATION_ENV, env)
+            return None
 
     def _write(self, step, snap):
         """The full crash-consistent write protocol: serialize + manifest
@@ -749,6 +872,7 @@ class AutoCheckpoint:
             # a kill leaves classic torn-write debris in the .tmp dir
             faults.fire("checkpoint.io", step=step,
                         path=os.path.join(tmp, "state.npz"))
+            gen = self._generation()
             manifest = {
                 "format": 2 if sharded_manifest else 1,
                 "step": step,
@@ -765,10 +889,15 @@ class AutoCheckpoint:
             }
             if not sharded_manifest:
                 manifest.pop("sharded")
+            if gen is not None:
+                manifest["gang_generation"] = gen
             with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
                 json.dump(manifest, f)
+            meta = {"step": step, "time": time.time()}
+            if gen is not None:
+                meta["gang_generation"] = gen
             with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump({"step": step, "time": time.time()}, f)
+                json.dump(meta, f)
 
         self._retry.call(write_files)
         # merged sidecars leave the tree only once every (possibly
@@ -885,18 +1014,21 @@ class AutoCheckpoint:
         return self
 
     # -- resume ----------------------------------------------------------
-    def resume(self, shardings=None):
+    def resume(self, shardings=None, step=None):
         """Restore the newest VALID checkpoint into the scope (verifying
         CRCs, walking back past corrupt/torn entries and quarantining
         them as *.corrupt); returns the step AFTER the checkpointed one
         (0 on a fresh start). An attached data_state gets its iterator
         position restored from the same checkpoint. ``shardings`` (name
         -> target sharding) restores format-2 sharded entries shard-wise
-        with no full-array host materialization (see load_checkpoint)."""
+        with no full-array host materialization (see load_checkpoint).
+        ``step`` pins the restore to exactly ``ckpt_<step>`` (the
+        elastic sync contract — no silent walk-back; a bad pinned entry
+        raises CheckpointCorruptError instead)."""
         return load_checkpoint(self._dir, scope=self._scope or global_scope(),
                                data_state=self._data_state,
                                shardings=shardings,
-                               extra_state=self._extra_state)
+                               extra_state=self._extra_state, step=step)
 
     def close(self):
         """Join the async writer and SURFACE its failure (a failed last

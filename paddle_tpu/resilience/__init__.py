@@ -16,6 +16,11 @@ hardened path builds on:
 * `supervisor` — gang supervision: poll all ranks, on first failure or
   heartbeat-declared hang terminate + relaunch the whole gang from the
   newest VALID checkpoint, under a restart budget with backoff.
+* `elastic`    — elastic gang supervision atop `supervisor`: relaunch at
+  whatever world size capacity allows (shrink on loss, grow back when it
+  returns), pinning every rank to one validated sync checkpoint and
+  stamping a monotone gang generation into every manifest; the data
+  stream re-shards exactly via `dataio.state.elastic_resume`.
 
 Crash-consistent checkpoint integrity itself (per-array CRC32 manifests,
 fallback chain walking, `*.corrupt` quarantine) lives with the
@@ -26,6 +31,11 @@ harness in tests.
 """
 
 from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.elastic import (
+    ElasticGangSupervisor,
+    elastic_resume_step,
+    gang_generation,
+)
 from paddle_tpu.resilience.faults import (
     FaultInjector,
     InjectedFault,
@@ -40,6 +50,7 @@ from paddle_tpu.resilience.supervisor import (
 )
 
 __all__ = [
+    "ElasticGangSupervisor",
     "FaultInjector",
     "GangFailedError",
     "GangSupervisor",
@@ -47,6 +58,8 @@ __all__ = [
     "RetryPolicy",
     "TransientFault",
     "corrupt_file",
+    "elastic_resume_step",
     "faults",
+    "gang_generation",
     "heartbeat_tick",
 ]

@@ -23,10 +23,10 @@ Schedule format (``PADDLE_TPU_FAULTS`` env var — a JSON list, or
      {"site": "checkpoint.before_latest", "action": "kill"},
      {"site": "lookup.pull", "action": "stall", "delay_s": 0.2}]
 
-Rule fields: ``site`` (required); ``action`` in kill | raise | stall |
-corrupt | truncate (default raise); ``at_step`` / ``at_call`` (1-based
-nth matching call) / ``rank`` / ``prob`` (+ ``seed``) select WHEN it
-fires; ``times`` bounds how often (default 1, -1 = unlimited);
+Rule fields: ``site`` (required); ``action`` in kill | term | raise |
+stall | corrupt | truncate (default raise); ``at_step`` / ``at_call``
+(1-based nth matching call) / ``rank`` / ``prob`` (+ ``seed``) select
+WHEN it fires; ``times`` bounds how often (default 1, -1 = unlimited);
 ``exc`` = "transient" (retryable TransientFault, the default) or
 "fault"; ``path`` overrides the file target for corrupt/truncate;
 ``delay_s``, ``exit_code``, ``id`` as expected. With a ``state_dir``
@@ -34,6 +34,31 @@ fires; ``times`` bounds how often (default 1, -1 = unlimited);
 file so a RESTARTED process replaying the same steps does not re-fire
 them — that is what makes kill-at-step-N schedules convergent under a
 supervised restart loop.
+
+``kill`` vs ``term``: ``kill`` is a hard crash (``os._exit`` — no
+atexit handlers, no flushes, torn files possible), the failure a dying
+host produces. ``term`` is a PREEMPTION: the process sends itself
+SIGTERM — the polite, catchable signal (a worker that installs a
+handler can land in-flight durable state before exiting; unhandled it
+terminates with code -SIGTERM). Cloud TPU/VM preemption notices are
+exactly this shape; the elastic supervisor treats both as capacity
+loss, but only ``kill`` can tear files.
+
+Elastic-training sites (r14, ``resilience/elastic.py`` +
+tools/chaos_elastic.py):
+
+* ``worker.preempt`` — fired by training workers once per step
+  (immediately after ``train.step``). The conventional site for
+  preemption-shaped failure: ``action: "term"`` SIGTERMs the worker
+  with grace mid-run, ``action: "kill"`` is the hard variant. The
+  chaos scenario drives both shrink (hard kill) and grow (preempt as
+  the capacity-returns signal) through these.
+* ``elastic.resize`` — fired by ``ElasticGangSupervisor`` immediately
+  BEFORE each resize relaunch decision commits (``step`` = the new
+  gang generation, ``rank`` = the new world size). ``raise`` makes the
+  resize attempt itself fail (the supervisor counts it against the
+  restart budget and retries its decision loop); ``stall`` delays it —
+  so resize-path failure is injectable like any other hardened path.
 
 Fleet failover sites (r12, ``serving/fleet/`` + tools/chaos_serve.py):
 
@@ -135,7 +160,8 @@ class _Rule:
             raise ValueError("fault rule needs a 'site'")
         self.site = spec["site"]
         self.action = spec.get("action", "raise")
-        if self.action not in ("kill", "raise", "stall", "corrupt", "truncate"):
+        if self.action not in ("kill", "term", "raise", "stall", "corrupt",
+                               "truncate"):
             raise ValueError(f"unknown fault action {self.action!r}")
         self.at_step = spec.get("at_step")
         self.at_call = spec.get("at_call")
@@ -237,6 +263,19 @@ class FaultInjector:
         if rule.action == "kill":
             # simulate a hard crash: no atexit handlers, no flushes
             os._exit(rule.exit_code)
+        if rule.action == "term":
+            # preemption: SIGTERM to self — the polite, CATCHABLE
+            # signal (a worker with a handler can land its in-flight
+            # durable state first; unhandled it terminates with code
+            # -SIGTERM). Contrast "kill" = os._exit: uncatchable-shaped,
+            # can leave torn files.
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)
+            # delivery is asynchronous; hold here so the "preempted"
+            # worker never races past the site
+            time.sleep(rule.delay_s)
+            return
         if rule.action == "stall":
             time.sleep(rule.delay_s)
             return

@@ -29,8 +29,10 @@ class FleetMetrics:
         # per-replica circuit breaker (PR-2 contract at fleet scope)
         "breaker_opened", "breaker_probes", "breaker_closed",
         "breaker_reopened",
-        # elasticity + rolling deploys
-        "scale_ups", "scale_downs", "deploys", "stolen_queued",
+        # elasticity + rolling deploys ("replaced_deploys" = subprocess
+        # worker-replacement swaps inside a deploy() pass)
+        "scale_ups", "scale_downs", "deploys", "replaced_deploys",
+        "stolen_queued",
     )
 
     def __init__(self, router_label, registry=None):

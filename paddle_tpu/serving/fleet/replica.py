@@ -250,7 +250,8 @@ class SubprocessReplica:
     _CONNECT_RETRY = RetryPolicy(max_attempts=40, base_delay_s=0.1,
                                  max_delay_s=1.0, deadline_s=240.0)
 
-    def __init__(self, rid, index, proc, sock, meta):
+    def __init__(self, rid, index, proc, sock, meta, specs=None,
+                 extra_env=None):
         self.rid = str(rid)
         self.index = int(index)
         self.proc = proc
@@ -259,13 +260,19 @@ class SubprocessReplica:
         self._dead = False
         self._meta = dict(meta)
         self._last_load = 0
+        # remembered spawn inputs: what a REPLACEMENT worker must host
+        # (rolling deploys add the new version's spec on top)
+        self._specs = [dict(s) for s in (specs or [])]
+        self._extra_env = dict(extra_env or {})
 
     @classmethod
     def spawn(cls, rid, index, model_args, extra_env=None,
               startup_timeout=240.0):
         """Spawn + handshake: the worker prints one READY line naming
         its port and where its three executables came from, then serves
-        RPCs. Connect rides the shared RetryPolicy."""
+        RPCs. Connect rides the shared RetryPolicy. ``model_args`` is
+        one spec dict (legacy) or a list of spec dicts — each a
+        (name, version) decoder geometry the worker hosts."""
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))))
         env = dict(os.environ)
@@ -273,10 +280,12 @@ class SubprocessReplica:
             p for p in (repo, env.get("PYTHONPATH")) if p)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.update(extra_env or {})
+        specs = (list(model_args) if isinstance(model_args, (list, tuple))
+                 else [model_args])
         cmd = [sys.executable, "-m", "paddle_tpu.serving.fleet.worker",
                "--index", str(index)]
-        for k, v in model_args.items():
-            cmd += [f"--{k.replace('_', '-')}", str(v)]
+        for spec in specs:
+            cmd += ["--model-spec", json.dumps(spec)]
         proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                 text=True)
         deadline = time.monotonic() + startup_timeout
@@ -302,17 +311,30 @@ class SubprocessReplica:
             return s
 
         sock = cls._CONNECT_RETRY.call(connect)
-        return cls(rid, index, proc, sock, meta)
+        return cls(rid, index, proc, sock, meta, specs=specs,
+                   extra_env=extra_env)
 
     # -- wire --------------------------------------------------------------
-    def _rpc(self, obj):
+    def _rpc(self, obj, timeout=None):
+        """One request/response over the framed socket. ``timeout``
+        temporarily widens the socket timeout for RPCs whose server-side
+        work legitimately blocks (retire drains a whole version) — the
+        default 60s connect timeout would otherwise trip mid-drain and
+        mark a healthy worker dead."""
         if self._dead:
             raise ReplicaError(f"replica {self.rid} is dead", fatal=True)
         body = json.dumps(obj).encode()
         try:
             with self._sock_lock:
-                frame_send(self._sock, body)
-                resp = frame_recv(self._sock)
+                old_to = self._sock.gettimeout()
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                try:
+                    frame_send(self._sock, body)
+                    resp = frame_recv(self._sock)
+                finally:
+                    if timeout is not None:
+                        self._sock.settimeout(old_to)
         except (ConnectionError, OSError, struct.error) as e:
             self._dead = True
             raise ReplicaError(
@@ -366,12 +388,36 @@ class SubprocessReplica:
     def deploy(self, builder, name, new_version):
         raise ReplicaError(
             "subprocess replicas deploy by replacement (spawn a worker "
-            "hosting the new version, drain + retire this one), not "
-            "in-place registration")
+            "hosting the new version, drain + retire this one) — the "
+            "router's deploy(worker_spec=...) drives spawn_replacement()"
+            ", not in-place registration")
+
+    def spawn_replacement(self, new_spec, startup_timeout=240.0):
+        """Rolling-deploy pass 1 for the subprocess transport: spawn a
+        fresh worker into THIS replica's slot (same rid/index, same env)
+        hosting every spec this worker hosts PLUS ``new_spec`` — the old
+        version keeps serving on the replacement until the router's pin
+        flips and pass 2 retires it over the wire."""
+        return SubprocessReplica.spawn(
+            self.rid, self.index, self._specs + [dict(new_spec)],
+            extra_env=self._extra_env, startup_timeout=startup_timeout)
 
     def retire(self, name, version, timeout=120.0):
-        raise ReplicaError(
-            "subprocess replicas retire by replacement; see deploy()")
+        """Drain-before-retire one hosted version over the RPC wire
+        (registry unregistration crosses processes fine; only builder
+        closures cannot)."""
+        resp = self._rpc({"cmd": "retire", "name": name,
+                          "version": str(version), "timeout": timeout},
+                         timeout=timeout + 30.0)
+        if not resp.get("ok"):
+            raise ReplicaError(
+                f"replica {self.rid} retire({name}@{version}) failed: "
+                f"{resp.get('error', {}).get('message')}")
+        self._meta["models"] = resp.get("models",
+                                        self._meta.get("models", []))
+        self._specs = [s for s in self._specs
+                       if not (s.get("name") == name
+                               and str(s.get("version")) == str(version))]
 
     def trace_count(self):
         return int(self._meta.get("trace", -1))

@@ -700,15 +700,28 @@ class FleetRouter:
             self._idle_ticks = 0
 
     # -- rolling deploys ---------------------------------------------------
-    def deploy(self, builder, version, name=None, timeout=120.0):
+    def deploy(self, builder, version, name=None, timeout=120.0,
+               worker_spec=None):
         """Roll (name, version) across the fleet with zero downtime, in
-        two passes. Pass 1 REGISTERS the new version on every live
-        replica while the old one keeps serving (the multi-tenant
-        registry hosts both; unversioned traffic stays PINNED to the old
-        version, so nothing races the roll — a mixed-version fleet is
-        only reachable by explicit version). Once every replica hosts
-        the new version the pin flips atomically; pass 2 then
-        DRAIN-RETIRES the old version replica by replica — queued and
+        two passes. Pass 1 makes every live replica HOST the new version
+        while the old one keeps serving (unversioned traffic stays
+        PINNED to the old version, so nothing races the roll — a
+        mixed-version fleet is only reachable by explicit version):
+
+        * local replicas register the builder in-place (the multi-tenant
+          registry hosts both versions);
+        * subprocess replicas deploy by WORKER REPLACEMENT — a builder
+          closure cannot cross the process boundary, so the router
+          spawns a replacement worker hosting old+new from
+          ``worker_spec`` (the new version's decoder geometry kwargs),
+          steals the old worker's queued backlog for re-dispatch
+          (deadlines intact), waits for its in-flight slots to land,
+          swaps the replacement into the same routing slot, and closes
+          the old process.
+
+        Once every replica hosts the new version the pin flips
+        atomically; pass 2 then DRAIN-RETIRES the old version replica by
+        replica (over the RPC wire for subprocess replicas) — queued and
         in-flight old-version generations finish before each entry
         leaves its registry. Explicit old-version requests after the
         flip fail over between replicas until the version is gone, then
@@ -720,13 +733,30 @@ class FleetRouter:
             old_version = self._pin.get(name)
             rids = [rid for rid in sorted(self._replicas)
                     if not self._health[rid].dead]
+            # precondition BEFORE any replica is mutated: a mixed fleet
+            # missing worker_spec must fail with zero replicas touched
+            # (a half-registered pass 1 cannot be retried — re-register
+            # raises on the replicas that already host the version)
+            if worker_spec is None and any(
+                    hasattr(self._replicas[rid], "spawn_replacement")
+                    for rid in rids):
+                raise RuntimeError(
+                    "fleet contains replicas that deploy by worker "
+                    "replacement: deploy(..., worker_spec={decoder "
+                    "geometry kwargs}) is required")
         version = str(version)
-        for rid in rids:            # pass 1: register, old keeps serving
+        for rid in rids:            # pass 1: host new, old keeps serving
             with self._lock:
                 handle = self._replicas.get(rid)
                 if handle is None or self._health[rid].dead:
                     continue
-            handle.deploy(builder, name, version)
+            if hasattr(handle, "spawn_replacement"):
+                self._replace_replica(
+                    rid, handle,
+                    {**worker_spec, "name": name, "version": version},
+                    timeout)
+            else:
+                handle.deploy(builder, name, version)
         with self._lock:
             self._pin[name] = version
         if old_version is not None and old_version != version:
@@ -738,6 +768,33 @@ class FleetRouter:
                 handle.retire(name, old_version, timeout=timeout)
         self._metrics.incr("deploys")
         return version
+
+    def _replace_replica(self, rid, old, spec, timeout):
+        """Swap a freshly spawned replacement worker into `rid`'s slot:
+        spawn FIRST (the fleet never dips below strength), then
+        quarantine the old worker from routing, steal its queued backlog
+        (re-dispatched under original deadlines), wait for in-flight
+        slots to land, commit the swap, close the old process. A spawn
+        or drain failure re-admits the old worker untouched. All
+        process/transport I/O runs OUTSIDE the router lock."""
+        replacement = old.spawn_replacement(spec)
+        with self._lock:
+            self._draining.add(rid)
+        try:
+            self._steal_and_park(rid, old)
+            self._wait_inflight_drained(rid, timeout)
+        except Exception:
+            with self._lock:
+                self._draining.discard(rid)
+            replacement.close()
+            raise
+        with self._lock:
+            self._draining.discard(rid)
+            self._replicas[rid] = replacement
+            self._health[rid].revive()   # fresh process, fresh breaker
+        old.close()
+        self._metrics.incr("replaced_deploys")
+        return replacement
 
     def _steal_and_park(self, rid, handle):
         try:

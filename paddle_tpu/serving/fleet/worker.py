@@ -8,6 +8,17 @@ serving-ready with ZERO traces), prints one ``FLEET_WORKER_READY``
 JSON line naming its port and compile sources, and serves the router's
 length-prefixed JSON RPC on a single connection.
 
+Rolling deploys by REPLACEMENT (ROADMAP 3(b)): a builder closure cannot
+cross a process boundary, so a subprocess replica never deploys
+in-place. Instead the worker accepts any number of ``--model-spec
+'{...}'`` JSON geometries (each a (name, version) registry entry served
+concurrently by the multi-tenant engine), and the router rolls a new
+version by spawning a REPLACEMENT worker hosting old+new specs into the
+dead man's slot, stealing the old worker's backlog, and drain-retiring
+it — then pass 2 retires the old version from the replacement via the
+``retire`` RPC (a registry unregistration, which DOES cross the wire).
+The legacy single-model flags stay byte-compatible.
+
 Chaos contract: the worker fires the ``replica.kill`` fault site (rank
 = ``--index``) at the top of EVERY RPC it serves, so a schedule entry
 ``{"site": "replica.kill", "action": "kill", "rank": N, "at_call": K}``
@@ -41,6 +52,19 @@ def _result_payload(resp):
     return {"tokens": [int(t) for t in resp.result()["tokens"]]}
 
 
+def model_specs(args):
+    """The (possibly several) decoder geometries this worker hosts:
+    every ``--model-spec`` JSON, each defaulted from the legacy single-
+    model flags; no specs = exactly the legacy single model."""
+    base = dict(vocab_size=args.vocab_size, hidden=args.hidden,
+                num_layers=args.num_layers, slots=args.slots,
+                max_len=args.max_len, eos_id=args.eos_id,
+                name=args.name, version=args.version)
+    if not args.model_spec:
+        return [base]
+    return [{**base, **json.loads(s)} for s in args.model_spec]
+
+
 def serve(args):
     from paddle_tpu.resilience import faults
     from paddle_tpu.serving.decode import (
@@ -53,12 +77,10 @@ def serve(args):
         queue_depth=args.queue_depth, breaker_threshold=0,
         label=f"fleet-worker-{args.index}",
     )
-    entry = engine.register_model(lambda: build_decoder_model(
-        vocab_size=args.vocab_size, hidden=args.hidden,
-        num_layers=args.num_layers, slots=args.slots,
-        max_len=args.max_len, eos_id=args.eos_id,
-        name=args.name, version=args.version,
-    ))
+    entries = []
+    for spec in model_specs(args):
+        entries.append(engine.register_model(
+            lambda spec=spec: build_decoder_model(**spec)))
     engine.start()
 
     srv = socket.socket()
@@ -69,8 +91,8 @@ def serve(args):
         "port": srv.getsockname()[1],
         "pid": os.getpid(),
         "models": ["@".join(k) for k in engine.models()],
-        "trace": entry.compile_sources.get("trace", 0),
-        "compile_sources": entry.compile_sources,
+        "trace": sum(e.compile_sources.get("trace", 0) for e in entries),
+        "compile_sources": entries[0].compile_sources,
     }), flush=True)
 
     conn, _addr = srv.accept()
@@ -135,6 +157,20 @@ def serve(args):
                             del tickets[t]
                             break
             _send(conn, {"tickets": stolen})
+        elif cmd == "retire":
+            # rolling-deploy pass 2 over the wire: drain-before-retire
+            # one hosted (name, version) from the multi-tenant registry
+            try:
+                engine.unregister_model(
+                    msg["name"], msg["version"],
+                    timeout=float(msg.get("timeout", 120.0)))
+            except Exception as e:
+                _send(conn, {"ok": False,
+                             "error": {"code": "request_failed",
+                                       "message": str(e)}})
+                continue
+            _send(conn, {"ok": True,
+                         "models": ["@".join(k) for k in engine.models()]})
         elif cmd == "stop":
             engine.shutdown()
             _send(conn, {"ok": True})
@@ -161,6 +197,10 @@ def main(argv=None):
     ap.add_argument("--eos-id", type=int, default=None)
     ap.add_argument("--name", type=str, default="fleet")
     ap.add_argument("--version", type=str, default="1")
+    ap.add_argument("--model-spec", action="append", default=None,
+                    help="JSON decoder geometry to host (repeatable; "
+                         "each a (name, version) registry entry, "
+                         "defaulted from the single-model flags)")
     ap.add_argument("--queue-depth", type=int, default=64)
     args = ap.parse_args(argv)
     try:

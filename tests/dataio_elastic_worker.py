@@ -1,0 +1,138 @@
+"""Subprocess worker for the ELASTIC crash-resume determinism test.
+
+The elastic sibling of ``dataio_resume_worker.py``: one rank of a
+(world)-sized gang trains a tiny linear model over an elastic
+DataEngine stream with AutoCheckpoint carrying the iterator position.
+Every emitted batch is appended to the log as one JSON line naming the
+rank/world/epoch, the batch's epoch-GLOBAL sample positions, a sha256
+per sample, and the loss — so the parent test can reconstruct the
+committed global stream across a 4 -> 2 world-size change and assert
+per-sample exactly-once consumption plus digest conservation against a
+world-1 reference run of this same script.
+
+``--kill-at-step N`` SIGKILLs right after step N (mid-epoch, after that
+step's checkpoint decision); ``--resume-step S`` pins the elastic
+resume to ``ckpt_S`` (the sync step the parent chose), letting the
+engine translate the world-4 blob onto this rank's new geometry;
+``--max-steps`` stops a surviving rank early (the parent "terminates"
+the old gang).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.ir import Program, program_guard
+from paddle_tpu.dataio import DataEngine, ListSource
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+N_SAMPLES = 96
+BATCH = 4
+
+
+def transform(i, rng):
+    x = (np.full(4, float(i), dtype=np.float32) * 0.01
+         + np.float32(rng.random() * 1e-3))
+    return (x, np.array([x.sum()], dtype=np.float32))
+
+
+def sample_digest(x, y):
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(x).tobytes())
+    h.update(np.ascontiguousarray(y).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckdir", required=True,
+                    help="base dir; this rank uses <ckdir>/rank<r>")
+    ap.add_argument("--log", required=True)
+    ap.add_argument("--tag", required=True)
+    ap.add_argument("--rank", type=int, default=0)
+    ap.add_argument("--world", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--num-workers", type=int, default=0)
+    ap.add_argument("--save-interval", type=int, default=2)
+    ap.add_argument("--kill-at-step", type=int, default=-1)
+    ap.add_argument("--max-steps", type=int, default=-1)
+    ap.add_argument("--resume-step", type=int, default=-1)
+    args = ap.parse_args()
+
+    source = ListSource(list(range(N_SAMPLES)), seed=args.seed,
+                        rank=args.rank, world=args.world)
+    engine = DataEngine(source, transform=transform, batch_size=BATCH,
+                        drop_last=True, num_workers=args.num_workers,
+                        elastic=True)
+
+    main_p, startup = Program(), Program()
+    with program_guard(main_p, startup):
+        x = fluid.data("x", shape=[-1, 4])
+        y = fluid.data("y", shape=[-1, 1])
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        feeder = fluid.DataFeeder([x, y])
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ck = AutoCheckpoint(exe, main_p,
+                        os.path.join(args.ckdir, f"rank{args.rank}"),
+                        save_interval_steps=args.save_interval,
+                        max_to_keep=16, data_state=engine)
+    if args.resume_step >= 0:
+        # pinned elastic resume: params from this rank's own chain at
+        # the sync step, data blob translated onto (world, rank) by the
+        # elastic engine
+        step = ck.resume(step=args.resume_step)
+    else:
+        step = ck.resume()
+
+    with open(args.log, "a") as logf:
+        while engine.epoch < args.epochs:
+            if args.max_steps >= 0 and step >= args.max_steps:
+                break
+            advanced = False
+            for batch in engine:
+                feed = feeder.feed(batch)
+                out = exe.run(main_p, feed=feed, fetch_list=[loss])
+                c0 = engine.cursor - BATCH
+                positions = [engine.base + j * args.world + args.rank
+                             for j in range(c0, engine.cursor)]
+                digests = [sample_digest(bx, by) for bx, by in batch]
+                logf.write(json.dumps({
+                    "tag": args.tag, "rank": args.rank,
+                    "world": args.world, "step": step,
+                    "epoch": engine.epoch, "positions": positions,
+                    "digests": digests,
+                    "loss": float(out[0][0]).hex(),
+                }) + "\n")
+                logf.flush()
+                advanced = True
+                ck.maybe_save(step, blocking=True)
+                if step == args.kill_at_step:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                step += 1
+                if args.max_steps >= 0 and step >= args.max_steps:
+                    break
+            if not advanced:
+                break  # empty epoch shard: nothing left for this rank
+    ck.close()
+    print(f"DONE rank={args.rank} step={step} "
+          f"emitted={engine.emitted_batches}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

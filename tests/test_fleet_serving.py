@@ -520,12 +520,22 @@ def test_rolling_deploy_pins_until_complete_then_flips():
                       version="2")
         stop = True
         t.join(30)
-        bad = [x for x in mid_roll
-               if not (isinstance(x, list) and x == ref_v1)]
-        assert not bad, f"mid-roll traffic disturbed: {bad[:3]}"
         ref_v2 = router._replicas["r0"].engine.entry(
             "fleet_t", "2").offline_decode(p, 4)
         assert ref_v2 != ref_v1
+        # every mid-roll answer is a CLEAN version's bytes (no errors,
+        # no torn outputs), and the unversioned stream switches v1 -> v2
+        # exactly once: v1 until the atomic pin flip, v2 after — a v1
+        # answer after a v2 one would mean a request raced the roll.
+        # (deploy() keeps draining pass 2 AFTER the flip, so traffic
+        # legitimately sees v2 before deploy returns.)
+        bad = [x for x in mid_roll
+               if not (isinstance(x, list) and x in (ref_v1, ref_v2))]
+        assert not bad, f"mid-roll traffic disturbed: {bad[:3]}"
+        switches = [a != b for a, b in zip(mid_roll, mid_roll[1:])]
+        assert sum(switches) <= 1, "mid-roll traffic flapped versions"
+        assert mid_roll and mid_roll[0] == ref_v1, \
+            "traffic saw v2 before the flip"
         got = [int(t) for t in
                router.submit(p, max_new_tokens=4).result(60)["tokens"]]
         assert got == ref_v2
@@ -534,6 +544,143 @@ def test_rolling_deploy_pins_until_complete_then_flips():
         st = router.stats()
         assert st["pinned_versions"] == {"fleet_t": "2"}
         assert st["deploys"] == 1
+        with pytest.raises(RejectedError):
+            router.submit(p, max_new_tokens=4, version="1")
+    finally:
+        router.shutdown()
+
+
+class _FakeReplaceableHandle(_FakeHandle):
+    """A subprocess-shaped handle: deploys by replacement, retires over
+    the 'wire'. Tracks the protocol calls the router must make."""
+
+    transport = "fake-subprocess"
+
+    def __init__(self, rid, index, hosted=None, log=None):
+        super().__init__(rid, index)
+        self.hosted = hosted or [("m", "1")]
+        self.log = log if log is not None else []
+        self.closed = False
+
+    def models(self):
+        return list(self.hosted)
+
+    def deploy(self, builder, name, new_version):
+        raise AssertionError("in-place deploy must not be used on a "
+                             "replacement-capable handle")
+
+    def spawn_replacement(self, new_spec, startup_timeout=0):
+        self.log.append(("spawn_replacement", self.rid, new_spec["name"],
+                         new_spec["version"]))
+        return _FakeReplaceableHandle(
+            self.rid, self.index,
+            hosted=self.hosted + [(new_spec["name"],
+                                   str(new_spec["version"]))],
+            log=self.log)
+
+    def steal_queued(self):
+        self.log.append(("steal", self.rid))
+        return []
+
+    def retire(self, name, version, timeout=0):
+        self.log.append(("retire", self.rid, name, str(version)))
+        self.hosted = [m for m in self.hosted
+                       if m != (name, str(version))]
+
+    def close(self, timeout=0):
+        self.log.append(("close", self.rid))
+        self.closed = True
+
+
+def test_deploy_by_replacement_protocol_order():
+    """ROADMAP 3(b) unit: a replacement-capable replica deploys by
+    spawn-replacement -> steal backlog -> swap into the same slot ->
+    close old; pass 2 retires the old version from the REPLACEMENT over
+    the wire. worker_spec is mandatory for such replicas."""
+    router = FleetRouter(health_interval_s=1e9)
+    log = []
+    old = _FakeReplaceableHandle("r0", 0, log=log)
+    router.add_replica(old)
+
+    class _LocalFake(_FakeHandle):
+        deploys = []
+
+        def deploy(self, builder, name, version):
+            self.deploys.append((name, version))
+
+    local = _LocalFake("r1", 1)
+    router.add_replica(local)
+    # precondition fires up front: ZERO replicas touched (a
+    # half-registered pass 1 could never be retried)
+    with pytest.raises(RuntimeError, match="worker_spec"):
+        router.deploy(None, version="2", name="m")
+    assert not old.closed and router._replicas["r0"] is old
+    assert not log and not local.deploys
+    with router._lock:
+        del router._replicas["r1"]
+        del router._health["r1"]
+
+    router.deploy(None, version="2", name="m",
+                  worker_spec={"hidden": 8})
+    new = router._replicas["r0"]
+    assert new is not old and old.closed and not new.closed
+    # replacement hosted both until pass 2 retired the old version
+    assert new.models() == [("m", "2")]
+    assert router.stats()["pinned_versions"]["m"] == "2"
+    assert router.metrics.count("replaced_deploys") == 1
+    assert router.metrics.count("deploys") == 1
+    spawn_i = log.index(("spawn_replacement", "r0", "m", "2"))
+    steal_i = log.index(("steal", "r0"))
+    close_i = log.index(("close", "r0"))
+    retire_i = log.index(("retire", "r0", "m", "1"))
+    assert spawn_i < steal_i < close_i < retire_i
+
+
+@pytest.mark.slow
+def test_subprocess_rolling_deploy_by_replacement(tmp_path):
+    """ROADMAP 3(b) with a REAL subprocess: the router rolls a new
+    (model, version) onto a SubprocessReplica by spawning a replacement
+    worker hosting old+new, draining the old worker out of its slot,
+    flipping the pin, and retiring the old version over the RPC wire.
+    v2 has different geometry, so the version switch is provable in the
+    output bytes."""
+    cache = str(tmp_path / "cache")
+    margs = {**GEOM, "name": "flt_roll", "version": "1"}
+    r0 = SubprocessReplica.spawn(
+        "r0", 0, margs, extra_env={"PADDLE_TPU_CACHE_DIR": cache})
+    old_pid = r0.proc.pid
+
+    # in-process references: deterministic init = byte-identical weights
+    engine = GenerationEngine(breaker_threshold=0, label="roll-ref")
+    e1 = engine.register_model(_builder(name="flt_roll", version="1"))
+    e2 = engine.register_model(_builder(name="flt_roll", version="2",
+                                        num_layers=2))
+    p = [3, 1, 4]
+    ref_v1 = e1.offline_decode(p, 4)
+    ref_v2 = e2.offline_decode(p, 4)
+    assert ref_v1 != ref_v2
+
+    router = FleetRouter(health_interval_s=0.02)
+    router.add_replica(r0)
+    router.start()
+    try:
+        got = [int(t) for t in
+               router.submit(p, max_new_tokens=4).result(240)["tokens"]]
+        assert got == ref_v1
+        router.deploy(None, version="2", name="flt_roll",
+                      worker_spec={**GEOM, "num_layers": 2})
+        new = router._replicas["r0"]
+        assert isinstance(new, SubprocessReplica)
+        assert new.proc.pid != old_pid, "no replacement worker spawned"
+        assert r0.proc.poll() is not None, "old worker still running"
+        # pass 2 retired v1 over the wire: only v2 remains hosted
+        assert new.models() == [("flt_roll", "2")]
+        got = [int(t) for t in
+               router.submit(p, max_new_tokens=4).result(240)["tokens"]]
+        assert got == ref_v2, "unversioned traffic not on the new version"
+        st = router.stats()
+        assert st["replaced_deploys"] == 1 and st["deploys"] == 1
+        assert st["pinned_versions"]["flt_roll"] == "2"
         with pytest.raises(RejectedError):
             router.submit(p, max_new_tokens=4, version="1")
     finally:
