@@ -104,8 +104,13 @@ def _bench():
         # passed TPU-sized args: cap batch, keep the metric shape identical
         batch = min(batch, 8)
     cfg = bert.BertConfig.base()
-    if os.environ.get("PADDLE_TPU_BENCH_FLASH", "1") != "0" and on_tpu:
-        # flash path: Pallas fused attention fwd+bwd. The kernel applies no
+    from paddle_tpu import kernels as _kernels_probe
+
+    if os.environ.get("PADDLE_TPU_BENCH_FLASH", "1") != "0" and \
+            _kernels_probe.probe("flash_attention"):
+        # flash path: Pallas fused attention fwd+bwd, taken whenever the
+        # kernel registry would actually serve it (auto on TPU, or
+        # PADDLE_TPU_KERNELS=interpret anywhere). The kernel applies no
         # attention-prob dropout (enforced, models/bert.py), so that knob
         # is 0 here - recorded in extra so the config change is visible.
         cfg.use_flash_attention = True
@@ -170,6 +175,11 @@ def _bench():
     frac_roofline = achieved / roofline if roofline else 0.0
 
 
+    # flash_attention is a LIVE registry probe (paddle_tpu/kernels/,
+    # imported above): would the Pallas flash kernel serve the sdpa op
+    # on this backend under the current PADDLE_TPU_KERNELS mode
+    # (auto/off/interpret — set PADDLE_TPU_KERNELS=off to opt out of
+    # every registry kernel)?
     extra = {
         "device": "tpu" if on_tpu else "cpu",
         "backend_diag": diag,
@@ -180,7 +190,12 @@ def _bench():
         "roofline_tfps": round(roofline / 1e12, 1) if roofline else 0.0,
         "frac_of_roofline": round(frac_roofline, 4),
         "final_loss": final_loss,
-        "flash_attention": bool(getattr(cfg, "use_flash_attention", False)),
+        "flash_attention": _kernels_probe.probe("flash_attention"),
+        "kernels": {
+            "mode": _kernels_probe.mode(),
+            "resolved": _kernels_probe.resolved_mode(),
+            "registry": [s.name for s in _kernels_probe.all_specs()],
+        },
         "max_predictions_per_seq": max_pred,
         "attention_dropout": cfg.attention_probs_dropout_prob,
         "rng_impl": _flags.rng_impl,

@@ -36,7 +36,7 @@ from paddle_tpu.analysis.verify import Diagnostic
 from paddle_tpu.core.dtypes import dtype_size
 
 __all__ = ["MemoryReport", "estimate_peak_hbm", "check_donation_safety",
-           "check_hbm_budget"]
+           "check_hbm_budget", "remat_hbm_delta"]
 
 _OP_ROLE_BACKWARD = 1
 _OP_ROLE_OPTIMIZE = 2
@@ -106,7 +106,7 @@ def _bytes_of(name, shape_report, value_specs, axis_sizes, block=None,
 
 def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
                       donate=True, shape_report=None,
-                      sharding_report=None):
+                      sharding_report=None, kernel_path=None):
     """Static per-device peak-HBM upper bound for one step of `program`.
 
     ``sharding_report`` (analysis/sharding.py) supplies per-var specs and
@@ -114,7 +114,19 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
     device). Returns a MemoryReport; ``unknown_vars`` lists names whose
     size could not be resolved (symbolic dims with no feed binding) —
     they are excluded from the totals, so bind the feeds for tight
-    numbers."""
+    numbers.
+
+    ``kernel_path`` models the Pallas kernel registry
+    (paddle_tpu/kernels/): a fused attention op's COMPOSITE fallback
+    materializes dense intermediates (the paged [S, L, H] gather views)
+    that the kernel keeps in VMEM. False counts those composite
+    internals; True counts none; None (default) consults the live
+    registry selection for this process — so the estimate tracks what
+    the lowering will actually emit. Remat policies are accounted
+    regardless: a ``recompute_segment_grad`` op's
+    ``__segment_saved_names__[policy]`` vars stay live from the end of
+    its forward segment to the grad op (the span the default
+    save-nothing policy frees)."""
     if shape_report is None:
         shape_report = infer_shapes(program, feed_shapes=feed_shapes)
     value_specs = {}
@@ -196,6 +208,65 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
 
     sub_peaks = {}
 
+    def fused_internal(op):
+        """Composite-fallback internals of a kernel-registry fused op
+        (zero when the kernel serves it — its workset stays in VMEM)."""
+        if op.type not in ("cached_attention", "paged_attention"):
+            return 0
+        use_kernel = kernel_path
+        if use_kernel is None:
+            from paddle_tpu.kernels import registry as _kr
+
+            use_kernel = _kr.selected(op.type) is not None
+        if use_kernel:
+            return 0
+        from paddle_tpu.kernels import fallback_internal_bytes
+
+        def shape_of(slot):
+            names = op.inputs.get(slot)
+            if not names:
+                return None
+            info = shape_report.get(names[0])
+            if info is None or info.shape is None or any(
+                    is_sym(d) for d in info.shape):
+                return None
+            return info.shape
+
+        q = shape_of("Q")
+        itemsize = 4
+        if q is not None:
+            info = shape_report.get(op.inputs["Q"][0])
+            if info is not None and info.dtype:
+                itemsize = dtype_size(info.dtype)
+        return fallback_internal_bytes(op.type, op.attrs, shape_of,
+                                       itemsize)
+
+    def remat_extra(blk):
+        """Per-op-point bytes the chosen remat policy pins across
+        fwd->bwd: the saved values already count INSIDE the forward
+        segment (normal liveness); this adds the segment-end -> grad-op
+        span the save-nothing policy would free. Names resolve through
+        the same feed-bound, shard-aware ``bytes_of`` as everything
+        else."""
+        extra = [0] * len(blk.ops)
+        for gi, op in enumerate(blk.ops):
+            if op.type != "recompute_segment_grad":
+                continue
+            names = (op.attrs.get("__segment_saved_names__") or {}).get(
+                op.attrs.get("__remat_policy__", "full"), ())
+            saved = sum(bytes_of(n, blk) or 0 for n in names)
+            if not saved:
+                continue
+            outs = set(op.attrs.get("__out_names__") or ())
+            fi = None
+            for j in range(gi - 1, -1, -1):
+                if outs & set(blk.ops[j].output_names()):
+                    fi = j
+                    break
+            for j in range((fi if fi is not None else 0), gi):
+                extra[j] += saved
+        return extra
+
     def block_peak(blk, fetches, top=False):
         ud = usedef if top else UseDefMap(blk)
         live_after = [set() for _ in blk.ops]
@@ -212,10 +283,12 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
         if top:
             report.peak_op_index, report.peak_op_type = -1, "<entry>"
             report.timeline.append((-1, "<entry>", peak))
+        extra = remat_extra(blk)
         for i, op in enumerate(blk.ops):
             if op.type in ("feed", "fetch"):
                 continue
-            b = live_bytes(blk, live_after[i])
+            b = live_bytes(blk, live_after[i]) + extra[i]
+            b += fused_internal(op)
             for bi in sub_block_indices(op):
                 if bi not in sub_peaks:
                     sub_peaks[bi] = block_peak(program.block(bi), ())
@@ -244,6 +317,35 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
 # ---------------------------------------------------------------------------
 # donation safety — the pre-lowering hard-error gate
 # ---------------------------------------------------------------------------
+
+
+def remat_hbm_delta(program_plain, program_remat, *, feed_shapes=None,
+                    fetch_names=()):
+    """Pre-compile peak-HBM delta of a remat decision: the same model
+    built WITHOUT checkpoints vs WITH (RecomputeOptimizer + an IR-keyed
+    policy, kernels/remat.py). Both sides are pure static analysis —
+    this is the number an operator reads BEFORE paying a compile to
+    decide whether a long-sequence config trades HBM for recompute."""
+    plain = estimate_peak_hbm(program_plain, feed_shapes=feed_shapes,
+                              fetch_names=fetch_names)
+    remat = estimate_peak_hbm(program_remat, feed_shapes=feed_shapes,
+                              fetch_names=fetch_names)
+    policies = sorted({
+        op.attrs.get("__remat_policy__")
+        for op in program_remat.global_block().ops
+        if op.type == "recompute_segment_grad"
+        and op.attrs.get("__remat_policy__")
+    })
+    return {
+        "plain_peak_bytes": plain.peak_total_bytes,
+        "remat_peak_bytes": remat.peak_total_bytes,
+        "plain_intermediate_bytes": plain.peak_intermediate_bytes,
+        "remat_intermediate_bytes": remat.peak_intermediate_bytes,
+        "saved_bytes": plain.peak_total_bytes - remat.peak_total_bytes,
+        "ratio": (plain.peak_total_bytes
+                  / float(max(remat.peak_total_bytes, 1))),
+        "policies": policies,
+    }
 
 
 def check_hbm_budget(report, budget_bytes, label=""):

@@ -997,6 +997,28 @@ def _r_sdpa(ctx):
     ctx.set("Out", out, q.dtype)
 
 
+@rule("cached_attention")
+def _r_cached_attention(ctx):
+    q, v = ctx.first("Q"), ctx.first("VCache")
+    if q is None or q.shape is None:
+        return
+    out = tuple(q.shape)
+    if v is not None and v.shape is not None:
+        out = tuple(out[:-1]) + (v.shape[-1],)
+    ctx.set("Out", out, q.dtype)
+
+
+@rule("paged_attention")
+def _r_paged_attention(ctx):
+    q, v = ctx.first("Q"), ctx.first("VArena")
+    if q is None or q.shape is None:
+        return
+    out = tuple(q.shape)
+    if v is not None and v.shape is not None:
+        out = tuple(out[:-1]) + (v.shape[-1],)
+    ctx.set("Out", out, q.dtype)
+
+
 @rule("while", "conditional_block")
 def _r_control_flow(ctx):
     # handled structurally by the walker (sub-block recursion); outputs
@@ -1006,13 +1028,16 @@ def _r_control_flow(ctx):
 
 #: matmul-family op types the AMP lint watches
 _AMP_MATMUL_OPS = ("mul", "matmul", "matmul_v2", "conv2d",
-                   "depthwise_conv2d", "scaled_dot_product_attention")
+                   "depthwise_conv2d", "scaled_dot_product_attention",
+                   "cached_attention", "paged_attention")
 
 #: their operand slots
 _AMP_OPERAND_SLOTS = {
     "mul": ("X", "Y"), "matmul": ("X", "Y"), "matmul_v2": ("X", "Y"),
     "conv2d": ("Input", "Filter"), "depthwise_conv2d": ("Input", "Filter"),
     "scaled_dot_product_attention": ("Q", "K", "V"),
+    "cached_attention": ("Q", "KCache", "VCache"),
+    "paged_attention": ("Q", "KArena", "VArena"),
 }
 
 

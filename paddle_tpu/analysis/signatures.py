@@ -68,6 +68,16 @@ _SIGNATURES = {
     "scaled_dot_product_attention": OpSignature(
         same_dtype=[("Q", "K", "V")], ranks={"Q": 4, "K": 4, "V": 4}
     ),
+    "cached_attention": OpSignature(
+        same_dtype=[("Q", "KCache", "VCache", "Bias")],
+        ranks={"Q": 2, "KCache": 3, "VCache": 3, "Bias": 3},
+        dtype_family={"Q": "float"},
+    ),
+    "paged_attention": OpSignature(
+        same_dtype=[("Q", "KArena", "VArena", "Bias")],
+        ranks={"Q": 2, "KArena": 2, "VArena": 2, "Rows": 1, "Bias": 3},
+        dtype_family={"Q": "float", "Rows": "int"},
+    ),
     "lookup_table": OpSignature(
         dtype_family={"Ids": "int", "W": "float"}, ranks={"W": 2}
     ),

@@ -393,8 +393,12 @@ class CompiledProgram:
                     "back to the dense fused form (no wire savings)"
                 )
                 dgc_sparse = False
+        from paddle_tpu.kernels import registry as _kernel_registry
+
+        # resolved kernel mode joins the cheap key (see executor.py)
         key = (self._program._uid, self._program._version, feed_sig,
-               tuple(fetch_names), dgc_sparse)
+               tuple(fetch_names), dgc_sparse,
+               _kernel_registry.resolved_mode())
         entry = self._cache.get(key)
         if dgc_sparse:
             # expand U/V accumulators to per-shard [n, ...] state; runs on

@@ -236,6 +236,31 @@ def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires, readers):
         )
         for o in seg_ops
     ]
+    # IR-keyed remat policy (paddle_tpu/kernels/remat.py): the policy
+    # rides in op attrs (so a flip retraces via the content-addressed
+    # cache), together with the NAME lists of what each policy would
+    # additionally pin across fwd->bwd. analysis/memory.py resolves
+    # those names through its feed-bound, shard-aware shape report (the
+    # forward ops stay in the program, so the names keep inferred
+    # shapes) and adds the chosen policy's bytes to every program point
+    # between the segment's end and its grad op — predicting the
+    # peak-HBM delta of a policy change before any compile.
+    from paddle_tpu.kernels import remat as _remat
+
+    policy = _remat.validate_policy(
+        getattr(block.program, "_recompute_policy", None)
+        or _remat.DEFAULT_POLICY
+    )
+    out_set = set(out_names)
+    dot_ops = {"mul", "matmul", "matmul_v2", "conv2d", "depthwise_conv2d"}
+    dots_saved, all_saved = [], []
+    for o in seg_ops:
+        for n in o.output_names():
+            if n in out_set:
+                continue           # boundary values are saved regardless
+            all_saved.append(n)
+            if o.type in dot_ops:
+                dots_saved.append(n)
     attrs = {
         "__segment__": segment,
         "__in_names__": list(in_names),
@@ -244,6 +269,11 @@ def _make_segment_op(block, seg_ops, ckpt_set, loss_name, requires, readers):
             n for n in in_names if n in requires and _is_float_var(block, n)
         ],
         "__diff_outs__": [n for n in out_names if _is_float_var(block, n)],
+        "__remat_policy__": policy,
+        "__segment_saved_names__": {
+            "full": [], "dots": dots_saved, "dots_no_batch": dots_saved,
+            "save_all": all_saved,
+        },
     }
     return Operator(
         block, "recompute_segment", {"X": in_names}, {"Out": out_names}, attrs

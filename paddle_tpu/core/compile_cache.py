@@ -153,6 +153,7 @@ def program_fingerprint(
     mesh=None,
     sharding_sig=None,
     layout_sig=None,
+    kernel_sig=None,
     extra=(),
 ):
     """Content-addressed identity of one lowered step.
@@ -191,6 +192,13 @@ def program_fingerprint(
         # are byte-identical to pre-registry revisions — a deploy of this
         # code does not cold-miss an existing PADDLE_TPU_CACHE_DIR
         payload["layout"] = layout_sig
+    if kernel_sig is not None:
+        # same discipline for the Pallas kernel registry
+        # (paddle_tpu/kernels/): None whenever every kernel resolves to
+        # its composite fallback, so kernel-less fingerprints stay
+        # byte-identical to pre-registry revisions; any active kernel
+        # selection (mode x registry content) retraces cleanly
+        payload["kernels"] = kernel_sig
     h = hashlib.sha256()
     h.update(program.to_bytes())
     h.update(b"\0")

@@ -634,8 +634,14 @@ class Executor:
         # per-executor cheap key: steady-state steps never pay the
         # content-addressed fingerprint (which serializes the program);
         # on a miss the shared lowering consults the process-wide and
-        # persistent tiers before tracing
-        key = (program._uid, program._version, feed_sig, tuple(fetch_names))
+        # persistent tiers before tracing. The RESOLVED kernel mode
+        # (paddle_tpu/kernels/) joins the cheap key — flipping
+        # PADDLE_TPU_KERNELS must not serve a stale executable from this
+        # per-object tier when the content-addressed one would miss
+        from paddle_tpu.kernels import registry as _kernel_registry
+
+        key = (program._uid, program._version, feed_sig,
+               tuple(fetch_names), _kernel_registry.resolved_mode())
         entry = self._cache.get(key)
         if entry is None:
             from paddle_tpu.core import lowering

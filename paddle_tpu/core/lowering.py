@@ -415,10 +415,15 @@ def lower_step(
     sharding_sig = None
     if in_shardings is not None:
         sharding_sig = _sharding_sig(in_shardings, out_shardings)
+    # the Pallas kernel registry's selection joins the fingerprint here
+    # (the layout_sig pattern): op lowerings consult the registry at
+    # trace time, so a mode/registry change MUST miss the cache
+    from paddle_tpu.kernels import registry as kernel_registry
+
     fingerprint = compile_cache.program_fingerprint(
         program, feed_sig, fetch_names, scope_sig,
         donate=with_donation, mesh=mesh, sharding_sig=sharding_sig,
-        layout_sig=layout_sig,
+        layout_sig=layout_sig, kernel_sig=kernel_registry.kernel_sig(),
         extra=(label.split(":", 1)[0],) + tuple(extra_fingerprint),
     )
 

@@ -120,17 +120,27 @@ class VarBase:
             )
         return np.asarray(self.value)
 
+    def _scalar(self, what):
+        """The single element of a size-1 tensor, extracted explicitly:
+        numpy >= 1.25 deprecates the implicit ndim>0 -> scalar conversion
+        that ``int(np.array([3]))`` used to do. Multi-element tensors
+        keep numpy's error semantics (ambiguous truth / no conversion)."""
+        arr = self._concrete(what)
+        if arr.ndim and arr.size == 1:
+            return arr.reshape(())[()]
+        return arr
+
     def __bool__(self):
-        return bool(self._concrete("bool"))
+        return bool(self._scalar("bool"))
 
     def __float__(self):
-        return float(self._concrete("float"))
+        return float(self._scalar("float"))
 
     def __int__(self):
-        return int(self._concrete("int"))
+        return int(self._scalar("int"))
 
     def __index__(self):
-        return int(self._concrete("index"))
+        return int(self._scalar("index"))
 
     def __repr__(self):
         tag = "ParamBase" if getattr(self, "trainable", None) is not None else "VarBase"

@@ -66,6 +66,12 @@ def _with_retry(fn):
     return _lookup._with_retry(fn)
 
 
+def _kernels_emb():
+    from paddle_tpu.kernels import embedding as kemb
+
+    return kemb
+
+
 class HostStore:
     """Host-RAM overflow tier: per-ep-shard (id -> float32 row) maps.
 
@@ -230,6 +236,23 @@ class _TableRuntime:
         self.g_occupancy.set(0)
         self.g_staleness.set(0)
 
+    def _device_admission(self):
+        """On-device miss admission applies unless the operator opted
+        out (PADDLE_TPU_KERNELS=off restores the legacy host path
+        byte-for-byte) or the slab is mesh-sharded (the multichip arm
+        keeps its P('ep') placement; a host-driven per-shard scatter
+        would need resharding machinery this path does not carry)."""
+        from paddle_tpu.kernels import registry as kreg
+
+        if kreg.mode() == "off":
+            return False
+        v = self.scope.find_var(self.cfg.slab_name)
+        sharding = getattr(v, "sharding", None)
+        if sharding is not None and len(
+                getattr(sharding, "device_set", ())) > 1:
+            return False
+        return True
+
     # -- the per-step path -------------------------------------------------
     def lookup(self, ids, dedup=True, train=True):
         """Resolve a batch: admit misses, evict victims (write-back),
@@ -274,18 +297,38 @@ class _TableRuntime:
                 new_slots.append(s)
             self.m_evictions.inc(len(evicted))
 
-            slab = np.array(self.slab_host())  # host copy; mutated below
-            if evicted:
-                # write-back BEFORE the slots are reused: the victims'
-                # device values are the authoritative ones
-                dirty_ev = [i for i in evicted if i in self._dirty]
+            dirty_ev = [i for i in evicted if i in self._dirty]
+            ev_slots = [s for i, s in zip(evicted, evicted_slots)
+                        if i in self._dirty]
+            if self._device_admission():
+                # on-device admission (kernels/embedding.py): gather ONLY
+                # the victims' rows for write-back, scatter the pulled
+                # miss rows in place (donated) — the [capacity, dim] slab
+                # never round-trips through host numpy
+                slab_dev = self.scope.find_var(self.cfg.slab_name)
                 if dirty_ev:
-                    ev_slots = [s for i, s in zip(evicted, evicted_slots)
-                                if i in self._dirty]
+                    # read-back BEFORE the scatter reuses the slots: the
+                    # victims' device values are the authoritative ones
+                    self._async_push(
+                        dirty_ev, _kernels_emb().read_rows(
+                            slab_dev, ev_slots))
+                    self._dirty.difference_update(dirty_ev)
+                self.scope.set(
+                    self.cfg.slab_name,
+                    _kernels_emb().admit_rows(slab_dev, new_slots, rows),
+                )
+            else:
+                # legacy host path (PADDLE_TPU_KERNELS=off, or a
+                # mesh-sharded slab): full capacity-slab round-trip,
+                # counted so the kernel evidence can assert ZERO
+                _kernels_emb().admission_roundtrip_counter().inc()
+                slab = np.array(self.slab_host())  # host copy
+                if dirty_ev:
+                    # write-back BEFORE the slots are reused
                     self._async_push(dirty_ev, slab[ev_slots].copy())
                     self._dirty.difference_update(dirty_ev)
-            slab[new_slots] = rows
-            self.scope.set(self.cfg.slab_name, slab)
+                slab[new_slots] = rows
+                self.scope.set(self.cfg.slab_name, slab)
 
         # LRU touch for hits (misses were appended above)
         for idv in uu.tolist():

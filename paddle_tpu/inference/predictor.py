@@ -433,6 +433,16 @@ class Predictor:
         self.run()
         return True
 
+    @staticmethod
+    def _cache_key(sig):
+        """Cheap bucket key: the resolved kernel mode
+        (paddle_tpu/kernels/) joins it (see core/executor.py) — a
+        PADDLE_TPU_KERNELS flip must reach the content-addressed tier,
+        never a stale per-object executable."""
+        from paddle_tpu.kernels import registry as _kernel_registry
+
+        return (sig, _kernel_registry.resolved_mode())
+
     def _compiled(self, sig):
         """AOT-compile the pruned program for one input-shape bucket,
         through the shared lowering (core/lowering.py): mandatory verifier
@@ -444,9 +454,10 @@ class Predictor:
         committed same-layout args, no per-call jit dispatch."""
         from paddle_tpu.observability import metrics as obs_metrics
 
+        cache_key = self._cache_key(sig)
         reg = obs_metrics.registry()
         with self._cache_lock:
-            hit = self._cache.get(sig)
+            hit = self._cache.get(cache_key)
             if hit is not None:
                 self._cache_stats["hits"] += 1
                 reg.counter("predictor_cache_hits_total",
@@ -487,8 +498,8 @@ class Predictor:
                 self._cache_stats["compile_s"] += dt
             elif source == "disk":
                 self._cache_stats["persistent_hits"] += 1
-            self._cache[sig] = (executable, entry.scope_names)
-        return self._cache[sig]
+            self._cache[cache_key] = (executable, entry.scope_names)
+        return self._cache[cache_key]
 
     def cache_stats(self):
         """Compile-cache counters, shared across clones: {hits, misses,
@@ -591,7 +602,7 @@ class Predictor:
         for b in spec["batch_sizes"]:
             for s in seqs:
                 sig = self._bucket_signature(b, s)
-                if sig in self._cache:
+                if self._cache_key(sig) in self._cache:
                     continue
                 t0 = _time.perf_counter()
                 with profiler.RecordEvent("predictor::warmup_bucket"):

@@ -1,0 +1,262 @@
+"""paddle_tpu.kernels — the Pallas kernel registry subsystem.
+
+Maps op/composite patterns to optional Pallas TPU kernels with the
+XLA-composite lowering as the mandatory fallback (see registry.py for
+the selection/mode/fingerprint contract). The built-in kernel set:
+
+==================== ============================== ========= =========
+kernel               serves                          parity    activation
+==================== ============================== ========= =========
+flash_attention      scaled_dot_product_attention    tolerance mode
+cached_attention     cached_attention (decode [S,1]) bit       mode
+paged_attention      paged_attention (block arena)   bit       mode
+embedding_admission  hot-slab miss admission         bit       mode
+dgc_topk             dgc gradient compaction         tolerance FLAGS_pallas_dgc_topk
+sparse_row_update    sgd_sparse row scatter          tolerance FLAGS_pallas_sparse_update
+remat_policy         recompute_segment[_grad]        bit       IR attr (policy kind)
+==================== ============================== ========= =========
+
+Every entry registers a ``parity_check`` — tests/test_kernels.py
+parametrizes over ``all_specs()`` and runs them all, so this table IS
+the CI gate (a kernel without a parity test cannot register).
+"""
+
+import numpy as np
+
+from paddle_tpu.kernels import registry as _r
+from paddle_tpu.kernels.registry import (  # noqa: F401
+    MODE_ENV, KernelSpec, all_specs, get, has, kernel_sig, mode, probe,
+    register, registry_fingerprint, resolved_mode, scoped_mode, selected,
+)
+
+__all__ = [
+    "MODE_ENV", "KernelSpec", "all_specs", "get", "has", "kernel_sig",
+    "mode", "probe", "register", "registry_fingerprint", "resolved_mode",
+    "scoped_mode", "selected", "fallback_internal_bytes",
+]
+
+
+def fallback_internal_bytes(op_type, attrs, shape_of, itemsize=4):
+    """HBM bytes the COMPOSITE fallback of a fused attention op
+    materializes that the kernel keeps in VMEM — what
+    ``analysis/memory.py`` adds back to the peak estimate when the
+    kernel is not selected. ``shape_of(slot)`` resolves an input slot's
+    static shape (None when unknown)."""
+    if op_type == "paged_attention":
+        q = shape_of("Q")
+        if q is None:
+            return 0
+        s, l = int(attrs["seqs"]), int(attrs["length"])
+        h = int(q[-1])
+        # two dense [S, L, H] gathered views + scores + att [S, 1, L]
+        return (2 * s * l * h + 2 * s * l) * itemsize
+    if op_type == "cached_attention":
+        # K/V are already inputs; only scores + att [S, 1, L] materialize
+        k = shape_of("KCache")
+        if k is None:
+            return 0
+        return 2 * int(k[0]) * int(k[1]) * itemsize
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# built-in kernel registrations (parity checks import lazily — they run
+# inside the test gate, not at import)
+# ---------------------------------------------------------------------------
+
+
+def _assert_bytes_equal(got, ref, what):
+    got, ref = np.asarray(got), np.asarray(ref)
+    assert got.dtype == ref.dtype and got.shape == ref.shape, \
+        f"{what}: {got.dtype}{got.shape} vs {ref.dtype}{ref.shape}"
+    assert got.tobytes() == ref.tobytes(), \
+        f"{what}: kernel output not BIT-identical to composite " \
+        f"(max abs diff {np.abs(got - ref).max()})"
+
+
+def _assert_close_both_ways(a, b, what, rtol, atol):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                               atol=atol, err_msg=f"{what} (a vs b)")
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=rtol,
+                               atol=atol, err_msg=f"{what} (b vs a)")
+
+
+def _parity_flash(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, H, S, D = 2, 2, 32, 8
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+               for _ in range(3))
+    bias = jnp.asarray(
+        np.where(rng.rand(B, S) > 0.25, 0, -1e9).astype("float32"))
+    got = flash_attention(q, k, v, bias=bias, causal=True, interpret=True,
+                          block_q=16, block_k=8)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = s + bias[:, None, None, :]
+    s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+    _assert_close_both_ways(got, ref, "flash_attention", 1e-5, 1e-5)
+
+
+def _parity_cached(rng):
+    """Kernel-interpret vs composite UNDER JIT on both sides: every real
+    execution path lowers through one jit (core/lowering.py), and the
+    bit contract holds for the lowered computation — eager dispatch
+    fuses differently and is not a path any program takes."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import attention as A
+
+    S, L, H = 4, 16, 8
+    q = jnp.asarray(rng.randn(S, H).astype("float32"))
+    k = jnp.asarray(rng.randn(S, L, H).astype("float32"))
+    v = jnp.asarray(rng.randn(S, L, H).astype("float32"))
+    cur = rng.randint(1, L, S)
+    bias = np.where(np.arange(L)[None, :] < cur[:, None], 0.0, -1e9)
+    bias = jnp.asarray(bias.astype("float32").reshape(S, 1, L))
+    sm = 1.0 / float(np.sqrt(H))
+    got = jax.jit(lambda *a: A.decode_attention(*a, sm, interpret=True))(
+        q, k, v, bias)
+    ref = jax.jit(lambda *a: A.cached_attention_composite(*a, sm))(
+        q, k, v, bias)
+    _assert_bytes_equal(got, ref, "cached_attention")
+
+
+def _parity_paged(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import attention as A
+
+    S, L, H, R = 3, 8, 8, 64
+    q = jnp.asarray(rng.randn(S, H).astype("float32"))
+    ka = jnp.asarray(rng.randn(R, H).astype("float32"))
+    va = jnp.asarray(rng.randn(R, H).astype("float32"))
+    rows = jnp.asarray(rng.randint(0, R, S * L).astype("int64"))
+    cur = rng.randint(1, L, S)
+    bias = np.where(np.arange(L)[None, :] < cur[:, None], 0.0, -1e9)
+    bias = jnp.asarray(bias.astype("float32").reshape(S, 1, L))
+    sm = 1.0 / float(np.sqrt(H))
+    got = jax.jit(lambda *a: A.paged_attention(
+        *a, S, L, sm, interpret=True))(q, ka, va, rows, bias)
+    ref = jax.jit(lambda *a: A.paged_attention_composite(
+        *a, S, L, sm))(q, ka, va, rows, bias)
+    _assert_bytes_equal(got, ref, "paged_attention")
+
+
+def _parity_admission(rng):
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import embedding as E
+
+    C, D, M = 32, 8, 5
+    slab = rng.randn(C, D).astype("float32")
+    slots = rng.choice(C, M, replace=False).astype("int32")
+    rows = rng.randn(M, D).astype("float32")
+    got = E.admit_rows(slab, slots, rows, interpret=True)
+    s, r = E.pad_slots(slots, rows, C, D, np.float32)
+    ref = jnp.asarray(slab).at[jnp.asarray(s)].set(jnp.asarray(r),
+                                                   mode="drop")
+    _assert_bytes_equal(got, ref, "embedding_admission")
+    untouched = np.setdiff1d(np.arange(C), slots)
+    _assert_bytes_equal(np.asarray(got)[untouched], slab[untouched],
+                        "embedding_admission untouched rows")
+
+
+def _parity_dgc_topk(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.topk import blocked_topk_abs
+
+    x = jnp.asarray(rng.randn(1000).astype("float32"))
+    vals, idx = blocked_topk_abs(x, 16, block=128, interpret=True)
+    ref_v, _ref_i = jax.lax.top_k(jnp.abs(x), 16)
+    _assert_close_both_ways(vals, ref_v, "dgc_topk values", 1e-6, 0)
+    np.testing.assert_allclose(
+        np.abs(np.asarray(x))[np.asarray(idx)], np.asarray(vals),
+        rtol=1e-6)
+
+
+def _parity_sparse_update(rng):
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.sparse_update import sparse_row_update
+
+    V, D, N = 50, 8, 6
+    p = jnp.asarray(rng.randn(V, D).astype("float32"))
+    ids = jnp.asarray(rng.choice(V, N, replace=False).astype("int32"))
+    rows = jnp.asarray(rng.randn(N, D).astype("float32"))
+    got = sparse_row_update(p, ids, rows, interpret=True)
+    ref = p.at[ids].add(rows)
+    _assert_close_both_ways(got, ref, "sparse_row_update", 1e-6, 1e-6)
+
+
+def _parity_remat(rng):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import remat
+
+    x = jnp.asarray(rng.randn(4, 8).astype("float32"))
+    w1 = jnp.asarray(rng.randn(8, 16).astype("float32"))
+    w2 = jnp.asarray(rng.randn(16, 8).astype("float32"))
+
+    def f(x, w1, w2):
+        return jnp.sum(jnp.tanh(x @ w1) @ w2)
+
+    # jit on both sides: remat is bit-exact for the LOWERED computation
+    # (the only path programs take); see _parity_cached
+    v_ref, g_ref = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
+        x, w1, w2)
+    for name in remat.POLICY_NAMES:
+        pol = remat.checkpoint_policy(name)
+        fc = (jax.checkpoint(f, policy=pol) if pol is not None
+              else jax.checkpoint(f))
+        v, g = jax.jit(jax.value_and_grad(fc, argnums=(0, 1, 2)))(
+            x, w1, w2)
+        _assert_bytes_equal(v, v_ref, f"remat[{name}] value")
+        for a, b in zip(g, g_ref):
+            _assert_bytes_equal(a, b, f"remat[{name}] grad")
+
+
+register(KernelSpec(
+    "flash_attention", ("scaled_dot_product_attention",), "tolerance",
+    _parity_flash,
+    doc="tiled online-softmax attention, training fwd+bwd "
+        "(ops/pallas/flash_attention.py)",
+))
+register(KernelSpec(
+    "cached_attention", ("cached_attention",), "bit", _parity_cached,
+    doc="fused [S,1] decode attention over a dense slotted cache "
+        "(kernels/attention.py)",
+))
+register(KernelSpec(
+    "paged_attention", ("paged_attention",), "bit", _parity_paged,
+    doc="fused paged attention over the flat [R,H] block arenas; the "
+        "[S,L,H] gather view never reaches HBM (kernels/attention.py)",
+))
+register(KernelSpec(
+    "embedding_admission", ("__host_admission__",), "bit",
+    _parity_admission,
+    doc="on-device hot-slab miss admission scatter (kernels/embedding.py)",
+))
+register(KernelSpec(
+    "dgc_topk", ("dgc_momentum",), "tolerance", _parity_dgc_topk,
+    gated_by="pallas_dgc_topk",
+    doc="blocked top-|x| for DGC compaction (ops/pallas/topk.py)",
+))
+register(KernelSpec(
+    "sparse_row_update", ("sgd_sparse",), "tolerance",
+    _parity_sparse_update, gated_by="pallas_sparse_update",
+    doc="row-scatter sparse SGD update (ops/pallas/sparse_update.py)",
+))
+register(KernelSpec(
+    "remat_policy", ("recompute_segment", "recompute_segment_grad"),
+    "bit", _parity_remat, kind="policy",
+    doc="IR-keyed jax.checkpoint policy table (kernels/remat.py)",
+))

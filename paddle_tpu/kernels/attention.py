@@ -1,0 +1,182 @@
+"""Fused decode attention: cached (slotted) and paged (block-arena) forms.
+
+Two Pallas TPU kernels serving the ``[S, 1]`` decode step (the hot path of
+``serving/decode/``), both written as ONE fused body so the per-layer
+attention never round-trips HBM between its stages:
+
+* ``decode_attention`` — single-position attention of ``q`` ``[S, H]``
+  over a dense slotted cache ``[S, L, H]`` under the additive ``-1e9``
+  bias (the ``cached_attention`` composite, fused).
+* ``paged_attention`` — the PR-13 block-arena form: the kernel takes the
+  flat ``[R, H]`` row arenas and the ``[S * L]`` block row-index feed
+  DIRECTLY and gathers inside the kernel, so the dense ``[S, L, H]``
+  gather view (the composite's HBM intermediate — the gap between the
+  12.8x arena win and the 6.9x peak-HBM win in DECODE_EVIDENCE_r13) only
+  ever exists in VMEM. This is vLLM's PagedAttention read pattern
+  (Kwon et al., 2023) on the Mosaic pipeline.
+
+Bit-exactness contract: each kernel body is the EXACT composite primitive
+sequence (``*_composite`` below — shared verbatim with the op registry's
+fallback lowering in ops/nn.py), so in interpret mode the Pallas call
+traces to the same jax primitives on the same shapes and the outputs are
+BIT-identical to the fallback — which is what keeps kernel-on decode
+byte-equal to kernel-off decode for every request in every mode
+(tests/test_kernels.py, tests/test_decode.py). Blocked/streamed variants
+(online softmax over KV blocks) would break that bit contract; they stay
+out until on-chip numbers arbitrate, the ops/pallas/ precedent.
+
+Eligibility: the fused body wants its whole workset resident in VMEM
+(~16 MB/core). ``fits_vmem`` gates the compiled-TPU path per static
+shape; an oversized geometry (e.g. 32k-context arenas) falls back to the
+composite — the mandatory-fallback rule doing its job, counted in
+``kernel_fallbacks_total``.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.common import vma_names
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = [
+    "cached_attention_composite", "paged_attention_composite",
+    "decode_attention", "paged_attention", "fits_vmem",
+]
+
+#: conservative per-kernel VMEM budget (bytes): ~16 MB/core minus
+#: double-buffering headroom
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def fits_vmem(*arrays):
+    total = 0
+    for a in arrays:
+        total += int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+    return total <= VMEM_BUDGET
+
+
+def _fallback_counter():
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    return obs_metrics.registry().counter(
+        "kernel_fallbacks_total",
+        "kernel-eligible ops that ran the composite fallback "
+        "(VMEM-oversized geometry or manual-mesh region)",
+    )
+
+
+# ---------------------------------------------------------------------------
+# the composite primitive sequences — THE definition of both ops' math.
+# ops/nn.py's fallback lowerings call these; the kernel bodies call these;
+# bit-identity between the two paths is by construction, not by test luck
+# (the tests then pin it).
+# ---------------------------------------------------------------------------
+
+
+def cached_attention_composite(q, k_cache, v_cache, bias, sm_scale):
+    """Exactly the op sequence ``layers.cached_attention`` used to emit:
+    unsqueeze -> matmul(transpose_y, alpha) -> elementwise_add -> softmax
+    -> matmul -> squeeze, with each step lowered the way ops/math.py and
+    ops/nn.py lower those ops."""
+    q3 = jnp.expand_dims(q, 1)                        # unsqueeze [S,1,H]
+    scores = jnp.matmul(q3, jnp.swapaxes(k_cache, -1, -2))
+    if sm_scale != 1.0:                               # matmul alpha
+        scores = scores * sm_scale
+    att = jax.nn.softmax(scores + bias, axis=-1)      # add bias, softmax
+    ctx = jnp.matmul(att, v_cache)                    # [S,1,H]
+    return jnp.squeeze(ctx, 1)                        # [S,H]
+
+
+def paged_attention_composite(q, k_arena, v_arena, rows, bias, seqs,
+                              length, sm_scale):
+    """``block_gather(k) ; block_gather(v) ; cached_attention`` as one
+    function: gather rows byte-for-byte out of the flat arenas, then the
+    cached-attention sequence over the gathered views."""
+    flat = rows.reshape(-1)
+    gk = jnp.take(k_arena, flat, axis=0).reshape(int(seqs), int(length), -1)
+    gv = jnp.take(v_arena, flat, axis=0).reshape(int(seqs), int(length), -1)
+    return cached_attention_composite(q, gk, gv, bias, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# fused kernels
+# ---------------------------------------------------------------------------
+
+
+def _pallas_full_block(body, out_shape, args, interpret):
+    """One-program pallas_call over full-array blocks: the whole workset
+    is VMEM-resident (the eligibility gate guarantees it fits), the body
+    is the fused composite. No grid: decode worksets are small; the win
+    is fusion (no HBM between stages), not tiling."""
+    kw = {} if (interpret or _VMEM is None) else {"memory_space": _VMEM}
+    return pl.pallas_call(
+        body,
+        in_specs=[pl.BlockSpec(**kw) for _ in args],
+        out_specs=pl.BlockSpec(**kw),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+
+
+def decode_attention(q, k_cache, v_cache, bias, sm_scale, interpret=False):
+    """Fused ``[S, 1]`` cached attention. Falls back to the composite
+    when the workset cannot be VMEM-resident on the compiled path or the
+    call sits inside a manual (shard_map) region."""
+    if vma_names(q) or (
+        not interpret and not fits_vmem(q, k_cache, v_cache, bias)
+    ):
+        _fallback_counter().inc()
+        return cached_attention_composite(q, k_cache, v_cache, bias,
+                                          sm_scale)
+
+    def body(q_ref, k_ref, v_ref, b_ref, o_ref):
+        o_ref[...] = cached_attention_composite(
+            q_ref[...], k_ref[...], v_ref[...], b_ref[...], sm_scale
+        ).astype(o_ref.dtype)
+
+    return _pallas_full_block(
+        body, jax.ShapeDtypeStruct(q.shape, q.dtype),
+        [q, k_cache, v_cache, bias], interpret,
+    )
+
+
+def paged_attention(q, k_arena, v_arena, rows, bias, seqs, length,
+                    sm_scale, interpret=False):
+    """Fused paged attention over the flat ``[R, H]`` block arenas. The
+    row-index feed enters the kernel; the ``[S, L, H]`` gathered views
+    exist only inside it (VMEM), never as an HBM intermediate."""
+    seqs, length = int(seqs), int(length)
+    H = q.shape[-1]
+    if vma_names(q):
+        _fallback_counter().inc()
+        return paged_attention_composite(q, k_arena, v_arena, rows, bias,
+                                         seqs, length, sm_scale)
+    if not interpret:
+        # compiled path: arenas + both gathered views + scores in VMEM
+        gathered = 2 * seqs * length * H * jnp.dtype(q.dtype).itemsize
+        if not fits_vmem(q, k_arena, v_arena, bias) or \
+                gathered > VMEM_BUDGET // 2:
+            _fallback_counter().inc()
+            return paged_attention_composite(
+                q, k_arena, v_arena, rows, bias, seqs, length, sm_scale)
+
+    def body(q_ref, k_ref, v_ref, rows_ref, b_ref, o_ref):
+        o_ref[...] = paged_attention_composite(
+            q_ref[...], k_ref[...], v_ref[...], rows_ref[...], b_ref[...],
+            seqs, length, sm_scale,
+        ).astype(o_ref.dtype)
+
+    return _pallas_full_block(
+        body, jax.ShapeDtypeStruct(q.shape, q.dtype),
+        [q, k_arena, v_arena, rows, bias], interpret,
+    )

@@ -1,0 +1,166 @@
+"""On-device embedding admission: the PR-8 leftover, closed.
+
+``embedding/store.py`` admits cache misses by mutating the device hot
+slab. The original path round-tripped the ENTIRE ``[capacity, dim]`` slab
+through host numpy per missing batch (``np.array(slab); slab[slots] =
+rows; scope.set(...)``) — a capacity-sized device->host->device copy to
+move a handful of rows. This module replaces it with device-side
+gather/scatter:
+
+* ``read_rows(slab, slots)``  — gather ONLY the eviction victims' rows
+  for write-back (a ``[n_evicted, dim]`` transfer, not capacity-sized);
+* ``admit_rows(slab, slots, rows)`` — scatter the pulled miss rows into
+  their slots, DONATED (the slab updates in place on device; the scope
+  keeps the result as a device array between steps).
+
+Admission counts are padded to power-of-2 buckets (the dedup-gather
+discipline, embedding/gather.py) with ``slot == capacity`` as the "write
+nowhere" encoding — the paged-arena drop convention — so the jitted
+update retraces O(log capacity) times, not per batch shape. Both jits go
+through the ``core/lowering.py`` ``jit_compile`` chokepoint (compile
+counts stay observable) and are cached here under a lockdep-named lock.
+
+Kernel selection follows the registry: the composite scatter is
+``slab.at[slots].set(rows, mode="drop")``; under Pallas modes the same
+write runs as a row-loop kernel aliasing the slab buffer
+(``input_output_aliases``), which is the true in-place dynamic scatter on
+TPU. Rows move byte-for-byte on every path — admission is bit-identical
+across modes, capacities and ep counts (tools/bench_embedding.py
+--smoke asserts it end to end).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.observability import lockdep
+
+__all__ = ["read_rows", "admit_rows", "admit_bucket", "pad_slots",
+           "admission_roundtrip_counter"]
+
+_jit_cache = {}   # (kind, capacity, dim, bucket, dtype, interpret) -> fn
+_jit_lock = lockdep.named_lock("kernels.cache")
+
+
+def admission_roundtrip_counter():
+    """Host capacity-slab round-trips (the legacy admission path). The
+    KERNEL_EVIDENCE gate asserts this stays ZERO under device
+    admission."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    return obs_metrics.registry().counter(
+        "embedding_host_slab_roundtrips_total",
+        "miss admissions that copied the full [capacity, dim] slab "
+        "through host numpy (legacy path; 0 under device admission)",
+    )
+
+
+def admit_bucket(n):
+    """Power-of-2 admission bucket (>= 1) bounding jit retraces."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_slots(slots, rows, capacity, dim, dtype):
+    """Pad (slots, rows) to the bucket size; padded entries write
+    NOWHERE (slot == capacity, dropped by every backend)."""
+    n = len(slots)
+    b = admit_bucket(max(n, 1))
+    s = np.full((b,), capacity, dtype=np.int32)
+    s[:n] = np.asarray(slots, dtype=np.int32)
+    r = np.zeros((b, dim), dtype=dtype)
+    if n:
+        r[:n] = np.asarray(rows, dtype=dtype)
+    return s, r
+
+
+def _scatter_composite(slab, slots, rows):
+    # mode="drop": the padded slot == capacity rows are skipped, the
+    # exact analog of ops/tensor.py scatter's paged-decode encoding
+    return slab.at[slots].set(rows, mode="drop")
+
+
+def _scatter_pallas(slab, slots, rows, interpret):
+    """Row-loop scatter aliasing the slab buffer: only the admitted rows
+    are written; everything else IS the input buffer (in-place on TPU)."""
+    cap = slab.shape[0]
+    m = slots.shape[0]
+
+    def body(slab_ref, slots_ref, rows_ref, out_ref):
+        def write(i, _):
+            s = slots_ref[i]
+
+            @pl.when(s < cap)
+            def _():
+                out_ref[pl.ds(s, 1), :] = rows_ref[pl.ds(i, 1), :]
+
+            return 0
+
+        jax.lax.fori_loop(0, m, write, 0)
+
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct(slab.shape, slab.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(slab, slots, rows)
+
+
+def _get_jit(kind, capacity, dim, bucket, dtype, interpret):
+    key = (kind, capacity, dim, bucket, str(dtype), interpret)
+    with _jit_lock:
+        fn = _jit_cache.get(key)
+        if fn is not None:
+            return fn
+    from paddle_tpu.core.lowering import jit_compile
+
+    if kind == "gather":
+        fn = jit_compile(lambda slab, slots: jnp.take(slab, slots, axis=0))
+    elif kind == "admit_composite":
+        fn = jit_compile(_scatter_composite, donate_argnums=(0,))
+    else:
+        fn = jit_compile(
+            lambda slab, slots, rows: _scatter_pallas(
+                slab, slots, rows, interpret),
+            donate_argnums=(0,),
+        )
+    with _jit_lock:
+        return _jit_cache.setdefault(key, fn)
+
+
+def read_rows(slab, slots):
+    """Gather ``slab[slots]`` on device; returns a host array (the
+    write-back payload). Only the victims' rows cross the wire."""
+    n = len(slots)
+    b = admit_bucket(max(n, 1))
+    # pad with slot 0 (sliced off below) so the gather shape is bucketed
+    s = np.zeros((b,), dtype=np.int32)
+    s[:n] = np.asarray(slots, dtype=np.int32)
+    fn = _get_jit("gather", slab.shape[0], slab.shape[1], b,
+                  slab.dtype, False)
+    return np.asarray(fn(jnp.asarray(slab), jnp.asarray(s)))[:n]
+
+
+def admit_rows(slab, slots, rows, *, interpret=None):
+    """Scatter the admitted rows into the slab ON DEVICE (donated).
+    ``interpret=None`` consults the kernel registry: composite scatter
+    unless the Pallas kernel is selected. Returns the updated device
+    slab."""
+    from paddle_tpu.kernels import registry
+
+    if interpret is None:
+        sel = registry.selected("embedding_admission")
+        kind = "admit_composite" if sel is None else "admit_pallas"
+        interp = bool(sel.interpret) if sel is not None else False
+    else:
+        kind = "admit_pallas"
+        interp = bool(interpret)
+    slab = jnp.asarray(slab)   # device-commit so donation is real
+    s, r = pad_slots(slots, rows, slab.shape[0], slab.shape[1], slab.dtype)
+    fn = _get_jit(kind, slab.shape[0], slab.shape[1], len(s), slab.dtype,
+                  interp)
+    return fn(slab, jnp.asarray(s), jnp.asarray(r))

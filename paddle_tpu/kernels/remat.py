@@ -1,0 +1,74 @@
+"""IR-keyed remat policies: per-segment ``jax.checkpoint`` policy choice.
+
+The recompute machinery (core/backward.py ``_collapse_segments`` +
+ops/recompute.py) replays each inter-checkpoint forward segment under
+``jax.vjp(jax.checkpoint(f))`` — PR-era behavior was always the default
+"save nothing" policy. Long-sequence training wants that knob: rematting
+EVERYTHING trades maximum HBM for maximum recompute, while
+``checkpoint_dots``-style policies keep the MXU outputs (the expensive
+part) and replay only the cheap elementwise tail.
+
+This module is the ONE policy table. The selection is keyed THROUGH THE
+IR: ``RecomputeOptimizer(opt, checkpoints=..., policy="dots")`` stamps
+``__remat_policy__`` on every collapsed segment op, and the
+``recompute_segment_grad`` lowering maps that attr here. Because the
+policy rides in op attrs, it participates in the program's serialized
+bytes — a policy flip retraces via the content-addressed compile cache
+with no extra fingerprint plumbing.
+
+Static story: ``core/backward.py`` also stamps
+``__segment_saved_names__`` (the per-policy NAME lists of what each
+policy would additionally pin across fwd->bwd; the forward ops stay in
+the program, so the names keep inferred shapes), and
+``analysis/memory.py`` resolves them through its feed-bound shape
+report and adds the bytes to every program point between the segment's
+end and its grad op — so ``estimate_peak_hbm`` predicts the peak-HBM
+delta of a policy change BEFORE any compile.
+
+Remat is bit-exact by construction (the replay reruns the same ops on
+the same values, rng folds included), so the registry entry's parity
+contract is "bit", asserted by its parity check and
+tests/test_recompute.py.
+"""
+
+__all__ = ["POLICY_NAMES", "checkpoint_policy", "validate_policy",
+           "DEFAULT_POLICY"]
+
+DEFAULT_POLICY = "full"
+
+#: policy name -> how to build the jax.checkpoint ``policy=`` argument.
+#: "full"      — save nothing inside the segment (jax default): minimum
+#:               HBM, maximum recompute.
+#: "dots"      — save matmul-family outputs (checkpoint_dots): the
+#:               backward replays only elementwise work.
+#: "dots_no_batch" — checkpoint_dots_with_no_batch_dims (the variant
+#:               GSPMD prefers under batch-sharded programs).
+#: "save_all"  — save everything (no recompute): the control policy that
+#:               must reproduce the no-remat memory profile.
+POLICY_NAMES = ("full", "dots", "dots_no_batch", "save_all")
+
+
+def checkpoint_policy(name):
+    """The ``jax.checkpoint(policy=...)`` value for a policy name (None
+    = the default save-nothing policy)."""
+    import jax
+
+    validate_policy(name)
+    cp = jax.checkpoint_policies
+    if name == "full":
+        return None
+    if name == "dots":
+        return cp.checkpoint_dots
+    if name == "dots_no_batch":
+        return cp.checkpoint_dots_with_no_batch_dims
+    return cp.everything_saveable
+
+
+def validate_policy(name):
+    if name not in POLICY_NAMES:
+        from paddle_tpu.utils.enforce import EnforceError
+
+        raise EnforceError(
+            f"unknown remat policy {name!r} (want one of {POLICY_NAMES})"
+        )
+    return name

@@ -28,6 +28,7 @@ __all__ = [
     "kv_cache_write",
     "masked_write",
     "cached_attention",
+    "paged_attention",
     "block_gather",
     "block_scatter_write",
     "moe_ffn",
@@ -650,18 +651,58 @@ def block_scatter_write(arena, rows, new_rows, name=None):
 
 
 def cached_attention(q, k_cache, v_cache, attn_bias, sm_scale=1.0,
-                     name=None):
+                     fused=False, name=None):
     """Single-position attention of ``q`` ``[S, H]`` over a slotted KV
     cache ``[S, L, H]`` — the decode-step half of cached (incremental)
     attention; `kv_cache_write` is the other half. ``attn_bias`` is an
     additive ``[S, 1, L]`` mask fed from the host scheduler: 0.0 at
     positions ``<= cursor``, -1e9 beyond (exp underflows to exactly 0.0,
     the repo-wide padding contract), so stale cache positions are
-    bit-invisible. Returns the ``[S, H]`` context vectors."""
+    bit-invisible. Returns the ``[S, H]`` context vectors.
+
+    ``fused=True`` emits ONE ``cached_attention`` op instead of the
+    matmul/softmax composite: the op's reference lowering is the exact
+    composite sequence (bit-identical), and the kernel registry
+    (paddle_tpu/kernels/) may serve it with a fused Pallas kernel under
+    ``PADDLE_TPU_KERNELS``."""
+    if fused:
+        helper = LayerHelper("cached_attention", name=name)
+        out = helper.create_variable_for_type_inference(q.dtype)
+        helper.append_op(
+            "cached_attention",
+            {"Q": [q.name], "KCache": [k_cache.name],
+             "VCache": [v_cache.name], "Bias": [attn_bias.name]},
+            {"Out": [out.name]},
+            {"sm_scale": float(sm_scale)},
+        )
+        return out
     q3 = unsqueeze(q, [1], name=name)                    # [S, 1, H]
     scores = matmul(q3, k_cache, transpose_y=True, alpha=float(sm_scale))
     att = softmax(elementwise_add(scores, attn_bias), axis=-1)
     return squeeze(matmul(att, v_cache), [1])            # [S, H]
+
+
+def paged_attention(q, k_arena, v_arena, rows, attn_bias, seqs, length,
+                    sm_scale=1.0, name=None):
+    """Fused paged attention: ``q`` ``[S, H]`` attends over rows of the
+    flat ``[R, H]`` block arenas addressed by the ``[S * L]`` row feed —
+    ``block_gather(k) ; block_gather(v) ; cached_attention`` as ONE op.
+    The reference lowering is that exact composite (bit-identical for
+    any block size); under ``PADDLE_TPU_KERNELS`` the registry serves it
+    with the fused Pallas kernel, where the dense ``[S, L, H]`` gather
+    views live only in VMEM instead of materializing in HBM (the
+    analysis/memory.py accounting difference KERNEL_EVIDENCE commits)."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "paged_attention",
+        {"Q": [q.name], "KArena": [k_arena.name], "VArena": [v_arena.name],
+         "Rows": [rows.name], "Bias": [attn_bias.name]},
+        {"Out": [out.name]},
+        {"sm_scale": float(sm_scale), "seqs": int(seqs),
+         "length": int(length)},
+    )
+    return out
 
 
 def moe_ffn(input, num_experts, d_ff=None, expert_axis="expert",

@@ -468,11 +468,17 @@ def _sdpa_reference(ins, attrs):
 
 
 def _sdpa_pallas(ins, attrs):
+    from paddle_tpu.kernels import registry as kernel_registry
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
     sp = _sdpa_seq_parallel(ins, attrs)
     if sp is not None:
         return sp
+    sel = kernel_registry.selected("flash_attention")
+    if sel is None:
+        # composite fallback is mandatory: PADDLE_TPU_KERNELS=off, or
+        # auto off-TPU (interpret mode is a parity tool, not a fast path)
+        return _sdpa_reference(ins, attrs)
     q, k, v = first(ins, "Q"), first(ins, "K"), first(ins, "V")
     bias = first(ins, "Bias") if ins.get("Bias") else None
     return {
@@ -481,6 +487,7 @@ def _sdpa_pallas(ins, attrs):
                 q, k, v, bias=bias,
                 causal=attrs.get("causal", False),
                 sm_scale=attrs.get("sm_scale"),
+                interpret=sel.interpret,
             )
         ]
     }
@@ -492,6 +499,84 @@ OpRegistry.register(
         _sdpa_reference,
         pallas=_sdpa_pallas,
         nondiff_inputs=(),
+    )
+)
+
+
+# ---------------------------------------------------------------------------
+# fused decode attention (paddle_tpu/kernels/): cached (dense slotted) and
+# paged (block-arena row feeds). The reference lowerings ARE the composite
+# primitive sequences the old layer composites emitted — bit-identity
+# between kernel-on and kernel-off paths is by shared definition
+# (kernels/attention.py), not by test luck.
+# ---------------------------------------------------------------------------
+
+
+def _cached_attention_reference(ins, attrs):
+    from paddle_tpu.kernels import attention as fused
+
+    q, k, v = first(ins, "Q"), first(ins, "KCache"), first(ins, "VCache")
+    bias = first(ins, "Bias")
+    return {"Out": [fused.cached_attention_composite(
+        q, k, v, bias, attrs.get("sm_scale", 1.0))]}
+
+
+def _cached_attention_pallas(ins, attrs):
+    from paddle_tpu.kernels import attention as fused
+    from paddle_tpu.kernels import registry as kernel_registry
+
+    sel = kernel_registry.selected("cached_attention")
+    if sel is None:
+        return _cached_attention_reference(ins, attrs)
+    q, k, v = first(ins, "Q"), first(ins, "KCache"), first(ins, "VCache")
+    bias = first(ins, "Bias")
+    return {"Out": [fused.decode_attention(
+        q, k, v, bias, attrs.get("sm_scale", 1.0),
+        interpret=sel.interpret)]}
+
+
+OpRegistry.register(
+    OpDef(
+        "cached_attention",
+        _cached_attention_reference,
+        pallas=_cached_attention_pallas,
+        nondiff_inputs=("Bias",),
+    )
+)
+
+
+def _paged_attention_reference(ins, attrs):
+    from paddle_tpu.kernels import attention as fused
+
+    q = first(ins, "Q")
+    ka, va = first(ins, "KArena"), first(ins, "VArena")
+    rows, bias = first(ins, "Rows"), first(ins, "Bias")
+    return {"Out": [fused.paged_attention_composite(
+        q, ka, va, rows, bias, attrs["seqs"], attrs["length"],
+        attrs.get("sm_scale", 1.0))]}
+
+
+def _paged_attention_pallas(ins, attrs):
+    from paddle_tpu.kernels import attention as fused
+    from paddle_tpu.kernels import registry as kernel_registry
+
+    sel = kernel_registry.selected("paged_attention")
+    if sel is None:
+        return _paged_attention_reference(ins, attrs)
+    q = first(ins, "Q")
+    ka, va = first(ins, "KArena"), first(ins, "VArena")
+    rows, bias = first(ins, "Rows"), first(ins, "Bias")
+    return {"Out": [fused.paged_attention(
+        q, ka, va, rows, bias, attrs["seqs"], attrs["length"],
+        attrs.get("sm_scale", 1.0), interpret=sel.interpret)]}
+
+
+OpRegistry.register(
+    OpDef(
+        "paged_attention",
+        _paged_attention_reference,
+        pallas=_paged_attention_pallas,
+        nondiff_inputs=("Rows", "Bias"),
     )
 )
 

@@ -85,8 +85,21 @@ def _recompute_segment_grad(ins, attrs):
 
     # prevent_cse: without it XLA CSEs the replay against the live forward
     # pass, keeping every intermediate activation alive to the backward —
-    # exactly the memory profile recompute exists to avoid
-    f_ck = jax.checkpoint(f, prevent_cse=True)
+    # exactly the memory profile recompute exists to avoid.
+    # The IR-keyed policy (kernels/remat.py) selects WHAT the replay may
+    # keep: "full" saves nothing (the default), "dots" keeps MXU outputs
+    # and replays only elementwise work, "save_all" is the no-remat
+    # control. Replay is bit-exact under every policy (same ops, same rng
+    # folds), so policy choice is a memory/compute trade, never a
+    # numerics change.
+    from paddle_tpu.kernels import remat as _remat
+
+    policy = _remat.checkpoint_policy(
+        attrs.get("__remat_policy__", _remat.DEFAULT_POLICY))
+    if policy is None:
+        f_ck = jax.checkpoint(f, prevent_cse=True)
+    else:
+        f_ck = jax.checkpoint(f, prevent_cse=True, policy=policy)
     primal_in = [xs[i] for i in diff_idx]
     primal_out, vjp = jax.vjp(f_ck, primal_in)
     gouts = ins.get("Out@GRAD", [])

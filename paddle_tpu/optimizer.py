@@ -771,24 +771,45 @@ class RecomputeOptimizer(Optimizer):
     (core/backward.py _collapse_segments, ops/recompute.py) — only segment
     boundaries stay live across fwd->bwd. Gradients are mathematically
     identical with or without recompute.
+
+    ``policy`` keys the jax.checkpoint remat policy THROUGH THE IR
+    (paddle_tpu/kernels/remat.py): "full" (default, save nothing),
+    "dots" / "dots_no_batch" (keep matmul outputs, replay only
+    elementwise work), "save_all" (no-remat control). The choice is
+    stamped as ``__remat_policy__`` on every collapsed segment op —
+    ``analysis/memory.py`` predicts the peak-HBM delta of a policy
+    change before any compile, and a flip retraces via the
+    content-addressed cache because the attr is program content.
     """
 
-    def __init__(self, optimizer):
+    def __init__(self, optimizer, policy=None):
+        from paddle_tpu.kernels import remat as _remat
+
         self._inner = optimizer
         self._checkpoints = None
+        self._policy = _remat.validate_policy(
+            policy or _remat.DEFAULT_POLICY)
 
-    def _set_checkpoints(self, checkpoints):
+    def _set_checkpoints(self, checkpoints, policy=None):
+        from paddle_tpu.kernels import remat as _remat
+
         self._checkpoints = [
             c if isinstance(c, str) else c.name for c in checkpoints
         ]
+        if policy is not None:
+            self._policy = _remat.validate_policy(policy)
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
+    def _arm(self, program):
+        program._recompute_checkpoints = list(self._checkpoints)
+        program._recompute_policy = self._policy
+
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
         if self._checkpoints:
-            loss.block.program._recompute_checkpoints = list(self._checkpoints)
+            self._arm(loss.block.program)
         return self._inner.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
@@ -799,7 +820,7 @@ class RecomputeOptimizer(Optimizer):
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         if self._checkpoints:
-            loss.block.program._recompute_checkpoints = list(self._checkpoints)
+            self._arm(loss.block.program)
         return self._inner.minimize(
             loss, startup_program, parameter_list, no_grad_set
         )

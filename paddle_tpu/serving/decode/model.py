@@ -202,7 +202,7 @@ def _state_var(main_program, startup_program, name, shape):
 def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
                         slots=4, max_len=32, eos_id=None, name="decoder",
                         version="1", block_size=None, num_blocks=None,
-                        chunk_tokens=None):
+                        chunk_tokens=None, fused_attention=True):
     """Build the canonical cached-attention decoder as a paged
     DecodeModel.
 
@@ -219,6 +219,16 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
     so by default nothing can run out of blocks — size it DOWN (with
     the analysis/memory.py gate) to get the paged memory win.
     ``chunk_tokens`` >= 2 additionally builds the chunk-prefill program.
+
+    ``fused_attention`` (default True) routes the decode step's
+    attention through ONE ``paged_attention`` op — the row-index feeds
+    enter the op directly, so the kernel registry
+    (paddle_tpu/kernels/) can serve it with a fused Pallas kernel that
+    never materializes the dense ``[S, L, H]`` gather view in HBM. The
+    op's reference lowering is the exact gather+attention composite, so
+    tokens are BIT-identical to ``fused_attention=False`` (the pre-r15
+    op sequence, kept for the DECODE_EVIDENCE_r13 static recompute)
+    with kernels on or off.
     """
     import paddle_tpu as fluid
     from paddle_tpu.core.ir import Program, program_guard
@@ -315,11 +325,16 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
             # in-place device update, not a copy
             fluid.layers.assign(nk, output=kc)
             fluid.layers.assign(nv, output=vc)
-            gk = fluid.layers.block_gather(nk, rows, S, L)
-            gv = fluid.layers.block_gather(nv, rows, S, L)
-            ctx = fluid.layers.cached_attention(
-                fluid.layers.squeeze(q, [1]), gk, gv, bias,
-                sm_scale=sm_scale)
+            if fused_attention:
+                ctx = fluid.layers.paged_attention(
+                    fluid.layers.squeeze(q, [1]), nk, nv, rows, bias,
+                    S, L, sm_scale=sm_scale)
+            else:
+                gk = fluid.layers.block_gather(nk, rows, S, L)
+                gv = fluid.layers.block_gather(nv, rows, S, L)
+                ctx = fluid.layers.cached_attention(
+                    fluid.layers.squeeze(q, [1]), gk, gv, bias,
+                    sm_scale=sm_scale)
             ctx = fluid.layers.unsqueeze(ctx, [1])
             h = fluid.layers.elementwise_add(h, proj(ctx, H, f"l{i}.out"))
             h = ffn_block(h, i)
@@ -390,7 +405,7 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
     kwargs = dict(vocab_size=V, hidden=H, num_layers=NL, ffn_dim=FFN,
                   slots=S, max_len=L, eos_id=eos_id, name=name,
                   version=version, block_size=BS, num_blocks=NB,
-                  chunk_tokens=C)
+                  chunk_tokens=C, fused_attention=fused_attention)
     return DecodeModel(
         decode_program=decode, prefill_program=prefill,
         inject_program=inject, chunk_program=chunk,

@@ -67,7 +67,7 @@ def test_linear_layer_trains_with_adam():
             loss.backward()
             opt.minimize(loss, parameter_list=model.parameters())
             model.clear_gradients()
-            losses.append(float(loss.numpy()))
+            losses.append(float(loss.numpy().reshape(-1)[0]))
         assert losses[-1] < losses[0] * 0.05, losses[::10]
 
 
@@ -115,7 +115,8 @@ def test_mlp_static_dygraph_parity():
         exe.run(startup)
         for _ in range(5):
             static_losses.append(
-                float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+                float(np.asarray(exe.run(main, feed={"x": xs, "y": ys},
+                                          fetch_list=[loss])[0]).reshape(-1)[0])
             )
 
     # -- dygraph
@@ -151,7 +152,7 @@ def test_mlp_static_dygraph_parity():
             opt.minimize(l, parameter_list=params)
             for p_ in params:
                 p_.clear_gradient()
-            dy_losses.append(float(l.numpy()))
+            dy_losses.append(float(l.numpy().reshape(-1)[0]))
 
     np.testing.assert_allclose(static_losses, dy_losses, rtol=2e-4, atol=1e-6)
 

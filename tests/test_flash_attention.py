@@ -63,7 +63,11 @@ def test_flash_gradients_match(rng):
 
 def test_bert_flash_matches_unfused(rng):
     """BERT with flash attention must match the unfused path when attention
-    dropout is off (the only semantic difference of the fused kernel)."""
+    dropout is off (the only semantic difference of the fused kernel).
+    The flash leg runs under the kernel registry's interpret mode — on
+    CPU the default ``auto`` resolves to the composite fallback, which
+    would compare the unfused path against itself and prove nothing."""
+    from paddle_tpu import kernels
 
     def build(flash):
         from paddle_tpu.models import bert
@@ -86,7 +90,8 @@ def test_bert_flash_matches_unfused(rng):
     for flash in (False, True):
         cfg, main, startup, fetches = build(flash)
         exe = fluid.Executor(fluid.CPUPlace())
-        with fluid.scope_guard(fluid.Scope()):
+        mode = kernels.scoped_mode("interpret" if flash else "off")
+        with fluid.scope_guard(fluid.Scope()), mode:
             exe.run(startup)
             out = [
                 float(

@@ -1,0 +1,303 @@
+"""Pallas kernel registry (paddle_tpu/kernels/): the registry-enumerated
+parity gate, mode/fingerprint wiring, fused-op memory accounting, and the
+KERNEL_EVIDENCE_r15 drift gate.
+
+The parity gate is the CI contract of the subsystem: it parametrizes
+over ``kernels.all_specs()``, so a kernel registered without a parity
+check cannot even register, and one whose interpret-mode output drifts
+from its composite fallback fails here by name.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import kernels
+from paddle_tpu.kernels import registry as kreg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the registry-enumerated parity gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name", [s.name for s in kernels.all_specs()])
+def test_kernel_parity(name, rng):
+    """EVERY registered kernel/policy runs its interpret-mode parity
+    assertion. Enumerated from the registry — a new kernel lands in this
+    gate automatically; registration itself refuses a spec without a
+    parity check (see test below)."""
+    kernels.get(name).parity_check(rng)
+
+
+def test_registration_requires_parity_check():
+    with pytest.raises(ValueError, match="parity_check"):
+        kernels.KernelSpec("bogus", ("x",), "bit", None)
+    with pytest.raises(ValueError, match="parity"):
+        kernels.KernelSpec("bogus", ("x",), "sorta", lambda rng: None)
+
+
+def test_every_kernel_spec_is_complete():
+    specs = kernels.all_specs()
+    assert {s.name for s in specs} >= {
+        "flash_attention", "cached_attention", "paged_attention",
+        "embedding_admission", "remat_policy", "dgc_topk",
+        "sparse_row_update",
+    }
+    for s in specs:
+        assert s.op_types, s.name
+        assert callable(s.parity_check), s.name
+        assert s.parity in ("bit", "tolerance"), s.name
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + scoped override
+# ---------------------------------------------------------------------------
+
+
+def test_mode_env_and_scoped(monkeypatch):
+    monkeypatch.delenv(kernels.MODE_ENV, raising=False)
+    assert kernels.mode() == "auto"
+    # on this CPU rig auto resolves to composites everywhere
+    assert kernels.resolved_mode() == "off"
+    assert kernels.selected("paged_attention") is None
+    with kernels.scoped_mode("interpret"):
+        assert kernels.resolved_mode() == "interpret"
+        sel = kernels.selected("paged_attention")
+        assert sel is not None and sel.interpret
+        with kernels.scoped_mode("off"):          # nesting: innermost wins
+            assert kernels.selected("paged_attention") is None
+        assert kernels.selected("paged_attention") is not None
+    monkeypatch.setenv(kernels.MODE_ENV, "off")
+    assert kernels.mode() == "off"
+    monkeypatch.setenv(kernels.MODE_ENV, "bogus")
+    from paddle_tpu.utils.enforce import EnforceError
+
+    with pytest.raises(EnforceError, match="bogus"):
+        kernels.mode()
+
+
+def test_flag_gated_kernels_not_mode_selected():
+    """Legacy FLAGS-gated kernels enumerate in the parity gate but are
+    never selected by the mode (their own flags drive them, and the
+    compile-cache fingerprint already covers those flags)."""
+    with kernels.scoped_mode("interpret"):
+        assert kernels.selected("dgc_topk") is None
+        assert kernels.selected("sparse_row_update") is None
+        assert kernels.selected("remat_policy") is None  # policy kind
+
+
+def test_probe():
+    with kernels.scoped_mode("interpret"):
+        assert kernels.probe("flash_attention")
+    with kernels.scoped_mode("off"):
+        assert not kernels.probe("flash_attention")
+
+
+# ---------------------------------------------------------------------------
+# compile-cache fingerprint join (the core/lowering.py chokepoint)
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_sig_modes():
+    with kernels.scoped_mode("off"):
+        assert kernels.kernel_sig() is None
+    with kernels.scoped_mode("auto"):
+        # auto on a CPU backend = composites = pre-registry fingerprints
+        assert (kernels.kernel_sig() is None) == (
+            jax.default_backend() != "tpu")
+    with kernels.scoped_mode("interpret"):
+        sig = kernels.kernel_sig()
+        assert sig is not None and sig[0] == "interpret"
+        assert ("paged_attention", 1) in sig[1]
+
+
+def _tiny_cached_attention_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.data("q", shape=[4, 8], dtype="float32")
+        k = fluid.data("k", shape=[4, 16, 8], dtype="float32")
+        v = fluid.data("v", shape=[4, 16, 8], dtype="float32")
+        b = fluid.data("b", shape=[4, 1, 16], dtype="float32")
+        out = fluid.layers.cached_attention(q, k, v, b, sm_scale=0.3,
+                                            fused=True)
+    return main, startup, out
+
+
+def test_mode_flip_retraces_and_stays_bit_identical(rng):
+    """The end-to-end chokepoint property: flipping PADDLE_TPU_KERNELS
+    must MISS the content-addressed cache (kernel_sig joins the
+    fingerprint — a stale composite executable must never serve the
+    kernel mode) while the outputs stay BIT-identical."""
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    main, startup, out = _tiny_cached_attention_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "q": rng.randn(4, 8).astype("float32"),
+        "k": rng.randn(4, 16, 8).astype("float32"),
+        "v": rng.randn(4, 16, 8).astype("float32"),
+        "b": np.where(rng.rand(4, 1, 16) > 0.3, 0, -1e9).astype("float32"),
+    }
+    jits = obs_metrics.registry().counter("lowering_jit_total", "")
+    outs, trace_counts = {}, {}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for mode in ("off", "interpret", "off"):
+            j0 = jits.value
+            with kernels.scoped_mode(mode):
+                got = np.asarray(
+                    exe.run(main, feed=feed, fetch_list=[out])[0])
+            traced = jits.value - j0
+            outs.setdefault(mode, []).append(got)
+            trace_counts.setdefault(mode, []).append(traced)
+    # first "off" and "interpret" each traced; second "off" hit the
+    # memory tier (same fingerprint as the first)
+    assert trace_counts["off"][0] > 0
+    assert trace_counts["interpret"][0] > 0, (
+        "interpret mode served the composite executable — kernel_sig "
+        "did not join the fingerprint")
+    assert trace_counts["off"][1] == 0
+    a, b_, c = outs["off"][0], outs["interpret"][0], outs["off"][1]
+    assert a.tobytes() == b_.tobytes() == c.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# fused-op static memory accounting
+# ---------------------------------------------------------------------------
+
+
+def test_paged_memory_accounting_orders():
+    """kernel-path < composite-path < slotted-dense, and the
+    composite-vs-kernel gap is (at least ~) the dense gather views."""
+    from paddle_tpu.analysis.memory import estimate_peak_hbm
+    from paddle_tpu.serving.decode import build_decoder_model
+
+    geom = dict(vocab_size=64, hidden=16, num_layers=2, slots=4,
+                max_len=256)
+    m = build_decoder_model(name="acct", version="1", block_size=16,
+                            num_blocks=24, **geom)
+    fs = {n: s for n, s, _d in m.decode_feed_sig()}
+    comp = estimate_peak_hbm(m.decode_program, feed_shapes=fs,
+                             fetch_names=[m.logits_fetch],
+                             kernel_path=False)
+    kern = estimate_peak_hbm(m.decode_program, feed_shapes=fs,
+                             fetch_names=[m.logits_fetch],
+                             kernel_path=True)
+    assert kern.peak_total_bytes < comp.peak_total_bytes
+    gather = 2 * geom["slots"] * geom["max_len"] * geom["hidden"] * 4
+    assert comp.peak_total_bytes - kern.peak_total_bytes >= 0.5 * gather
+    # default (None) consults the live registry: off-mode == composite
+    with kernels.scoped_mode("off"):
+        live = estimate_peak_hbm(m.decode_program, feed_shapes=fs,
+                                 fetch_names=[m.logits_fetch])
+    assert live.peak_total_bytes == comp.peak_total_bytes
+    with kernels.scoped_mode("interpret"):
+        live_k = estimate_peak_hbm(m.decode_program, feed_shapes=fs,
+                                   fetch_names=[m.logits_fetch])
+    assert live_k.peak_total_bytes == kern.peak_total_bytes
+
+
+def test_fused_program_tokens_match_composite_program(rng):
+    """fused_attention=True (one paged_attention op) vs False (the r13
+    gather+attention op sequence): same weights by deterministic init,
+    BIT-identical decode."""
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    geom = dict(vocab_size=32, hidden=8, num_layers=2, slots=4, max_len=24)
+
+    def drive(fused, tag):
+        engine = GenerationEngine(queue_depth=8, breaker_threshold=0)
+        entry = engine.register_model(lambda: build_decoder_model(
+            block_size=4, name=f"fusedcmp_{tag}", version="1",
+            fused_attention=fused, **geom))
+        prompts = [[3, 1, 4, 1, 5], [3, 1, 4], [9, 2]]
+        resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+        entry._admit_free_slots()
+        for _ in range(60):
+            if all(r.done() for r in resps):
+                break
+            entry._step()
+        outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+                for r in resps]
+        engine.shutdown()
+        return outs
+
+    assert drive(True, "on") == drive(False, "off")
+
+
+# ---------------------------------------------------------------------------
+# on-device embedding admission
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_device_admission_bit_identical_and_no_roundtrips():
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.embedding.store import EmbeddingEngine
+    from paddle_tpu.embedding.table import TableConfig
+    from paddle_tpu.kernels.embedding import admission_roundtrip_counter
+
+    def drive(mode):
+        with kernels.scoped_mode(mode):
+            sc = Scope()
+            eng = EmbeddingEngine(scope=sc)
+            rt = eng.register(TableConfig(name="kadm", dim=4, capacity=24,
+                                          ep=2, seed=7))
+            r = np.random.RandomState(0)
+            for _ in range(6):
+                ids = r.randint(0, 64, 10).astype(np.int64)
+                rt.lookup(ids, dedup=True, train=True)
+                slab = np.asarray(sc.find_var(rt.cfg.slab_name))
+                sc.set(rt.cfg.slab_name, slab + 0.001)
+            rt.flush()
+            blocks = rt.store.snapshot_blocks()
+            eng.close()
+            return [(i.tobytes(), v.tobytes()) for i, v in blocks]
+
+    c = admission_roundtrip_counter()
+    c0 = c.value
+    legacy = drive("off")
+    c1 = c.value
+    assert c1 - c0 > 0, "legacy path stopped counting round-trips"
+    device = drive("auto")
+    assert c.value == c1, "device admission round-tripped the slab"
+    pallas = drive("interpret")
+    assert c.value == c1
+    assert legacy == device == pallas
+
+
+# ---------------------------------------------------------------------------
+# KERNEL_EVIDENCE_r15 drift gate (live recompute, r08/r09/r13 style)
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_evidence_r15_committed():
+    """The committed KERNEL_EVIDENCE_r15.json must be exactly what
+    tools/kernel_report.py derives TODAY — evidence that drifts from the
+    code is worse than no evidence."""
+    sys_path_hack = os.path.join(REPO, "tools")
+    import sys
+
+    if sys_path_hack not in sys.path:
+        sys.path.insert(0, sys_path_hack)
+    import kernel_report
+
+    with open(os.path.join(REPO, "KERNEL_EVIDENCE_r15.json")) as f:
+        committed = json.load(f)
+    live = kernel_report.build_evidence()
+    kernel_report.check(live)
+    kernel_report.check(committed)
+    assert json.dumps(live, sort_keys=True) == \
+        json.dumps(committed, sort_keys=True), (
+            "KERNEL_EVIDENCE_r15.json drifted from the live recompute — "
+            "regenerate with `python tools/kernel_report.py --out "
+            "KERNEL_EVIDENCE_r15.json`")

@@ -33,7 +33,8 @@ def _mlp(depth=3, with_dropout=False):
     return loss, checkpoints
 
 
-def _train(recompute, steps, x, y, with_dropout=False, seed=7):
+def _train(recompute, steps, x, y, with_dropout=False, seed=7,
+           policy=None):
     main, startup = Program(), Program()
     main.random_seed = seed
     startup.random_seed = seed
@@ -41,14 +42,16 @@ def _train(recompute, steps, x, y, with_dropout=False, seed=7):
         loss, ckpts = _mlp(with_dropout=with_dropout)
         opt = fluid.optimizer.SGD(learning_rate=0.1)
         if recompute:
-            opt = fluid.optimizer.RecomputeOptimizer(opt)
+            opt = fluid.optimizer.RecomputeOptimizer(opt, policy=policy)
             opt._set_checkpoints(ckpts)
         opt.minimize(loss)
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
         return [
-            float(exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])[0][0])
+            float(np.asarray(
+                exe.run(main, feed={"x": x, "y": y},
+                        fetch_list=[loss])[0]).reshape(-1)[0])
             for _ in range(steps)
         ]
 
@@ -84,3 +87,96 @@ def test_segment_grad_ops_emitted(rng):
     assert "recompute_segment_grad" in types
     # per-op grads for segmented region must be gone
     assert "fc_grad" not in [t for t in types]
+
+
+# ---------------------------------------------------------------------------
+# IR-keyed remat policies (paddle_tpu/kernels/remat.py)
+# ---------------------------------------------------------------------------
+
+
+def test_remat_policies_bit_identical(rng):
+    """Every policy is a memory/compute trade, never a numerics change:
+    per-step losses are BIT-identical across plain / full / dots /
+    save_all (float-hex compare, not allclose)."""
+    x = rng.rand(16, 8).astype("float32")
+    y = x.sum(axis=1, keepdims=True).astype("float32")
+    runs = {"plain": _train(False, 4, x, y)}
+    for policy in ("full", "dots", "dots_no_batch", "save_all"):
+        runs[policy] = _train(True, 4, x, y, policy=policy)
+    hexes = {k: [v.hex() for v in vals] for k, vals in runs.items()}
+    assert all(h == hexes["plain"] for h in hexes.values()), hexes
+
+
+def test_remat_policy_rides_the_ir(rng):
+    """The policy is stamped on every collapsed segment op (so it is
+    program CONTENT: a flip retraces via the content-addressed cache),
+    alongside the per-policy saved-name lists the static memory
+    estimator prices."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        loss, ckpts = _mlp()
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), policy="dots")
+        opt._set_checkpoints(ckpts)
+        opt.minimize(loss)
+    gops = [op for op in main.global_block().ops
+            if op.type == "recompute_segment_grad"]
+    assert gops
+    for op in gops:
+        assert op.attrs["__remat_policy__"] == "dots"
+        saved = op.attrs["__segment_saved_names__"]
+        assert saved["full"] == []
+        assert set(saved["dots"]) <= set(saved["save_all"])
+
+
+def test_remat_policy_static_peak_ordering(rng):
+    """analysis/memory.py prices the policy pre-compile: full < dots <=
+    save_all <= plain on an activation-dominated stack, and
+    remat_hbm_delta reports a positive saving for the full policy."""
+    from paddle_tpu.analysis.memory import estimate_peak_hbm, remat_hbm_delta
+
+    def build(policy=None, ckpt=True):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.data("x", shape=[-1, 128])
+            y = fluid.data("y", shape=[-1, 1])
+            h = x
+            cps = []
+            for i in range(6):
+                h = fluid.layers.fc(h, size=128, act="relu")
+                if i % 2 == 1:
+                    cps.append(h)
+            pred = fluid.layers.fc(h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
+            if ckpt:
+                opt = fluid.optimizer.RecomputeOptimizer(opt,
+                                                         policy=policy)
+                opt._set_checkpoints(cps[:-1])
+            opt.minimize(loss)
+        return main
+
+    fs = {"x": (512, 128), "y": (512, 1)}
+    peaks = {
+        tag: estimate_peak_hbm(build(pol, ck),
+                               feed_shapes=fs).peak_intermediate_bytes
+        for tag, pol, ck in (("plain", None, False), ("full", "full", True),
+                             ("dots", "dots", True),
+                             ("save_all", "save_all", True))
+    }
+    assert peaks["full"] < peaks["dots"] <= peaks["save_all"] \
+        <= peaks["plain"], peaks
+    delta = remat_hbm_delta(build(None, False), build("full", True),
+                            feed_shapes=fs)
+    assert delta["saved_bytes"] > 0 and delta["policies"] == ["full"]
+
+
+def test_remat_unknown_policy_raises():
+    import pytest
+
+    from paddle_tpu.utils.enforce import EnforceError
+
+    with pytest.raises(EnforceError, match="remat policy"):
+        fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1), policy="sometimes")

@@ -181,6 +181,10 @@ def main():
         c = max(int(working_set * ratio), 2 * max_batch_unique)
         return ((c + ep - 1) // ep) * ep
 
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels.embedding import admission_roundtrip_counter
+
+    rt0 = admission_roundtrip_counter().value
     configs, outputs, valuemaps = [], [], []
     for ratio in ratios:
         rep, outs, values = run_config(
@@ -189,6 +193,23 @@ def main():
         configs.append(rep)
         outputs.append(outs)
         valuemaps.append(values)
+    device_admission_roundtrips = admission_roundtrip_counter().value - rt0
+    # legacy-path control: the smallest config re-run with
+    # PADDLE_TPU_KERNELS=off must produce BIT-identical training through
+    # the host capacity-slab round-trip (and the round-trip counter must
+    # fire — the zero above proves something)
+    with kernels.scoped_mode("off"):
+        _rep_leg, outs_legacy, values_legacy = run_config(
+            batches, cap_for(ratios[0]), ep, dim, dedup=True,
+            fetch_emb=True)
+    legacy_roundtrips = (admission_roundtrip_counter().value - rt0
+                         - device_admission_roundtrips)
+    legacy_bit_identical = all(
+        np.array_equal(a, b) for a, b in zip(outputs[0], outs_legacy)
+    ) and set(values_legacy) == set(valuemaps[0]) and all(
+        np.array_equal(valuemaps[0][i], values_legacy[i])
+        for i in values_legacy
+    )
     # dedup-off control at the largest cache
     rep_off, outs_off, values_off = run_config(
         batches, cap_for(ratios[-1]), ep, dim, dedup=False, fetch_emb=True)
@@ -239,11 +260,25 @@ def main():
             "bit_identical_across_configs": bool(bit_identical),
             "dedup_off_max_abs_diff": dedup_off_max_diff,
             "hit_rate": smallest["hit_rate"],
+            "device_admission_roundtrips": int(device_admission_roundtrips),
+            "legacy_admission_roundtrips": int(legacy_roundtrips),
+            "legacy_path_bit_identical": bool(legacy_bit_identical),
         },
     }
     if args.smoke:
         assert bit_identical, (
             "lookup results diverged across cache configurations"
+        )
+        assert device_admission_roundtrips == 0, (
+            "on-device admission still round-tripped the capacity slab "
+            f"through host numpy {device_admission_roundtrips}x"
+        )
+        assert legacy_roundtrips > 0, (
+            "legacy control never fired the round-trip counter — the "
+            "zero above proves nothing"
+        )
+        assert legacy_bit_identical, (
+            "device admission drifted from the legacy host path"
         )
         assert dedup_off_max_diff < 1e-6, (
             f"dedup on/off drifted past summation-order noise: "

@@ -24,8 +24,11 @@ have done without iteration-level retirement).
 
 `--smoke` runs a seconds-scale configuration and asserts the invariants
 (all served, zero retrace after warmup; for --decode also continuous-
-vs-offline bit-identity and occupancy gain > 1.5x) — wired into tier-1
-CI by tests/test_serving.py and tests/test_decode.py.
+vs-offline bit-identity, occupancy gain > 1.5x, and the KERNEL parity
+leg: the same paged+chunked+speculative workload under
+PADDLE_TPU_KERNELS=off vs =interpret must produce byte-identical
+tokens) — wired into tier-1 CI by tests/test_serving.py and
+tests/test_decode.py.
 
 Usage:
   python tools/bench_serving.py [--mode closed|open] [--requests 512]
@@ -295,6 +298,7 @@ def run_decode(args, rng):
 
     paged = _paged_sweep(args, rng) if args.paged else None
     spec = _spec_leg(args, rng) if args.spec else None
+    kernel_parity = _kernel_modes_leg(args) if args.smoke else None
 
     engine.shutdown()
     last = sweep[-1]
@@ -327,8 +331,11 @@ def run_decode(args, rng):
         report["extra"]["paged"] = paged
     if spec is not None:
         report["extra"]["spec"] = spec
+    if kernel_parity is not None:
+        report["extra"]["kernel_parity"] = kernel_parity
     print(json.dumps(report))
     if args.smoke:
+        assert kernel_parity["bit_identical"], kernel_parity
         assert errors == 0 and served == args.requests * len(args.rates), \
             (served, errors)
         assert mismatches == 0, f"{mismatches} continuous!=offline"
@@ -349,6 +356,55 @@ def run_decode(args, rng):
             assert spec["retraces"] == 0, spec
         print("DECODE_SMOKE_OK")
     return 0
+
+
+def _kernel_modes_leg(args):
+    """Kernel on/off bit-identity gate (PADDLE_TPU_KERNELS): the same
+    paged + chunked + speculative workload decoded hand-stepped under
+    the registry's "off" (composite fallbacks) and "interpret" (Pallas
+    kernels through the interpreter) modes must produce BYTE-identical
+    tokens for every request — the fused paged-attention kernel is the
+    exact composite primitive sequence, and this is where that contract
+    is held against the real engine, not a unit harness."""
+    from paddle_tpu import kernels
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    prompts = [[7, 3, 9, 2, 11, 5, 8, 1, 4], [7, 3, 9, 2, 11, 5, 8, 1],
+               [1, 2], [9, 9, 4, 4, 1, 2, 3, 4, 5, 6, 7, 8]]
+
+    def drive(mode):
+        with kernels.scoped_mode(mode):
+            engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+            geom = dict(vocab_size=args.vocab, hidden=args.hidden,
+                        num_layers=args.layers, slots=args.slots,
+                        max_len=args.max_len)
+            entry = engine.register_model(lambda: build_decoder_model(
+                block_size=4, chunk_tokens=4, name="bench_kmode",
+                version="1", **geom))
+            engine.register_model(lambda: build_decoder_model(
+                block_size=4, name="bench_kmode_draft", version="1",
+                **geom))
+            resps = [engine.submit(p, max_new_tokens=5,
+                                    model="bench_kmode") for p in prompts]
+            resps.append(engine.submit(
+                prompts[0], max_new_tokens=5, model="bench_kmode",
+                draft_model="bench_kmode_draft", spec_k=2))
+            for _ in range(args.max_len * 4):
+                if all(r.done() for r in resps):
+                    break
+                entry._iterate()
+            outs = [[int(t) for t in r.result(timeout=120)["tokens"]]
+                    for r in resps]
+            engine.shutdown()
+            return outs
+
+    off = drive("off")
+    interp = drive("interpret")
+    return {
+        "modes": ["off", "interpret"],
+        "requests": len(off),
+        "bit_identical": off == interp,
+    }
 
 
 def _paged_sweep(args, rng):

@@ -54,8 +54,12 @@ def static_hbm_report():
                          num_blocks=geom["slots"])),
         ("paged", dict(block_size=64, num_blocks=paged_blocks)),
     ):
+        # fused_attention=False pins the r13 program structure (gather +
+        # attention composite) so the committed r13 numbers stay
+        # byte-reproducible; the kernel-path story is KERNEL_EVIDENCE_r15
+        # (tools/kernel_report.py)
         m = build_decoder_model(name=f"hbm_{tag}", version="1", **geom,
-                                **kw)
+                                fused_attention=False, **kw)
         report = estimate_peak_hbm(
             m.decode_program,
             feed_shapes={n: s for n, s, _d in m.decode_feed_sig()},
