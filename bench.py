@@ -214,11 +214,41 @@ def _bench():
             extra["decode"] = _bench_decode()
         except Exception as e:
             extra["decode"] = {"error": str(e)[:300]}
+    if not os.environ.get("PADDLE_TPU_BENCH_NO_COST"):
+        try:
+            extra["cost"] = _bench_cost(main_prog, data, fetches)
+        except Exception as e:
+            extra["cost"] = {"error": str(e)[:300]}
     _emit(
         round(tokens_per_sec, 1),
         round(mfu / 0.5, 4),  # vs the >=50% MFU north star
         extra,
     )
+
+
+def _bench_cost(main_prog, data, fetches):
+    """Static roofline prediction for the bench program (analysis/cost.py,
+    r16): the PRE-COMPILE counterpart of mfu_est — predicted step time,
+    MFU, and bound-class counts on the default machine model, so the
+    bench records how far the measured number sits from the static
+    roofline it will one day be gated against."""
+    import numpy as np
+
+    from paddle_tpu.analysis.cost import analyze_cost
+
+    feed_shapes = {k: tuple(np.asarray(v).shape) for k, v in data.items()}
+    fetch_names = [f if isinstance(f, str) else f.name for f in fetches]
+    rep = analyze_cost(main_prog, feed_shapes=feed_shapes,
+                       fetch_names=fetch_names)
+    return {
+        "machine": rep.cost_model.machine.name,
+        "step_seconds": round(rep.step_seconds, 9),
+        "mfu_pred": round(rep.mfu, 6),
+        "total_flops": rep.total_flops,
+        "total_hbm_bytes": rep.total_hbm_bytes,
+        "bound_counts": rep.bound_counts(),
+        "unknown_ops": sorted(rep.unknown_ops),
+    }
 
 
 def _bench_decode():

@@ -73,6 +73,16 @@ class MemoryReport:
         }
 
 
+def var_bytes(name, shape_report, value_specs, axis_sizes, block=None,
+              feed_shapes=None):
+    """Per-device byte size of `name` (None when unresolvable): inferred
+    shape, declared-metadata fallback, shard divisors from `value_specs`.
+    Public so analysis/cost.py prices HBM traffic with the SAME resolver
+    that prices peaks here — the agreement test_cost_analysis.py pins."""
+    return _bytes_of(name, shape_report, value_specs, axis_sizes,
+                     block=block, feed_shapes=feed_shapes)
+
+
 def _bytes_of(name, shape_report, value_specs, axis_sizes, block=None,
               feed_shapes=None):
     info = shape_report.get(name)

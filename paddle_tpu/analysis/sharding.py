@@ -50,10 +50,10 @@ class ReshardEvent:
     collective_byte_report: the largest value the collective touches)."""
 
     __slots__ = ("kind", "cause", "var", "op_type", "op_index", "block_idx",
-                 "bytes", "shape", "spec")
+                 "bytes", "shape", "spec", "axes")
 
     def __init__(self, kind, cause, var, bytes_, shape, spec=None,
-                 op_type=None, op_index=None, block_idx=None):
+                 op_type=None, op_index=None, block_idx=None, axes=()):
         self.kind = kind          # all-reduce | all-gather | all-to-all
         self.cause = cause
         self.var = var
@@ -63,6 +63,11 @@ class ReshardEvent:
         self.op_type = op_type
         self.op_index = op_index
         self.block_idx = block_idx
+        # mesh axes the collective's ring spans — what the cost model
+        # (analysis/cost.py) prices through the ici/dcn link tiers.
+        # Deliberately NOT in to_json(): STATIC_EVIDENCE_r09.json embeds
+        # to_json() output and must not drift.
+        self.axes = tuple(axes or ())
 
     def to_json(self):
         return {
@@ -282,6 +287,8 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
     env = dict(report.param_specs)
     input_specs = input_specs or {}
     feed_names = set(feed_names)
+    data_axes = set()   # every mesh axis the feeds are sharded over —
+    # the ring the grad-sync all-reduce spans (multi-axis under dp×dcn)
     for block in program.blocks:
         for v in block.vars.values():
             if v.is_data or v.name in feed_names:
@@ -296,15 +303,20 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
                         not any(is_sym(d) for d in shape):
                     spec = check_spec(tuple(shape), spec, mesh)
                 env[v.name] = _norm_spec(spec, rank)
+                data_axes.update(
+                    ax for ax in _spec_axes(env[v.name])
+                    if axis_sizes.get(ax, 1) > 1
+                )
 
     # -- propagation + per-edge events ----------------------------------
     def emit(kind, cause, var, bytes_, shape, spec=None, op=None,
-             op_index=None, block=None):
+             op_index=None, block=None, axes=()):
         report.events.append(ReshardEvent(
             kind, cause, var, bytes_, shape, spec=spec,
             op_type=op.type if op is not None else None,
             op_index=op_index,
             block_idx=block.idx if block is not None else None,
+            axes=tuple(sorted(set(axes or ()))),
         ))
 
     def get_spec(name):
@@ -344,7 +356,8 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
             emit("all-reduce", "matmul-partial-sum", out_name,
                  _shard_bytes(out_shape, out_spec, axis_sizes,
                               dtype_of(out_name)),
-                 out_shape, _spec_str(out_spec), op, op_index, block)
+                 out_shape, _spec_str(out_spec), op, op_index, block,
+                 axes=tuple(cx or ()) + tuple(cy or ()))
         env[out_name] = out_spec
 
     def _transfer(op, op_index, block):
@@ -410,7 +423,8 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
                 emit("all-reduce", "sharded-vocab-lookup", on,
                      _shard_bytes(out_shape, out_spec, axis_sizes,
                                   dtype_of(on)),
-                     out_shape, _spec_str(out_spec), op, op_index, block)
+                     out_shape, _spec_str(out_spec), op, op_index, block,
+                     axes=tuple(wspec[0] or ()))
             env[on] = _norm_spec(out_spec, rank)
         elif t in ("reduce_sum", "reduce_mean", "mean",
                    "softmax_with_cross_entropy", "cross_entropy"):
@@ -526,11 +540,13 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
             continue
         dt = dtype_of(name)
         if data_size > 1 and name in read:
-            # gradient synchronization over the batch axis: bytes = the
-            # parameter's SHARD (this is why layout sharding shrinks wire)
+            # gradient synchronization over the data axes: bytes = the
+            # parameter's SHARD (this is why layout sharding shrinks wire);
+            # the ring spans EVERY axis the feeds shard over (dp×dcn runs
+            # sync across both tiers — what the hierarchical linter prices)
             emit("all-reduce", "grad-sync", name,
                  _shard_bytes(shape, spec, axis_sizes, dt), shape,
-                 _spec_str(spec))
+                 _spec_str(spec), axes=data_axes or {batch_axis})
         if tensor_sharded and _is_replicated(spec) and len(shape) >= 1:
             # replicated parameter in a tensor-sharded program: its update
             # is computed shard-local (the activations feeding its grad
@@ -538,7 +554,10 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
             # honor the replicated out-pin — the weight-sized collective
             # class PR 7 eliminated for registry layouts
             emit("all-gather", "replicated-param-update", name,
-                 _full_bytes(shape, dt), shape, "replicated")
+                 _full_bytes(shape, dt), shape, "replicated",
+                 axes=[ax for ax in axis_sizes
+                       if ax in TENSOR_AXIS_NAMES
+                       and axis_sizes.get(ax, 1) > 1])
 
     report.events.sort(key=lambda e: -(e.bytes or 0))
     return report

@@ -146,6 +146,7 @@ class CompiledProgram:
         self._param_rules = None      # pattern -> spec table (sharding.py)
         self._param_overrides = None  # exact name -> spec
         self._input_specs = None      # feed name -> spec (default: batch on 'data')
+        self._axis_tags = None        # mesh axis -> 'ici'|'dcn' (cost stage)
         self._spec_layout = None      # SpecLayout | False (off) | None (auto)
         self._auto_layout_cache = {}  # (prog uid, version) -> SpecLayout|None
 
@@ -180,6 +181,7 @@ class CompiledProgram:
         param_specs=None,
         input_specs=None,
         spec_layout=None,
+        axis_tags=None,
     ):
         # spec_layout contract: an instance/True = that registry;
         # False = placement stays exactly as passed (pre-PR-9 behavior);
@@ -209,6 +211,10 @@ class CompiledProgram:
         self._param_rules = param_rules
         self._param_overrides = param_specs
         self._input_specs = input_specs
+        # axis_tags: mesh axis -> 'ici' | 'dcn', consumed by the 'cost'
+        # static diagnostic stage's two-level collective model; declaring
+        # a 'dcn' axis arms the hierarchical-collective linter as an error
+        self._axis_tags = dict(axis_tags) if axis_tags else None
         if spec_layout is True:
             from paddle_tpu.parallel.spec_layout import SpecLayout
 
@@ -596,6 +602,7 @@ class CompiledProgram:
                     "param_rules": self._param_rules,
                     "param_specs": self._param_overrides,
                     "input_specs": self._input_specs,
+                    "axis_tags": self._axis_tags,
                 },
                 extra_fingerprint=(("dgc", dgc_sparse),),
                 label="compiled_program",

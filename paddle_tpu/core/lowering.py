@@ -114,7 +114,7 @@ def verify_for_lowering(program, feed_names, fetch_names, scope=None):
 # before any tracing
 # ---------------------------------------------------------------------------
 
-_STATIC_STAGE_NAMES = ("shapes", "sharding", "memory")
+_STATIC_STAGE_NAMES = ("shapes", "sharding", "memory", "cost")
 
 
 def _static_stages():
@@ -164,7 +164,8 @@ def run_static_diagnostics(program, feed_sig, fetch_names, stages, *,
     feed_dtypes = {n: d for n, _s, d in feed_sig}
     shape_report = None
     errors = []
-    if "shapes" in stages or "memory" in stages or "sharding" in stages:
+    if "shapes" in stages or "memory" in stages or "sharding" in stages \
+            or "cost" in stages:
         shape_report = a_shapes.infer_shapes(
             program, feed_shapes=feed_shapes, feed_dtypes=feed_dtypes,
         )
@@ -178,7 +179,7 @@ def run_static_diagnostics(program, feed_sig, fetch_names, stages, *,
             elif "shapes" in stages:
                 _stage_log().warning("static[%s]: %s", label, d)
     sharding_report = None
-    if "sharding" in stages and mesh is not None:
+    if ("sharding" in stages or "cost" in stages) and mesh is not None:
         from paddle_tpu.analysis import sharding as a_sharding
 
         placement = placement or {}
@@ -192,7 +193,7 @@ def run_static_diagnostics(program, feed_sig, fetch_names, stages, *,
             shape_report=shape_report,
         )
         budget_kb = flags.collective_budget_kb
-        if budget_kb:
+        if budget_kb and "sharding" in stages:
             from paddle_tpu.analysis.sharding import (
                 collective_budget_diagnostics,
             )
@@ -216,6 +217,35 @@ def run_static_diagnostics(program, feed_sig, fetch_names, stages, *,
             mem.peak_intermediate_bytes / 2**20,
             mem.peak_op_index, mem.peak_op_type,
         )
+    if "cost" in stages:
+        from paddle_tpu.analysis.cost import (
+            analyze_cost,
+            hierarchical_collective_diagnostics,
+        )
+
+        placement = placement or {}
+        axis_tags = placement.get("axis_tags")
+        cost = analyze_cost(
+            program, machine=flags.cost_machine or "tpu-v4-8",
+            mesh=mesh, axis_tags=axis_tags, feed_shapes=feed_shapes,
+            feed_dtypes=feed_dtypes, fetch_names=fetch_names,
+            shape_report=shape_report, sharding_report=sharding_report,
+        )
+        _stage_log().info(
+            "static[%s]: predicted step %.3f ms on %s (roofline %.3f ms "
+            "+ collectives %.3f ms), MFU %.4f, %d/%d ops compute-bound",
+            label, cost.step_seconds * 1e3, cost.cost_model.machine.name,
+            cost.roofline_seconds * 1e3, cost.collective_seconds * 1e3,
+            cost.mfu, cost.bound_counts()["compute"], len(cost.ops),
+        )
+        hier = hierarchical_collective_diagnostics(cost)
+        if axis_tags and any(t == "dcn" for t in axis_tags.values()):
+            # the caller has DECLARED the slow tier — a full-payload
+            # all-reduce across it is a layout bug, not a maybe
+            errors.extend(hier)
+        else:
+            for d in hier:
+                _stage_log().warning("static[%s]: %s", label, d)
     if errors:
         lines = [f"[{d.code}] {d.message}" for d in errors[:5]]
         raise EnforceError(

@@ -31,6 +31,10 @@ class DistributedStrategy(BuildStrategy):
         # (reference: paddle/fluid/framework/parallel_executor.cc:196).
         self.mesh_shape = None
         self.mesh_axis_names = None
+        # mesh axis -> 'ici' | 'dcn': feeds the static cost stage's
+        # two-level collective model; naming an axis 'dcn' (or tagging it
+        # here) makes the hierarchical-allreduce linter a hard error
+        self.mesh_axis_tags = None
         self.param_rules = None      # Megatron-style TP rule table
         self.param_specs = None      # exact name -> PartitionSpec
         self.input_specs = None      # feed name -> PartitionSpec
@@ -95,6 +99,7 @@ class CollectiveOptimizer(DistributedOptimizer):
             param_specs=strategy.param_specs,
             input_specs=strategy.input_specs,
             spec_layout=strategy.spec_layout,
+            axis_tags=strategy.mesh_axis_tags,
         )
         fleet._main_program = compiled
         return optimize_ops, params_grads

@@ -102,16 +102,24 @@ define_flag(
 define_flag(
     "static_diagnostics", "",
     "opt-in static-analysis stages run ahead of the mandatory verifier "
-    "in core/lowering.py: comma list of 'shapes', 'sharding', 'memory' "
-    "(or 'all'). Shape/dtype errors then fail at lowering time with op "
-    "attribution instead of exploding inside jit; sharding adds the "
-    "collective-cost report, memory the peak-HBM estimate",
+    "in core/lowering.py: comma list of 'shapes', 'sharding', 'memory', "
+    "'cost' (or 'all'). Shape/dtype errors then fail at lowering time "
+    "with op attribution instead of exploding inside jit; sharding adds "
+    "the collective-cost report, memory the peak-HBM estimate, cost the "
+    "roofline step-time/MFU prediction plus the hierarchical-collective "
+    "linter (errors when axis_tags declare a 'dcn' axis)",
 )
 define_flag(
     "collective_budget_kb", 0,
     "per-collective byte budget (KB) for the static sharding linter "
     "when the 'sharding' diagnostic stage is on; 0 disables the budget "
     "gate (the report still runs)",
+)
+define_flag(
+    "cost_machine", "tpu-v4-8",
+    "machine model for the 'cost' static diagnostic stage "
+    "(analysis/cost.py MACHINES: tpu-v4-8, tpu-v5e-8, tpu-v5p-8, "
+    "tpu-v6e-8, cpu-host)",
 )
 define_flag(
     "pallas_dgc_topk", False,

@@ -20,6 +20,13 @@ error; ``--json`` emits one machine-readable report line per program):
   memory       liveness-driven peak-HBM estimate + the donation-safety
                hard errors (read-after-donate, donated-var-fetched,
                donated-var-aliased-twice)
+  cost         roofline cost model (analysis/cost.py): predicted step
+               seconds / MFU / per-op compute-vs-memory-bound
+               classification on ``--machine`` (tpu-v4-8 default), the
+               per-axis collective budget, the hierarchical-collective
+               (dcn-allreduce) linter when ``--tag AXIS=dcn`` declares a
+               slow axis, and ``--budget-step-ms`` /
+               ``--budget-collective-kb`` / ``--min-mfu`` gates
   smoke        the fast-tier CI gate: shapes+sharding+donation over every
                examples/ build_programs() graph, plus a drift check of
                STATIC_EVIDENCE_r09.json's static predictions against a
@@ -37,6 +44,8 @@ Usage:
       --mesh 2x4:data,model --spec-layout --json
   python tools/lint_program.py collectives model.json --mesh 2x4:data,model \\
       --budget-kb 192
+  python tools/lint_program.py cost --builtin transformer \\
+      --mesh 2x4:dcn,data --tag dcn=dcn --machine tpu-v4-8 --json
   python tools/lint_program.py smoke
 """
 
@@ -49,7 +58,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 BUILTINS = ("mnist", "mnist_conv", "transformer")
 SUBCOMMANDS = ("verify", "shapes", "sharding", "collectives", "memory",
-               "smoke")
+               "cost", "smoke")
 
 EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 1, 2
 
@@ -350,6 +359,76 @@ def _cmd_collectives(args):
     return failures
 
 
+def _parse_axis_tags(entries):
+    """['dcn=dcn', 'data=ici'] -> {'dcn': 'dcn', 'data': 'ici'}."""
+    out = {}
+    for e in entries or []:
+        ax, _, tag = e.partition("=")
+        if not ax or tag not in ("ici", "dcn"):
+            _usage_error(f"bad --tag '{e}': want AXIS=ici|dcn")
+        out[ax] = tag
+    return out
+
+
+def _cmd_cost(args):
+    from paddle_tpu.analysis.cost import (
+        MACHINES,
+        analyze_cost,
+        check_cost_budgets,
+        hierarchical_collective_diagnostics,
+    )
+
+    if args.machine not in MACHINES:
+        _usage_error(
+            f"unknown --machine '{args.machine}'; have {sorted(MACHINES)}"
+        )
+    axis_tags = _parse_axis_tags(args.tag)
+    feed_shapes = _parse_feed_shapes(args.feed_shape)
+    mesh = _make_mesh(args) if args.mesh else None
+    layout = None
+    if getattr(args, "spec_layout", False):
+        from paddle_tpu.parallel.spec_layout import SpecLayout
+
+        layout = SpecLayout()
+    batch_axes = tuple(a for a in (args.batch_spec or "").split(",") if a)
+    failures = 0
+    for label, program, feed, fetch in _iter_programs(args, [], []):
+        input_specs = None
+        if batch_axes:
+            from jax.sharding import PartitionSpec as P
+
+            input_specs = {n: P(batch_axes) for n in feed}
+        rep = analyze_cost(
+            program, machine=args.machine, mesh=mesh,
+            axis_tags=axis_tags or None, spec_layout=layout,
+            input_specs=input_specs,
+            feed_shapes=feed_shapes, fetch_names=fetch,
+        )
+        diags = list(rep.diagnostics)
+        diags += hierarchical_collective_diagnostics(rep)
+        diags += check_cost_budgets(
+            rep, step_ms=args.budget_step_ms,
+            collective_kb=args.budget_collective_kb, min_mfu=args.min_mfu,
+        )
+        j = rep.to_json(ops_limit=16)
+        failures += _report(
+            label, "cost", diags,
+            extra={"machine": args.machine,
+                   "step_seconds": j["step_seconds"],
+                   "mfu": j["mfu"],
+                   "total_flops": j["total_flops"],
+                   "total_hbm_bytes": j["total_hbm_bytes"],
+                   "bound_counts": j["bound_counts"],
+                   "per_axis": j["per_axis"],
+                   "unknown_ops": j["unknown_ops"],
+                   "pipeline": j["pipeline"],
+                   "events": j["collectives"]},
+            as_json=args.as_json,
+            warnings_as_errors=args.warnings_as_errors,
+        )
+    return failures
+
+
 def _static_donation_plan(program, feed_names, fetch_names):
     """plan_step's donation classification without a scope: persistable
     vars written by live ops and not fetched are donated, the rest of the
@@ -534,7 +613,7 @@ def _cmd_smoke(args):
 # ---------------------------------------------------------------------------
 
 
-def _add_common(ap, with_mesh=False):
+def _add_common(ap, with_mesh=False, mesh_required=True):
     ap.add_argument("programs", nargs="*", help="serialized program files")
     ap.add_argument("--builtin", action="append", default=[],
                     choices=BUILTINS,
@@ -546,8 +625,11 @@ def _add_common(ap, with_mesh=False):
                     help="one JSON report line per program")
     ap.add_argument("--warnings-as-errors", action="store_true")
     if with_mesh:
-        ap.add_argument("--mesh", required=True, metavar="SHAPE:AXES",
-                        help="virtual mesh, e.g. 2x4:data,model")
+        ap.add_argument("--mesh", required=mesh_required,
+                        default=None, metavar="SHAPE:AXES",
+                        help="virtual mesh, e.g. 2x4:data,model"
+                        + ("" if mesh_required
+                           else " (omit for single-device)"))
         ap.add_argument("--spec-layout", action="store_true",
                         help="place parameters through the canonical "
                         "SpecLayout registry (parallel/spec_layout.py)")
@@ -555,8 +637,14 @@ def _add_common(ap, with_mesh=False):
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        # top-level help must describe the SUBCOMMAND surface, not fall
+        # through to the legacy verify parser (which knows nothing of the
+        # other passes — the help/usage drift fixed in round 16)
+        print(__doc__)
+        return EXIT_CLEAN
     sub = argv[0] if argv and argv[0] in SUBCOMMANDS else None
-    if sub in ("sharding", "collectives"):
+    if sub in ("sharding", "collectives", "cost"):
         n = _mesh_arg_devices(argv)
         if n:
             _ensure_virtual_devices(n)
@@ -579,13 +667,38 @@ def main(argv=None):
                             "(progress goes to stderr)")
             return (EXIT_FINDINGS if _cmd_smoke(ap.parse_args(body))
                     else EXIT_CLEAN)
-        _add_common(ap, with_mesh=sub in ("sharding", "collectives"))
+        _add_common(ap, with_mesh=sub in ("sharding", "collectives", "cost"),
+                    mesh_required=sub != "cost")
         if sub == "collectives":
             ap.add_argument("--budget-kb", type=int, required=True,
                             help="per-collective byte budget in KB")
         if sub == "memory":
             ap.add_argument("--no-donate", action="store_true",
                             help="estimate without buffer donation")
+        if sub == "cost":
+            ap.add_argument("--machine", default="tpu-v4-8",
+                            metavar="NAME",
+                            help="machine model (analysis/cost.py "
+                            "MACHINES); unknown names exit 2")
+            ap.add_argument("--tag", action="append", default=[],
+                            metavar="AXIS=ici|dcn",
+                            help="tag a mesh axis's link tier "
+                            "(repeatable); a 'dcn' tag arms the "
+                            "hierarchical-allreduce linter")
+            ap.add_argument("--budget-step-ms", type=float, default=0.0,
+                            help="fail if predicted step time exceeds "
+                            "this many ms (0 disables)")
+            ap.add_argument("--budget-collective-kb", type=int, default=0,
+                            help="fail if any mesh axis carries more "
+                            "on-wire KB per step (0 disables)")
+            ap.add_argument("--min-mfu", type=float, default=0.0,
+                            help="fail if predicted MFU is below this "
+                            "floor (0 disables)")
+            ap.add_argument("--batch-spec", default="",
+                            metavar="AXIS[,AXIS]",
+                            help="shard every feed's batch dim over "
+                            "these mesh axes (naive dp over dcn,ici — "
+                            "the layout the hierarchical linter flags)")
         args = ap.parse_args(body)
         if not args.programs and not args.builtin:
             ap.error("nothing to lint: pass program files and/or --builtin")
@@ -594,6 +707,7 @@ def main(argv=None):
             "sharding": _cmd_sharding,
             "collectives": _cmd_collectives,
             "memory": _cmd_memory,
+            "cost": _cmd_cost,
         }[sub]
         return EXIT_FINDINGS if body_fn(args) else EXIT_CLEAN
     except SystemExit:
