@@ -27,6 +27,7 @@ __all__ = [
     "scaled_dot_product_attention",
     "kv_cache_write",
     "masked_write",
+    "logits_mask_add",
     "cached_attention",
     "paged_attention",
     "block_gather",
@@ -648,6 +649,21 @@ def block_scatter_write(arena, rows, new_rows, name=None):
 
     return scatter(arena, rows, new_rows, overwrite=True, mode="drop",
                    name=name)
+
+
+def logits_mask_add(logits, mask, name=None):
+    """Additive logits mask for constrained decode: ``logits + mask``
+    where ``mask`` is host-built, 0.0 at allowed tokens and ``-1e9`` at
+    banned ones (``[S, 1, V]`` against the decode step's logits). The
+    same exactness contract as the attention bias: ``x + 0.0 == x`` in
+    IEEE float32, so an all-zeros mask (no grammar active) leaves every
+    logit bit-untouched, and the host applying the identical float32
+    add to prefill-fetched logits reproduces the device result
+    byte-for-byte — which is what keeps grammar-constrained decode
+    bit-comparable to the offline reference. The mask enters as DATA
+    through a fixed-shape feed, so per-step grammar state changes never
+    retrace."""
+    return elementwise_add(logits, mask, name=name)
 
 
 def cached_attention(q, k_cache, v_cache, attn_bias, sm_scale=1.0,

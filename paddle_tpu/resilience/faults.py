@@ -60,6 +60,24 @@ tools/chaos_elastic.py):
   restart budget and retries its decision loop); ``stall`` delays it —
   so resize-path failure is injectable like any other hardened path.
 
+Decode-engine sites (r13/r17, ``serving/decode/`` +
+tools/stress_concurrency.py):
+
+* ``decode.step`` / ``decode.prefill`` / ``decode.inject`` — fired
+  before each decode iteration / prompt prefill / warm-slot KV inject.
+  ``raise`` exercises the arena-loss recovery path (every in-flight
+  request rejected, arena rebuilt); ``stall`` perturbs scheduler-thread
+  interleavings for the concurrency stress harness.
+* ``decode.sample`` — fired before each committed-threefry sampled
+  token draw (r17; greedy requests never reach it). ``stall`` shifts
+  WHEN a sampled request's host-side policy runs relative to its
+  batchmates — the stress harness uses it to prove the stall schedule
+  cannot change a byte of the sampled stream (it is keyed purely on
+  request seed + emitted-token index). Unlike the three sites above,
+  the draw is host arithmetic on already-fetched logits, not a device
+  boundary, so ``raise`` models no real failure here: use ``stall``
+  schedules at this site.
+
 Fleet failover sites (r12, ``serving/fleet/`` + tools/chaos_serve.py):
 
 * ``fleet.dispatch`` — fired before every router->replica dispatch

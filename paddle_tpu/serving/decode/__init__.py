@@ -1,4 +1,4 @@
-"""Continuous-batching decode subsystem (online serving v3).
+"""Continuous-batching decode subsystem (online serving v4).
 
 The PR-2 engine (serving/engine.py) schedules at REQUEST granularity:
 whole requests coalesce into fixed (batch, seq) buckets and a finished
@@ -15,6 +15,16 @@ a budgeted chunk-prefill program interleaved with decode iterations, and
 a draft-model **speculative decoding** path (Leviathan et al.) emits
 multiple greedy-exact tokens per target forward.
 
+r17 adds the **generation-modes layer** (``generate/``): committed
+threefry **sampling** (temperature / top-k / top-p, replay bit-exact
+under any admission order), **beam search** as copy-on-write forks over
+the radix block arena (each hypothesis is a live slot; fork = refcount++
+plus a private tail block), **draft-KV speculative slots** (proposals
+decode O(1)/token from the draft entry's own paged arena instead of
+replaying the prompt), and **grammar-constrained decode** (regex / JSON
+schema compiled host-side to per-step fixed-shape logits masks fed as
+data through the donated ``DEC_MASK`` input — zero retraces).
+
 Modules:
 
 * `model`  — `DecodeModel`: the fixed-shape paged-program contract
@@ -30,11 +40,19 @@ Modules:
   compile cache.
 * `metrics`— `DecodeMetrics`: the serving counter set + occupancy /
   tokens-per-step / block-pool / speculative-acceptance series.
+* `generate` — the decode-policy layer: `SamplingParams`, `BeamParams`,
+  `CompiledGrammar` / `GrammarConstraint`, the offline beam reference.
 """
 
 from paddle_tpu.serving.decode.engine import (
     GenerationEngine,
     GenerationRequest,
+)
+from paddle_tpu.serving.decode.generate import (
+    BeamParams,
+    CompiledGrammar,
+    GrammarConstraint,
+    SamplingParams,
 )
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import DecodeModel, build_decoder_model
@@ -47,12 +65,16 @@ from paddle_tpu.serving.decode.pool import (
 )
 
 __all__ = [
+    "BeamParams",
     "BlockPool",
+    "CompiledGrammar",
     "DecodeMetrics",
     "DecodeModel",
     "GenerationEngine",
     "GenerationRequest",
+    "GrammarConstraint",
     "PrefixCache",
+    "SamplingParams",
     "SlotPool",
     "block_hashes",
     "build_decoder_model",

@@ -65,6 +65,18 @@ import numpy as np
 from paddle_tpu import profiler
 from paddle_tpu.observability import lockdep
 from paddle_tpu.resilience import faults
+from paddle_tpu.serving.decode.generate import (
+    BeamParams,
+    CompiledGrammar,
+    GrammarConstraint,
+    SamplingParams,
+    offline_beam_decode,
+    sample_token,
+)
+from paddle_tpu.serving.decode.generate.beam import (
+    finished_ranking as beam_finished_ranking,
+)
+from paddle_tpu.serving.decode.generate.beam import select as beam_select
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import NEG_INF, DecodeModel
 from paddle_tpu.serving.decode.pool import (
@@ -91,21 +103,31 @@ __all__ = ["GenerationEngine", "GenerationRequest"]
 # retry-after OUTSIDE _tenant_lock) exists precisely to preserve this.
 # Declared so a future inversion names the RULE, not just the cycle.
 lockdep.declare_order("serving.queue", "decode.tenant")
+# Draft-KV speculation: a TARGET entry's scheduler thread takes the draft
+# entry's decode.draft lock, then allocates from the draft's block pool
+# inside it (catch-up / proposal appends) — the draft lock is strictly
+# OUTSIDE the pool lock, never the reverse.
+lockdep.declare_order("decode.draft", "decode.blocks")
 
 
 class GenerationRequest:
-    """One admitted generation request (rows is always 1: a request holds
-    one slot). `response.result()` yields ``{"tokens": int64 array}`` —
-    the generated tokens, including the stop token when eos fired.
-    ``draft_key`` (a registry ``(name, version)``) opts the request into
-    speculative decoding with ``spec_k`` proposals per verify cycle."""
+    """One admitted generation request. `response.result()` yields
+    ``{"tokens": int64 array}`` — the generated tokens, including the
+    stop token when eos fired (beam requests add ``"beams"``: every
+    finished hypothesis with its score, best first). ``draft_key`` (a
+    registry ``(name, version)``) opts the request into speculative
+    decoding with ``spec_k`` proposals per verify cycle; ``rows`` is the
+    slot footprint — 1 for everything except beam search, whose live
+    hypotheses each hold a batch slot."""
 
     __slots__ = ("id", "prompt", "max_new", "tenant", "priority", "deadline",
                  "submit_time", "dispatch_time", "response", "rows",
-                 "draft_key", "spec_k")
+                 "draft_key", "spec_k", "sampling", "beam", "grammar",
+                 "draft_kv")
 
     def __init__(self, rid, prompt, max_new, tenant, priority, deadline,
-                 draft_key=None, spec_k=0):
+                 draft_key=None, spec_k=0, sampling=None, beam=None,
+                 grammar=None, draft_kv=False):
         self.id = rid
         self.prompt = list(prompt)
         self.max_new = int(max_new)
@@ -115,7 +137,11 @@ class GenerationRequest:
         self.submit_time = time.perf_counter()
         self.dispatch_time = None
         self.response = Response()
-        self.rows = 1
+        self.sampling = sampling      # SamplingParams or None (greedy)
+        self.beam = beam              # BeamParams or None
+        self.grammar = grammar        # CompiledGrammar or None
+        self.draft_kv = bool(draft_kv)
+        self.rows = beam.width if beam is not None else 1
         self.draft_key = draft_key
         self.spec_k = int(spec_k)
 
@@ -148,13 +174,19 @@ class _Slot:
     """Host-side state of one live batch slot.
 
     ``mode`` is "decode" (stepping through the [S,1] program),
-    "prefill" (a long prompt streaming through the chunk program), or
-    "spec" (speculative verify cycles — holds no arena blocks).
-    ``blocks`` is the slot's block table; ``row_map[p]`` the physical
-    arena row of position ``p`` (the device half of the table)."""
+    "prefill" (a long prompt streaming through the chunk program),
+    "spec" (speculative verify cycles — holds no TARGET arena blocks),
+    or "beam" (one live beam hypothesis; its group coordinates via
+    ``beam``). ``blocks`` is the slot's block table; ``row_map[p]`` the
+    physical arena row of position ``p`` (the device half of the
+    table). ``d_*`` is the draft-KV footprint of a speculative slot:
+    its slot/blocks/row-map ON THE DRAFT ENTRY plus ``d_cursor``, the
+    next draft arena position without a committed KV row."""
 
     __slots__ = ("request", "mode", "cursor", "last_token", "generated",
-                 "blocks", "row_map", "plen", "done", "shared_len", "toks")
+                 "blocks", "row_map", "plen", "done", "shared_len", "toks",
+                 "sampling", "grammar", "beam", "score",
+                 "d_entry", "d_slot", "d_blocks", "d_row_map", "d_cursor")
 
     def __init__(self, request, mode="decode"):
         self.request = request
@@ -168,6 +200,36 @@ class _Slot:
         self.done = 0           # chunked prefill: prompt positions landed
         self.shared_len = 0     # positions served by radix-shared blocks
         self.toks = None        # spec mode: prompt + emitted so far
+        self.sampling = None    # SamplingParams (committed-stream sampling)
+        self.grammar = None     # per-hypothesis GrammarConstraint
+        self.beam = None        # _BeamGroup this slot belongs to
+        self.score = 0.0        # beam: cumulative float64 log-prob
+        self.d_entry = None     # draft-KV: the draft _ModelEntry
+        self.d_slot = None
+        self.d_blocks = None
+        self.d_row_map = None
+        self.d_cursor = 0
+
+
+class _BeamGroup:
+    """One beam request's shared state across its live hypothesis slots.
+    ``order`` is the live slot ids in REFERENCE hypothesis order — the
+    rank order of the last selection — which is what makes the
+    incremental engine's tie-breaking (by parent index) bit-identical
+    to ``offline_beam_decode``'s live-list order."""
+
+    __slots__ = ("request", "width", "finished", "order", "spare")
+
+    def __init__(self, request):
+        self.request = request
+        self.width = request.beam.width
+        self.finished = []      # [(token list, float64 score), ...]
+        self.order = []         # live slot ids, hypothesis order
+        # the group RESERVES width slots for its lifetime (that is what
+        # request.rows promised admission): pruned hypotheses park their
+        # slot here for later forks instead of returning it to the pool,
+        # so a fork can never lose its slot to a concurrent admission
+        self.spare = []
 
 
 class _ModelEntry:
@@ -201,6 +263,17 @@ class _ModelEntry:
         self._pref_rr = 0       # round-robin cursor over prefilling slots
         # half-open relaunch latch: one rebuild per breaker episode
         self._probe_relaunched = False
+        # draft-KV speculation, when THIS entry serves as the draft:
+        # every draft-side device call from a target's scheduler thread
+        # serializes under _draft_lock; _draft_pinned closes the entry to
+        # primary submissions (its own loop then never touches the arena,
+        # so the donated draft decode/inject calls cannot race it);
+        # _draft_ok poisons the entry after a failed donated draft call —
+        # users fall back to replay proposals instead of reading an
+        # undefined arena
+        self._draft_lock = lockdep.named_lock("decode.draft")
+        self._draft_pinned = False
+        self._draft_ok = True
 
     # -- build / warmup ---------------------------------------------------
     def build(self):
@@ -363,7 +436,7 @@ class _ModelEntry:
                     return False
         admitted = self._admit_free_slots()
         progressed = self._advance_prefills() + self._advance_spec()
-        if not any(st is not None and st.mode == "decode"
+        if not any(st is not None and st.mode in ("decode", "beam")
                    for st in self._slots):
             # nothing decodable AND this round moved nothing — either
             # the queue is empty, or everything queued is blocked on a
@@ -393,11 +466,17 @@ class _ModelEntry:
     def _admit_free_slots(self):
         picked = []
         with self._cond:
-            while self._pool.free_count - len(picked) > 0:
-                req = self._engine._pick(self._queue)
+            rows = 0
+            while self._pool.free_count - rows > 0:
+                # budget in ROWS, not requests: a beam admission claims
+                # width slots (seed + first-selection forks) before the
+                # next pick runs
+                req = self._engine._pick(
+                    self._queue, max_rows=self._pool.free_count - rows)
                 if req is None:
                     break
                 picked.append(req)
+                rows += req.rows
             # the round's picks are ONE drain event for the rate EWMA
             self._queue.note_drained()
         for req in picked:
@@ -428,11 +507,9 @@ class _ModelEntry:
                 self._probe_relaunched = False
                 if self._breaker is not None:
                     self._breaker_event(self._breaker.record_failure())
-                for s, st in enumerate(self._slots):
-                    if st is not None:
-                        self._reject_in_flight(st.request, ReplicaLostError(
-                            f"request {st.request.id} lost to arena "
-                            f"failure during admission: {e}"), slot=s)
+                self._reject_all_slots(lambda r: ReplicaLostError(
+                    f"request {r.id} lost to arena "
+                    f"failure during admission: {e}"))
                 self._reset_arenas()
                 # the reset arena is valid (zeroed): the REMAINING picked
                 # requests still admit — dropping them would abandon
@@ -475,11 +552,21 @@ class _ModelEntry:
         m = self._model
         req.dispatch_time = time.perf_counter()
         if req.draft_key is not None:
-            # speculative: no arena footprint — verification re-derives
-            # every KV it needs inside the (stateless) batch prefill
+            # speculative: no TARGET arena footprint — verification
+            # re-derives every KV it needs inside the (stateless) batch
+            # prefill. With draft_kv the proposals get their own slot +
+            # blocks on the DRAFT entry (O(1) per proposed token);
+            # admission failure there degrades to replay proposals.
             st = _Slot(req, mode="spec")
             st.toks = list(req.prompt)
+            st.sampling = req.sampling
+            if req.grammar is not None:
+                st.grammar = GrammarConstraint(req.grammar)
             self._slots[slot] = st
+            if req.draft_kv:
+                draft = self._engine._entries.get(req.draft_key)
+                if draft is not None:
+                    self._admit_draft_kv(st, draft)
             self._metrics.incr("admitted")
             self._metrics.tenant_incr("admitted", req.tenant)
             return
@@ -547,16 +634,23 @@ class _ModelEntry:
 
         self._blocks.register_prompt_blocks(blocks, prompt,
                                             host_rows=host_rows)
-        first = int(np.argmax(logits_row))
         st.cursor = plen
-        st.last_token = first
-        st.generated = [first]
         self._slots[slot] = st
         self._metrics.incr("admitted")
+        self._metrics.tenant_incr("admitted", req.tenant)
+        if req.beam is not None:
+            st.mode = "beam"
+            self._begin_beam(slot, logits_row)
+            return
+        st.sampling = req.sampling
+        if req.grammar is not None:
+            st.grammar = GrammarConstraint(req.grammar)
+        first = self._choose_token(st, logits_row, device_masked=False)
+        st.last_token = first
+        st.generated = [first]
         # the prefill's first token: counted apart from generated_tokens
         # so tokens_per_step stays a decode-step quantity (<= S)
         self._metrics.incr("prefill_tokens")
-        self._metrics.tenant_incr("admitted", req.tenant)
         self._metrics.tenant_incr("tokens", req.tenant)
         if self._finished(st):
             self._retire(slot)
@@ -630,10 +724,21 @@ class _ModelEntry:
         if st.done < st.plen:
             return 1
         logits = np.asarray(fetches[0])              # [1, C, V]
-        first = int(np.argmax(logits[0, real - 1]))
         self._blocks.register_prompt_blocks(st.blocks, req.prompt)
-        st.mode = "decode"
         st.cursor = st.plen
+        if req.beam is not None:
+            st.mode = "beam"
+            try:
+                self._begin_beam(s, np.array(logits[0, real - 1]))
+            except _ArenaInvalidError as e:
+                self._arena_lost(f"beam fork inject failure: {e}")
+            return 1
+        st.mode = "decode"
+        st.sampling = req.sampling
+        if req.grammar is not None:
+            st.grammar = GrammarConstraint(req.grammar)
+        first = self._choose_token(st, logits[0, real - 1],
+                                   device_masked=False)
         st.last_token = first
         st.generated = [first]
         self._metrics.incr("prefill_tokens")
@@ -684,17 +789,23 @@ class _ModelEntry:
             # any observed executable content-identical — and any torn
             # state it could still surface lands here, on one request.)
             try:
-                props = []
-                dtoks = list(st.toks)
-                for _ in range(k):
-                    with profiler.RecordEvent("decode::spec_draft"):
-                        fetches = draft._run(
-                            "prefill", draft._prefill_feeds(dtoks))
-                    nxt = int(np.argmax(
-                        np.asarray(fetches[0])[0, len(dtoks) - 1]))
-                    props.append(nxt)
-                    dtoks.append(nxt)
-                self._metrics.incr("spec_draft_steps", k)
+                props = None
+                if st.d_slot is not None and k > 0:
+                    props = self._draft_propose_kv(st, draft, k)
+                if props is None:
+                    props = []
+                    dtoks = list(st.toks)
+                    for _ in range(k):
+                        with profiler.RecordEvent("decode::spec_draft"):
+                            fetches = draft._run(
+                                "prefill", draft._prefill_feeds(dtoks))
+                        nxt = int(np.argmax(
+                            np.asarray(fetches[0])[0, len(dtoks) - 1]))
+                        props.append(nxt)
+                        dtoks.append(nxt)
+                    self._metrics.incr("spec_draft_steps", k)
+                else:
+                    dtoks = list(st.toks) + props
                 self._metrics.incr("spec_proposed_tokens", k)
                 t0 = time.perf_counter()
                 with profiler.RecordEvent("decode::spec_verify"):
@@ -710,8 +821,16 @@ class _ModelEntry:
             self._metrics.observe_prefill(time.perf_counter() - t0)
             logits = np.asarray(fetches[0])          # [1, L, V]
             finished = False
+            accepted_n = 0
             for j in range(k + 1):
-                t = int(np.argmax(logits[0, n - 1 + j]))
+                # COMMITTED COUPLING: the target always derives ITS OWN
+                # token from its (masked, sampled) committed stream at
+                # this position; a proposal is accepted iff it equals
+                # that token. The realized stream is therefore
+                # bit-identical to target-only decode in EVERY policy —
+                # greedy acceptance is the temperature-0 special case.
+                t = self._choose_token(st, logits[0, n - 1 + j],
+                                       device_masked=False)
                 st.generated.append(t)
                 st.toks.append(t)
                 st.last_token = t
@@ -719,6 +838,7 @@ class _ModelEntry:
                 self._metrics.tenant_incr("tokens", req.tenant)
                 if j < k and props[j] == t:
                     self._metrics.incr("spec_accepted_tokens")
+                    accepted_n += 1
                     accepted = True
                 else:
                     accepted = False
@@ -731,9 +851,201 @@ class _ModelEntry:
                     break   # t was the correction token: later positions
                             # saw the wrong draft prefix
             st.cursor = len(st.toks)
+            if st.d_slot is not None:
+                # roll the draft cursor back to the first position whose
+                # written KV row may disagree with the committed tokens
+                # (the rejected proposal's slot onward); the next
+                # cycle's catch-up rewrites from there
+                st.d_cursor = min(st.d_cursor, n + accepted_n)
             if finished:
                 self._retire(s)
         return progressed
+
+    # -- draft-KV speculative slots ---------------------------------------
+    def _admit_draft_kv(self, st, draft):
+        """Give a speculative slot its own KV slot + blocks on the DRAFT
+        entry and prefill the prompt into them ONCE; every later
+        proposal is then one [S,1] draft decode step instead of a
+        whole-prompt replay. Draft blocks are deliberately never
+        radix-registered: the draft arena shares no partial tails, so
+        the proposal hot path can never trigger a COW there. Any
+        failure falls back to replay proposals (counted), never fails
+        the request."""
+        if not draft._draft_ok or not draft._draft_pinned:
+            return
+        prompt = st.request.prompt
+        d_slot = None
+        blocks = None
+        try:
+            with draft._draft_lock:
+                d_slot = draft._pool.acquire()
+                if d_slot is None:
+                    self._metrics.incr("spec_draft_kv_fallbacks")
+                    return
+                blocks, _shared = draft._blocks.acquire_for_prompt(prompt)
+                if blocks is None:
+                    draft._pool.release(d_slot)
+                    self._metrics.incr("spec_draft_kv_fallbacks")
+                    return
+                with profiler.RecordEvent("decode::spec_draft_prefill"):
+                    fetches = draft._run("prefill",
+                                         draft._prefill_feeds(prompt))
+                kv_rows = [np.asarray(f) for f in fetches[1:]]
+                st.d_entry = draft
+                st.d_slot = d_slot
+                st.d_blocks = blocks
+                st.d_row_map = None
+                self._rebuild_draft_row_map(draft, st)
+                dm = draft.model
+                plen = len(prompt)
+                inj_rows = np.full((dm.max_len,), dm.rows, dtype="int64")
+                inj_rows[:plen] = st.d_row_map[:plen]
+                inj = {DecodeModel.INJ_ROWS: inj_rows}
+                for i, (kn, vn) in enumerate(dm.inject_kv_feeds):
+                    inj[kn] = kv_rows[2 * i]
+                    inj[vn] = kv_rows[2 * i + 1]
+                with profiler.RecordEvent("decode::spec_draft_inject"):
+                    draft._run("inject", inj)
+                st.d_cursor = plen
+                self._metrics.incr("spec_draft_kv_prefills")
+        except Exception:
+            # the inject is DONATED on the draft arena: poison the entry
+            # (all draft-KV users revert to replay) rather than trusting
+            # an undefined arena
+            draft._draft_ok = False
+            if st.d_entry is draft:
+                st.d_entry = None
+                st.d_slot = None
+                st.d_blocks = None
+                st.d_row_map = None
+                st.d_cursor = 0
+            if blocks is not None:
+                draft._blocks.release(blocks)
+            if d_slot is not None:
+                draft._pool.release(d_slot)
+            self._metrics.incr("spec_draft_kv_fallbacks")
+
+    def _rebuild_draft_row_map(self, draft, st):
+        dm = draft.model
+        bs = dm.block_size
+        if st.d_row_map is None:
+            st.d_row_map = np.zeros(dm.max_len, dtype="int64")
+        for i, b in enumerate(st.d_blocks):
+            lo = i * bs
+            hi = min(lo + bs, dm.max_len)
+            st.d_row_map[lo:hi] = b.row0 + np.arange(hi - lo)
+
+    def _release_draft(self, st):
+        """Return a spec slot's draft-side footprint (caller holds the
+        draft lock, or knows no other thread can touch this state)."""
+        draft = st.d_entry
+        if draft is None:
+            return
+        if st.d_blocks:
+            draft._blocks.release(st.d_blocks)
+        if st.d_slot is not None:
+            draft._pool.release(st.d_slot)
+        st.d_entry = None
+        st.d_slot = None
+        st.d_blocks = None
+        st.d_row_map = None
+        st.d_cursor = 0
+
+    def _release_draft_locked(self, st):
+        draft = st.d_entry
+        if draft is None:
+            return
+        with draft._draft_lock:
+            self._release_draft(st)
+
+    def _draft_propose_kv(self, st, draft, k):
+        """Greedy draft proposals in O(1) decode steps per token from
+        the draft's own arena slot. Catch-up first feeds every committed
+        token whose draft KV row is not yet written (at most the last
+        cycle's correction + bonus positions) — the final catch-up
+        step's logits ARE the first proposal — then each further
+        proposal is one more draft decode step. Returns the k proposals
+        (bit-identical to replay-prefill proposals by the decode ≡
+        prefill invariant applied to the draft entry), or None to make
+        the caller fall back to replay."""
+        if not draft._draft_ok:
+            self._release_draft_locked(st)
+            self._metrics.incr("spec_draft_kv_fallbacks")
+            return None
+        n = len(st.toks)
+        props = []
+        with draft._draft_lock:
+            cur = None
+            for p in range(min(st.d_cursor, n - 1), n):
+                cur = self._draft_step_kv(st, draft, st.toks[p], p,
+                                          write=p >= st.d_cursor)
+                if cur is None:
+                    return None
+                st.d_cursor = max(st.d_cursor, p + 1)
+            props.append(int(np.argmax(cur)))
+            for j in range(1, k):
+                cur = self._draft_step_kv(st, draft, props[j - 1],
+                                          n + j - 1, write=True)
+                if cur is None:
+                    return None
+                st.d_cursor = max(st.d_cursor, n + j)
+                props.append(int(np.argmax(cur)))
+        return props
+
+    def _draft_step_kv(self, st, draft, token, p, write):
+        """ONE draft decode step: feed ``token`` at position ``p`` into
+        the spec slot's draft arena slot (writing KV row p when asked;
+        rewriting an already-correct row is a byte-identical no-op) and
+        return the [V] logits row. Returns None after releasing the
+        draft footprint when the draft pool is exhausted or the draft
+        arena died — the caller reverts to replay proposals."""
+        dm = draft.model
+        if write:
+            blocks, _nb, cow = draft._blocks.ensure_appendable(
+                st.d_blocks, p)
+            if blocks is None:
+                self._release_draft(st)
+                self._metrics.incr("spec_draft_kv_fallbacks")
+                return None
+            assert cow is None, "draft blocks are never radix-shared"
+            st.d_blocks = blocks
+            if _nb is not None:
+                self._rebuild_draft_row_map(draft, st)
+        S, L, R = dm.slots, dm.max_len, dm.rows
+        tok = np.zeros((S, 1), "int64")
+        pos = np.zeros((S, 1), "int64")
+        bias = np.full((S, 1, L), NEG_INF, "float32")
+        rows = np.zeros((S, L), "int64")
+        wrows = np.full((S,), R, dtype="int64")
+        s = st.d_slot
+        tok[s, 0] = int(token)
+        pos[s, 0] = p
+        bias[s, 0, :p + 1] = 0.0
+        rows[s] = st.d_row_map
+        if write:
+            b = st.d_blocks[p // dm.block_size]
+            wrows[s] = b.row0 + p % dm.block_size
+        feeds = {DecodeModel.DEC_TOKEN: tok, DecodeModel.DEC_POSITION: pos,
+                 DecodeModel.DEC_BIAS: bias,
+                 DecodeModel.DEC_ROWS: rows.reshape(-1),
+                 DecodeModel.DEC_WRITE_ROWS: wrows}
+        if dm.logits_mask:
+            feeds[DecodeModel.DEC_MASK] = np.zeros(
+                (S, 1, dm.vocab_size), "float32")
+        try:
+            with profiler.RecordEvent("decode::spec_draft_kv"):
+                fetches = draft._run("step", feeds)
+        except Exception:
+            # donated call on the DRAFT arena failed: poison the draft
+            # for every user; this request reverts to replay proposals
+            draft._draft_ok = False
+            self._release_draft(st)
+            self._metrics.incr("spec_draft_kv_fallbacks")
+            return None
+        if write:
+            draft._blocks.note_append(st.d_blocks[p // dm.block_size])
+        self._metrics.incr("spec_draft_kv_steps")
+        return np.asarray(fetches[0])[s, 0]
 
     # -- the decode iteration ---------------------------------------------
     def _arena_lost(self, why):
@@ -743,11 +1055,25 @@ class _ModelEntry:
         self._probe_relaunched = False
         if self._breaker is not None:
             self._breaker_event(self._breaker.record_failure())
-        for s, st in enumerate(list(self._slots)):
-            if st is not None:
-                self._reject_in_flight(st.request, ReplicaLostError(
-                    f"request {st.request.id} lost to {why}"), slot=s)
+        self._reject_all_slots(lambda r: ReplicaLostError(
+            f"request {r.id} lost to {why}"))
         self._reset_arenas()
+
+    def _reject_all_slots(self, make_error):
+        """Fail every in-flight sequence loudly — ONE completion per
+        request, even when a beam request holds several slots."""
+        groups = []
+        for s, st in enumerate(list(self._slots)):
+            if st is None:
+                continue
+            if st.beam is not None:
+                if st.beam not in groups:
+                    groups.append(st.beam)
+                continue
+            self._reject_in_flight(st.request, make_error(st.request),
+                                   slot=s)
+        for g in groups:
+            self._reject_beam_group(g, make_error(g.request))
 
     def _apply_cow(self, st, cow):
         """Copy-on-write landed a fresh block: re-inject the shared
@@ -768,6 +1094,232 @@ class _ModelEntry:
             self._run("inject", inj)
         self._rebuild_row_map(st)
 
+    # -- generation policy (host-side selection over fetched logits) ------
+    def _choose_token(self, st, logits_row, device_masked):
+        """The ONE token-selection point for non-beam paths: grammar
+        mask (host-applied unless the decode program already added the
+        DEC_MASK feed — bit-identical either way, float32 add on both
+        sides), then the committed-stream sampler or plain argmax. The
+        step index is the absolute emitted-token index, so the sampled
+        stream replays bit-exactly for ANY admission order, batchmates,
+        or slot assignment."""
+        row = np.asarray(logits_row, dtype=np.float32).reshape(-1)
+        if st.grammar is not None and not device_masked:
+            row = row + st.grammar.mask()
+        if st.sampling is not None and not st.sampling.greedy:
+            faults.fire("decode.sample")
+            t = sample_token(row, st.sampling, len(st.generated))
+            self._metrics.incr("sampled_tokens")
+        else:
+            t = int(np.argmax(row))
+        if st.grammar is not None:
+            st.grammar.advance(t)
+            self._metrics.incr("grammar_steps")
+        return t
+
+    # -- beam search (COW forks over the block arena) ----------------------
+    def _begin_beam(self, s, logits_row):
+        """First selection of a freshly prefilled beam request: the seed
+        hypothesis (empty continuation, score 0) expands into up to
+        ``width`` live beams — the seed slot hosts the top survivor in
+        place, the rest fork from it."""
+        st = self._slots[s]
+        req = st.request
+        group = _BeamGroup(req)
+        st.beam = group
+        st.score = 0.0
+        if req.grammar is not None:
+            st.grammar = GrammarConstraint(req.grammar)
+        group.order = [s]
+        # claim the rest of the group's row reservation up front (the
+        # admission round budgeted width rows for this pick)
+        for _ in range(group.width - 1):
+            sid = self._pool.acquire()
+            if sid is None:
+                break
+            group.spare.append(sid)
+        self._metrics.incr("beam_requests")
+        try:
+            row = np.asarray(logits_row, dtype=np.float32).reshape(-1)
+            if st.grammar is not None:
+                row = row + st.grammar.mask()
+            self._commit_beam_selection(group, [row])
+        except _ArenaInvalidError:
+            raise               # admission's arena handler owns cleanup
+        except Exception as e:
+            self._reject_beam_group(group, RequestError(
+                f"request {req.id} failed in first beam selection: {e}"))
+
+    def _commit_beam_selection(self, group, rows):
+        """ONE beam step's bookkeeping: run the committed selection rule
+        over the live hypotheses' (masked) logits rows, divert EOS and
+        length-exhausted continuations to ``finished``, release pruned
+        parents, keep each parent's top continuation in its slot, fork
+        the rest (refcount++ + private tail copy), and re-assert block
+        row conservation. Returns False when the group retired or
+        failed (its slots are gone)."""
+        m = self._model
+        req = group.request
+        live_ids = list(group.order)
+        live = [self._slots[s] for s in live_ids]
+        room = group.width - len(group.finished)
+        sel_live, sel_fin = beam_select(
+            [b.score for b in live], rows, room, m.eos_id)
+        for p, t, sc in sel_fin:
+            group.finished.append((live[p].generated + [t], sc))
+        survivors = []
+        for p, t, sc in sel_live:
+            n2 = len(live[p].generated) + 1
+            if n2 >= req.max_new or live[p].plen + n2 >= m.max_len:
+                group.finished.append((live[p].generated + [t], sc))
+            else:
+                survivors.append((p, t, sc))
+        keep = {p for p, _t, _s in survivors}
+        for i, sid in enumerate(live_ids):
+            if i not in keep:
+                self._release_beam_slot(sid, to_spare=True)
+                self._metrics.incr("beam_prunes")
+        # slot assignment preserves RANK order in group.order; children
+        # fork BEFORE their parent's in-place update (deferred) so every
+        # fork sees the parent's pre-step tokens/grammar/score
+        new_order = []
+        taken = set()
+        deferred = []
+        for p, t, sc in survivors:
+            if p not in taken:
+                taken.add(p)
+                new_order.append(live_ids[p])
+                deferred.append((live[p], t, sc))
+            else:
+                try:
+                    child = self._fork_beam(group, live[p], t, sc)
+                except _ArenaInvalidError:
+                    raise
+                except Exception as e:
+                    self._reject_beam_group(group, RequestError(
+                        f"request {req.id} beam fork failed: {e}"))
+                    return False
+                new_order.append(child)
+                self._metrics.incr("beam_forks")
+        for st, t, sc in deferred:
+            st.generated = st.generated + [t]
+            st.last_token = t
+            st.score = sc
+            if st.grammar is not None:
+                st.grammar.advance(t)
+        group.order = new_order
+        self._blocks.check_conservation()
+        if len(group.finished) >= group.width or not new_order:
+            self._retire_beam(group)
+            return False
+        return True
+
+    def _fork_beam(self, group, parent, token, score):
+        """COW-fork one live hypothesis: second owner on the parent's
+        full blocks, a private tail block filled by a device row copy
+        (arena scope read -> inject), and a fresh slot carrying the
+        forked host state."""
+        m = self._model
+        child_blocks, nb, src = self._blocks.fork_blocks(
+            parent.blocks, parent.cursor)
+        if child_blocks is None:
+            raise RuntimeError("block pool exhausted forking a beam")
+        slot = group.spare.pop() if group.spare else self._pool.acquire()
+        if slot is None:
+            self._blocks.release(child_blocks)
+            raise RuntimeError("slot pool exhausted forking a beam")
+        if nb is not None:
+            u = nb.size_used
+            inj_rows = np.full((m.max_len,), m.rows, dtype="int64")
+            inj_rows[:u] = nb.row0 + np.arange(u)
+            inj = {DecodeModel.INJ_ROWS: inj_rows}
+            for i, (kn_s, vn_s) in enumerate(m.state_names):
+                kn, vn = m.inject_kv_feeds[i]
+                karr = np.zeros((1, m.max_len, m.hidden), "float32")
+                varr = np.zeros((1, m.max_len, m.hidden), "float32")
+                karr[0, :u] = np.asarray(
+                    self._scope.find_var(kn_s))[src.row0:src.row0 + u]
+                varr[0, :u] = np.asarray(
+                    self._scope.find_var(vn_s))[src.row0:src.row0 + u]
+                inj[kn] = karr
+                inj[vn] = varr
+            try:
+                with profiler.RecordEvent("decode::beam_fork_inject"):
+                    self._run("inject", inj)
+            except Exception as e:
+                raise _ArenaInvalidError(str(e)) from e
+        st = _Slot(group.request, mode="beam")
+        st.beam = group
+        st.blocks = child_blocks
+        st.plen = parent.plen
+        st.shared_len = parent.shared_len
+        st.cursor = parent.cursor
+        st.last_token = int(token)
+        st.generated = parent.generated + [int(token)]
+        st.score = score
+        if parent.grammar is not None:
+            st.grammar = parent.grammar.fork().advance(token)
+        self._rebuild_row_map(st)
+        self._slots[slot] = st
+        return slot
+
+    def _release_beam_slot(self, sid, to_spare=False):
+        st = self._slots[sid]
+        self._slots[sid] = None
+        if to_spare and st is not None and st.beam is not None:
+            st.beam.spare.append(sid)   # keep the group's reservation
+        else:
+            self._pool.release(sid)
+        if st.blocks:
+            self._blocks.release(st.blocks)
+
+    def _release_group_slots(self, group):
+        for sid, st in enumerate(self._slots):
+            if st is not None and st.beam is group:
+                self._release_beam_slot(sid)
+        for sid in group.spare:
+            self._pool.release(sid)
+        group.spare = []
+
+    def _retire_beam(self, group):
+        self._release_group_slots(group)
+        req = group.request
+        self._engine._tenant_unflight(req.tenant)
+        ranked = beam_finished_ranking(group.finished)
+        if not ranked:
+            req.response._complete(error=RequestError(
+                f"request {req.id}: beam search finished no hypothesis"))
+            self._metrics.incr("failed")
+            self._metrics.observe_request(req)
+            return
+        req.response._complete(outputs={
+            "tokens": np.asarray(ranked[0][0], dtype="int64"),
+            "beams": [{"tokens": np.asarray(t, dtype="int64"),
+                       "score": float(sc)} for t, sc in ranked],
+        })
+        self._metrics.incr("completed")
+        self._metrics.incr("retired")
+        self._metrics.incr("beam_finished", len(ranked))
+        self._metrics.tenant_incr("completed", req.tenant)
+        self._metrics.observe_request(req)
+
+    def _reject_beam_group(self, group, error):
+        """Fail one beam request as a UNIT: release every slot the group
+        still holds, then complete its single response once. (The
+        arena-failure path may already have completed it through the
+        admitting request's handler — the done() guard keeps the
+        write-once future honest.)"""
+        self._release_group_slots(group)
+        req = group.request
+        if req.response.done():
+            return
+        self._engine._tenant_unflight(req.tenant)
+        self._metrics.incr(
+            "deadline_missed" if isinstance(error, DeadlineExceededError)
+            else "failed")
+        req.response._complete(error=error)
+        self._metrics.observe_request(req)
+
     def _step(self):
         m = self._model
         S, L, R = m.slots, m.max_len, m.rows
@@ -776,10 +1328,13 @@ class _ModelEntry:
         bias = np.full((S, 1, L), NEG_INF, "float32")
         rows = np.zeros((S, L), "int64")
         wrows = np.full((S,), R, dtype="int64")
+        dmask = (np.zeros((S, 1, m.vocab_size), "float32")
+                 if m.logits_mask else None)
         active = []
+        groups = []     # beam groups with a live slot this step
         for s in range(S):
             st = self._slots[s]
-            if st is None or st.mode != "decode":
+            if st is None or st.mode not in ("decode", "beam"):
                 continue
             # make the cursor position writable: allocate a fresh block
             # when it opens a new chunk, COW when it lands in a SHARED
@@ -791,14 +1346,22 @@ class _ModelEntry:
             except RuntimeError as e:
                 # pool invariant violation: loud per-request failure,
                 # never a dead scheduler thread
-                self._reject_in_flight(st.request, RequestError(
-                    f"request {st.request.id} failed: {e}"), slot=s)
+                if st.mode == "beam":
+                    self._reject_beam_group(st.beam, RequestError(
+                        f"request {st.request.id} failed: {e}"))
+                else:
+                    self._reject_in_flight(st.request, RequestError(
+                        f"request {st.request.id} failed: {e}"), slot=s)
                 continue
             if blocks is None:
                 self._metrics.incr("blocks_exhausted")
-                self._reject_in_flight(st.request, RequestError(
+                err = RequestError(
                     f"request {st.request.id} failed: block pool "
-                    "exhausted mid-generation"), slot=s)
+                    "exhausted mid-generation")
+                if st.mode == "beam":
+                    self._reject_beam_group(st.beam, err)
+                else:
+                    self._reject_in_flight(st.request, err, slot=s)
                 continue
             st.blocks = blocks
             if cow is not None:
@@ -811,24 +1374,33 @@ class _ModelEntry:
                     return
             elif _nb is not None:
                 self._rebuild_row_map(st)
-            active.append(s)
+            if st.mode == "beam":
+                if st.beam not in groups:
+                    groups.append(st.beam)
+            else:
+                active.append(s)
             tok[s, 0] = st.last_token
             pos[s, 0] = st.cursor
             bias[s, 0, :st.cursor + 1] = 0.0
             rows[s] = st.row_map
             wrows[s] = self._row_of(st, st.cursor)
-        if not active:
+            if dmask is not None and st.grammar is not None:
+                # the grammar's next-token constraint rides in as DATA —
+                # same compiled program for every request, zero retraces
+                dmask[s, 0] = st.grammar.mask()
+        if not active and not groups:
             return
+        feeds = {DecodeModel.DEC_TOKEN: tok, DecodeModel.DEC_POSITION: pos,
+                 DecodeModel.DEC_BIAS: bias,
+                 DecodeModel.DEC_ROWS: rows.reshape(-1),
+                 DecodeModel.DEC_WRITE_ROWS: wrows}
+        if dmask is not None:
+            feeds[DecodeModel.DEC_MASK] = dmask
         t0 = time.perf_counter()
         try:
             with profiler.RecordEvent("decode::step"):
                 faults.fire("decode.step")
-                fetches = self._run("step", {
-                    DecodeModel.DEC_TOKEN: tok, DecodeModel.DEC_POSITION: pos,
-                    DecodeModel.DEC_BIAS: bias,
-                    DecodeModel.DEC_ROWS: rows.reshape(-1),
-                    DecodeModel.DEC_WRITE_ROWS: wrows,
-                })
+                fetches = self._run("step", feeds)
         except Exception as e:
             # a failed donated call leaves the arena undefined: every
             # in-flight sequence is lost (failed loudly), the batch-level
@@ -839,11 +1411,13 @@ class _ModelEntry:
             self._breaker_event(self._breaker.record_success())
         logits = np.asarray(fetches[0])              # [S, 1, V]
         now = time.perf_counter()
+        stepped = len(active)
         for s in active:
             st = self._slots[s]
             self._blocks.note_append(
                 st.blocks[st.cursor // m.block_size])
-            nxt = int(np.argmax(logits[s, 0]))
+            nxt = self._choose_token(st, logits[s, 0],
+                                     device_masked=m.logits_mask)
             st.generated.append(nxt)
             st.cursor += 1
             st.last_token = nxt
@@ -857,7 +1431,34 @@ class _ModelEntry:
                 self._reject_in_flight(st.request, DeadlineExceededError(
                     "deadline expired mid-generation after "
                     f"{len(st.generated)} tokens"), slot=s)
-        self._metrics.observe_step(len(active), len(active),
+        for group in groups:
+            if group.request.response.done():
+                continue    # rejected while another slot was being fed
+            # commit this step's KV append per live hypothesis, collect
+            # its (device-masked) logits row in HYPOTHESIS order, then
+            # run the shared selection rule once for the whole group
+            rows_l = []
+            for sid in group.order:
+                bst = self._slots[sid]
+                self._blocks.note_append(
+                    bst.blocks[bst.cursor // m.block_size])
+                bst.cursor += 1
+                row = np.asarray(logits[sid, 0],
+                                 dtype=np.float32).reshape(-1)
+                if bst.grammar is not None and dmask is None:
+                    row = row + bst.grammar.mask()
+                rows_l.append(row)
+            stepped += len(rows_l)
+            try:
+                alive = self._commit_beam_selection(group, rows_l)
+            except _ArenaInvalidError as e:
+                self._arena_lost(f"beam fork inject failure: {e}")
+                return
+            if alive and group.request.expired(now):
+                self._reject_beam_group(group, DeadlineExceededError(
+                    "deadline expired mid-generation after "
+                    f"{len(group.finished)} finished hypotheses"))
+        self._metrics.observe_step(stepped, stepped,
                                    time.perf_counter() - t0)
 
     def _finished(self, st):
@@ -872,6 +1473,7 @@ class _ModelEntry:
         self._pool.release(slot)
         if st.blocks:
             self._blocks.release(st.blocks)
+        self._release_draft_locked(st)
         req = st.request
         self._engine._tenant_unflight(req.tenant)
         req.response._complete(outputs={
@@ -889,6 +1491,8 @@ class _ModelEntry:
             self._pool.release(slot)
             if st is not None and st.blocks:
                 self._blocks.release(st.blocks)
+            if st is not None:
+                self._release_draft_locked(st)
         self._engine._tenant_unflight(req.tenant)
         self._metrics.incr(
             "deadline_missed" if isinstance(error, DeadlineExceededError)
@@ -897,19 +1501,30 @@ class _ModelEntry:
         self._metrics.observe_request(req)
 
     # -- reference path ----------------------------------------------------
-    def offline_decode(self, prompt, max_new):
+    def offline_decode(self, prompt, max_new, sampling=None, grammar=None):
         """Offline whole-sequence reference: re-run the full causal
         prefill forward per generated token (no KV cache, no slots) with
-        identical finish rules. The bit-exactness tests compare
-        continuous output — in EVERY mode (paged decode, chunked
-        prefill, speculative) — against THIS."""
+        identical finish rules and the SAME committed selection policy
+        (host-masked grammar + committed-stream sampling). The
+        bit-exactness tests compare continuous output — in EVERY mode
+        (paged decode, chunked prefill, speculative, sampled,
+        constrained) — against THIS."""
         m = self._model
         toks = list(prompt)
         out = []
+        g = GrammarConstraint(grammar) if grammar is not None else None
         for _ in range(int(max_new)):
             t = len(toks) - 1
             fetches = self._run("prefill", self._prefill_feeds(toks))
-            nxt = int(np.argmax(np.asarray(fetches[0])[0, t]))
+            row = np.asarray(fetches[0])[0, t].astype(np.float32)
+            if g is not None:
+                row = row + g.mask()
+            if sampling is not None and not sampling.greedy:
+                nxt = sample_token(row, sampling, len(out))
+            else:
+                nxt = int(np.argmax(row))
+            if g is not None:
+                g.advance(nxt)
             out.append(nxt)
             toks.append(nxt)
             if m.eos_id is not None and nxt == m.eos_id:
@@ -917,6 +1532,21 @@ class _ModelEntry:
             if len(toks) >= m.max_len:
                 break
         return out
+
+    def offline_beam(self, prompt, max_new, params, grammar=None):
+        """Offline beam reference: ``generate.offline_beam_decode`` with
+        this entry's prefill forward as the whole-sequence logits
+        oracle. The engine's slot-based incremental beam is bit-compared
+        against this by tests and GEN_EVIDENCE_r17."""
+        m = self._model
+
+        def logits_fn(tokens):
+            fetches = self._run("prefill", self._prefill_feeds(tokens))
+            return np.asarray(fetches[0])[0, len(tokens) - 1]
+
+        g = GrammarConstraint(grammar) if grammar is not None else None
+        return offline_beam_decode(logits_fn, prompt, int(max_new), params,
+                                   m.eos_id, m.max_len, grammar=g)
 
     # -- observability ----------------------------------------------------
     def stats(self):
@@ -942,6 +1572,10 @@ class _ModelEntry:
             "spec_acceptance_rate": (
                 self._metrics.count("spec_accepted_tokens") / spec_p
                 if spec_p else None),
+            "spec_draft_kv_steps_per_token": (
+                self._metrics.count("spec_draft_kv_steps") / spec_e
+                if spec_e else None),
+            "draft_pinned": self._draft_pinned,
             "prefix_cache_entries": len(self._prefix),
             "prefix_hits": self._prefix.hits,
             "prefix_misses": self._prefix.misses,
@@ -1147,12 +1781,16 @@ class GenerationEngine:
             st = self._tenant(tenant)
             st.in_flight = max(st.in_flight - 1, 0)
 
-    def _pick(self, queue):
+    def _pick(self, queue, max_rows=None):
         """Weighted-fair pick (caller holds queue.lock): first non-empty
         priority lane wins (strict priority), then the lane's queued
         tenant with the smallest virtual time, skipping tenants at their
         in-flight cap. The winner's FIRST queued request dispatches
-        (per-tenant FIFO) and the tenant pays 1/weight virtual time."""
+        (per-tenant FIFO) and the tenant pays 1/weight virtual time.
+        ``max_rows`` is the admission round's remaining slot budget: a
+        tenant whose head request needs more rows (a beam) is skipped
+        for the round — head-of-line within the tenant is deliberate,
+        per-tenant FIFO is the ordering contract."""
         with self._tenant_lock:
             for lane in Priority.LANES:
                 requests = queue.lane(lane)
@@ -1167,7 +1805,14 @@ class GenerationEngine:
                     if (st.max_in_flight is not None
                             and st.in_flight >= st.max_in_flight):
                         continue
+                    if max_rows is not None and r.rows > max_rows:
+                        # not enough free slots THIS round for the
+                        # tenant's head request; its turn comes back
+                        candidates[r.tenant] = None
+                        continue
                     candidates[r.tenant] = (st, r)
+                candidates = {t: c for t, c in candidates.items()
+                              if c is not None}
                 if not candidates:
                     continue  # every queued tenant here is capped
                 for tenant, (st, r) in candidates.items():
@@ -1219,7 +1864,8 @@ class GenerationEngine:
     def submit(self, prompt_ids, model=None, version=None, tenant="default",
                priority=Priority.NORMAL, max_new_tokens=16,
                deadline_ms=None, deadline_at=None, draft_model=None,
-               draft_version=None, spec_k=4):
+               draft_version=None, spec_k=4, sampling=None,
+               beam_width=None, grammar=None, draft_kv=True):
         """Admit one generation request; returns its Response future
         (``result()`` -> ``{"tokens": int64 array}``). Raises structured
         RejectedError on invalid prompts, over-quota tenants, or a full
@@ -1230,16 +1876,67 @@ class GenerationEngine:
         budget — the fleet router's at-most-once-visible failover
         depends on this. ``draft_model`` (+ optional ``draft_version``)
         opts into speculative decoding: the draft must be a hosted
-        registry entry sharing the target's vocabulary; greedy
-        acceptance keeps the output bit-identical to non-speculative
-        decode."""
+        registry entry sharing the target's vocabulary; committed-
+        coupling acceptance keeps the output bit-identical to
+        non-speculative decode (greedy acceptance is its temperature-0
+        case). ``draft_kv`` (default on) gives the proposals their own
+        KV slot on the draft entry — O(1) draft work per token — when
+        the draft entry can be PINNED (no primary traffic); otherwise
+        the request silently uses replay proposals.
+
+        Generation modes (r17): ``sampling`` — a SamplingParams (or
+        kwargs dict) selecting temperature/top-k/top-p on the
+        per-request committed threefry stream; ``beam_width`` — beam
+        search over N slot-hypotheses (deterministic; exclusive with
+        sampling and speculation); ``grammar`` — a CompiledGrammar
+        whose per-step masks constrain output (requires a model built
+        with ``logits_mask=True`` except on the speculative path, which
+        masks host-side)."""
         entry = self._resolve(model, version)
         m = entry.model
         tenant = str(tenant)
         entry.metrics.incr("submitted")
         entry.metrics.tenant_incr("submitted", tenant)
         self._validate(m, prompt_ids, max_new_tokens, priority, entry)
+        if isinstance(sampling, dict):
+            sampling = SamplingParams(**sampling)
+        if sampling is not None and not isinstance(sampling, SamplingParams):
+            self._bad(entry, "sampling must be a SamplingParams or dict")
+        beam = None
+        if beam_width is not None:
+            beam = BeamParams(beam_width)
+            if beam.width > m.slots:
+                self._bad(entry,
+                          f"beam width {beam.width} exceeds the entry's "
+                          f"{m.slots} batch slots")
+            if sampling is not None and not sampling.greedy:
+                self._bad(entry, "beam search is deterministic; it does "
+                                 "not compose with sampling")
+            if draft_model is not None:
+                self._bad(entry, "beam search does not compose with "
+                                 "speculative decoding")
+        if grammar is not None:
+            if not isinstance(grammar, CompiledGrammar):
+                self._bad(entry, "grammar must be a CompiledGrammar")
+            if m.eos_id is None:
+                self._bad(entry, "grammar-constrained decode needs a "
+                                 "model with an eos_id")
+            if grammar.eos_id != m.eos_id:
+                self._bad(entry,
+                          f"grammar eos_id {grammar.eos_id} != model "
+                          f"eos_id {m.eos_id}")
+            if len(grammar.vocab) != m.vocab_size:
+                self._bad(entry,
+                          f"grammar vocab size {len(grammar.vocab)} != "
+                          f"model vocab {m.vocab_size}")
+            if draft_model is None and not m.logits_mask:
+                self._bad(entry,
+                          "grammar-constrained decode needs a model "
+                          "built with logits_mask=True (the DEC_MASK "
+                          "feed); only the speculative path masks "
+                          "host-side")
         draft_key = None
+        draft_kv = bool(draft_kv)
         if draft_model is not None:
             draft_entry = self._resolve(draft_model, draft_version)
             dm = draft_entry.model
@@ -1257,6 +1954,23 @@ class GenerationEngine:
             if int(spec_k) < 1:
                 self._bad(entry, f"spec_k must be >= 1, got {spec_k}")
             draft_key = dm.key
+            if draft_kv:
+                # pin the draft: draft-KV decode/inject calls DONATE the
+                # draft arena, so the draft entry must carry no primary
+                # traffic. Pinning is best-effort at admission (a request
+                # picked but not yet slotted can slip the busy check);
+                # production deployments dedicate the draft entry by
+                # configuration, and the per-call _draft_lock serializes
+                # every spec user either way.
+                with draft_entry._cond:
+                    busy = (not draft_entry._queue.empty()
+                            or draft_entry._pool.active_count > 0)
+                    if busy and not draft_entry._draft_pinned:
+                        draft_kv = False    # replay fallback, this request
+                    else:
+                        draft_entry._draft_pinned = True
+        else:
+            draft_kv = False
         with self._tenant_lock:
             st = self._tenant(tenant)
             over_quota = (st.max_queued is not None
@@ -1287,7 +2001,19 @@ class GenerationEngine:
             rid = self._next_id
         req = GenerationRequest(rid, prompt_ids, max_new_tokens, tenant,
                                 priority, deadline, draft_key=draft_key,
-                                spec_k=spec_k)
+                                spec_k=spec_k, sampling=sampling, beam=beam,
+                                grammar=grammar, draft_kv=draft_kv)
+        with entry._cond:
+            pinned = entry._draft_pinned
+        if pinned:
+            # a pinned draft entry serves speculative proposals through
+            # donated arena calls — concurrent primary traffic would
+            # corrupt them. Reject before enqueue (best-effort, like the
+            # pinning busy-check itself: dedicating the draft entry by
+            # configuration is the production posture).
+            self._tenant_unqueue(tenant)
+            self._bad(entry, "entry is pinned as a draft-KV proposal "
+                             "server; submit primary traffic elsewhere")
         try:
             with entry._cond:
                 entry._queue.put(req)

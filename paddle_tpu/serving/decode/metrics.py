@@ -32,6 +32,15 @@ class DecodeMetrics(ServingMetrics):
         # is the headline ratio; accepted/proposed is the acceptance rate
         "spec_target_steps", "spec_draft_steps", "spec_proposed_tokens",
         "spec_accepted_tokens", "spec_emitted_tokens",
+        # draft-KV speculative slots (r17): O(1)-per-token proposals from
+        # the draft entry's own paged arena; fallbacks count reversion to
+        # whole-prompt replay proposals (resource exhaustion / poisoning)
+        "spec_draft_kv_steps", "spec_draft_kv_prefills",
+        "spec_draft_kv_fallbacks",
+        # generation modes (r17): committed-stream sampling, grammar
+        # mask steps, and beam lifecycle events
+        "sampled_tokens", "grammar_steps", "beam_requests", "beam_forks",
+        "beam_prunes", "beam_finished",
         # circuit breaker relaunch (AOT-warmed replacement replicas)
         "relaunches",
     )

@@ -71,6 +71,7 @@ class DecodeModel:
     DEC_BIAS = "dec_bias"
     DEC_ROWS = "dec_rows"
     DEC_WRITE_ROWS = "dec_write_rows"
+    DEC_MASK = "dec_mask"
     PRE_TOKENS = "pre_tokens"
     PRE_POSITIONS = "pre_positions"
     PRE_BIAS = "pre_bias"
@@ -87,7 +88,7 @@ class DecodeModel:
                  prefill_kv_fetches, inject_kv_feeds, block_size,
                  num_blocks, chunk_program=None, chunk_tokens=None,
                  chunk_logits_fetch=None, eos_id=None, name="model",
-                 version="1", builder=None):
+                 version="1", builder=None, logits_mask=False):
         self.decode_program = decode_program
         self.prefill_program = prefill_program
         self.inject_program = inject_program
@@ -110,6 +111,7 @@ class DecodeModel:
         self.name = str(name)
         self.version = str(version)
         self.builder = builder
+        self.logits_mask = bool(logits_mask)
 
     @property
     def key(self):
@@ -143,13 +145,19 @@ class DecodeModel:
     # -- feed signatures (ordered like each program's feed list) ---------
     def decode_feed_sig(self):
         s, l = self.slots, self.max_len
-        return (
+        sig = [
             (self.DEC_TOKEN, (s, 1), "int64"),
             (self.DEC_POSITION, (s, 1), "int64"),
             (self.DEC_BIAS, (s, 1, l), "float32"),
             (self.DEC_ROWS, (s * l,), "int64"),
             (self.DEC_WRITE_ROWS, (s,), "int64"),
-        )
+        ]
+        if self.logits_mask:
+            # grammar-constrained decode: per-step [S, 1, V] additive
+            # logits mask, fed as DATA (zeros when no grammar is active
+            # — IEEE x + 0.0 == x keeps unconstrained slots bit-exact)
+            sig.append((self.DEC_MASK, (s, 1, self.vocab_size), "float32"))
+        return tuple(sig)
 
     def prefill_feed_sig(self):
         l = self.max_len
@@ -202,7 +210,8 @@ def _state_var(main_program, startup_program, name, shape):
 def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
                         slots=4, max_len=32, eos_id=None, name="decoder",
                         version="1", block_size=None, num_blocks=None,
-                        chunk_tokens=None, fused_attention=True):
+                        chunk_tokens=None, fused_attention=True,
+                        logits_mask=False):
     """Build the canonical cached-attention decoder as a paged
     DecodeModel.
 
@@ -219,6 +228,15 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
     so by default nothing can run out of blocks — size it DOWN (with
     the analysis/memory.py gate) to get the paged memory win.
     ``chunk_tokens`` >= 2 additionally builds the chunk-prefill program.
+
+    ``logits_mask`` (default False — opt-in so pre-r17 program
+    structures and their committed evidence stay byte-reproducible)
+    adds a fixed-shape ``[S, 1, V]`` additive mask feed applied to the
+    decode step's logits (``layers.logits_mask_add``): the
+    grammar-constrained decode contract. Per-step masks enter as data —
+    the compiled shape never changes, so constrained decode cannot
+    retrace; an all-zeros mask is a bit-exact no-op for every
+    unconstrained slot.
 
     ``fused_attention`` (default True) routes the decode step's
     attention through ONE ``paged_attention`` op — the row-index feeds
@@ -310,6 +328,8 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
         bias = fluid.data(DecodeModel.DEC_BIAS, [S, 1, L], dtype="float32")
         rows = fluid.data(DecodeModel.DEC_ROWS, [S * L], dtype="int64")
         wrows = fluid.data(DecodeModel.DEC_WRITE_ROWS, [S], dtype="int64")
+        lmask = (fluid.data(DecodeModel.DEC_MASK, [S, 1, V],
+                            dtype="float32") if logits_mask else None)
         h = embed(tok, pos)
         for i in range(NL):
             kc = _state_var(decode, startup, state_names[i][0], [R, H])
@@ -339,6 +359,8 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
             h = fluid.layers.elementwise_add(h, proj(ctx, H, f"l{i}.out"))
             h = ffn_block(h, i)
         dec_logits = proj(h, V, "head")
+        if lmask is not None:
+            dec_logits = fluid.layers.logits_mask_add(dec_logits, lmask)
 
     # -- inject: scatter prefill rows into arbitrary arena rows ----------
     inject = Program()
@@ -405,7 +427,8 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
     kwargs = dict(vocab_size=V, hidden=H, num_layers=NL, ffn_dim=FFN,
                   slots=S, max_len=L, eos_id=eos_id, name=name,
                   version=version, block_size=BS, num_blocks=NB,
-                  chunk_tokens=C, fused_attention=fused_attention)
+                  chunk_tokens=C, fused_attention=fused_attention,
+                  logits_mask=logits_mask)
     return DecodeModel(
         decode_program=decode, prefill_program=prefill,
         inject_program=inject, chunk_program=chunk,
@@ -418,4 +441,5 @@ def build_decoder_model(vocab_size, hidden=16, num_layers=2, ffn_dim=None,
         prefill_kv_fetches=kv_fetches, inject_kv_feeds=inj_feeds,
         eos_id=eos_id, name=name, version=version,
         builder=lambda: build_decoder_model(**kwargs),
+        logits_mask=logits_mask,
     )

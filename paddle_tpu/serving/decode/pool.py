@@ -321,6 +321,7 @@ class BlockPool:
         self.cow_copies = 0
         self.evictions = 0
         self.radix_hits = 0            # shared-block references served
+        self.forks = 0                 # beam forks served (refcount++ paths)
 
     @property
     def rows(self):
@@ -474,6 +475,39 @@ class BlockPool:
         with self._lock:
             block.size_used = min(block.size_used + 1, self.block_size)
 
+    def fork_blocks(self, blocks, written):
+        """Beam fork: a second owner for the first ``written`` positions
+        of ``blocks``. Full covered blocks are SHARED (refcount++ — they
+        are immutable history for both beams; appends can never land in
+        them because the cursor is past their last offset), and a
+        partial tail gets a fresh PRIVATE block the caller must fill by
+        copying the parent's ``written % block_size`` device rows (the
+        engine reads them out of the arena scope and re-injects).
+
+        Returns ``(child_blocks, new_tail, src_tail)`` — ``new_tail`` /
+        ``src_tail`` are None when ``written`` is block-aligned — or
+        ``(None, None, None)`` when the pool is exhausted."""
+        bs = self.block_size
+        full = int(written) // bs
+        tail_used = int(written) % bs
+        with self._lock:
+            child = list(blocks[:full])
+            nb = None
+            src = None
+            if tail_used:
+                src = blocks[full]
+                nb = self._alloc_locked()
+                if nb is None:
+                    return None, None, None
+                nb.size_used = tail_used
+                nb.tokens = src.tokens
+            for b in child:
+                b.refcount += 1
+            self.forks += 1
+            if nb is not None:
+                child.append(nb)
+            return child, nb, src
+
     def release(self, blocks):
         """Drop one owner's references. Registered refcount-0 blocks
         stay cached (LRU) for future prefix hits; private ones free."""
@@ -500,6 +534,32 @@ class BlockPool:
             self._free = list(range(self.num_blocks - 1, -1, -1))
             self._cached.clear()
 
+    def check_conservation(self):
+        """The row-conservation invariant, assertable after every beam
+        fork/prune: each block is in EXACTLY ONE of {free list, LRU
+        cache, live (refcount > 0)}, the three counts sum to the pool
+        size, and no refcount is negative. Raises AssertionError naming
+        the violation; returns the three counts when clean."""
+        with self._lock:
+            free = set(self._free)
+            cached = set(self._cached)
+            live = {b.id for b in self._blocks if b.refcount > 0}
+            neg = [b.id for b in self._blocks if b.refcount < 0]
+            assert not neg, f"negative refcount on blocks {neg}"
+            assert not (free & cached), (
+                f"blocks both free and cached: {sorted(free & cached)}")
+            assert not (free & live), (
+                f"blocks both free and live: {sorted(free & live)}")
+            assert not (cached & live), (
+                f"blocks both cached and live: {sorted(cached & live)}")
+            total = len(free) + len(cached) + len(live)
+            assert total == self.num_blocks, (
+                f"row conservation broken: {len(free)} free + "
+                f"{len(cached)} cached + {len(live)} live != "
+                f"{self.num_blocks} total")
+            return {"blocks_free": len(free), "blocks_cached": len(cached),
+                    "blocks_live": len(live)}
+
     # -- observability -----------------------------------------------------
     def stats(self):
         with self._lock:
@@ -521,6 +581,7 @@ class BlockPool:
                 "occupancy": physical / float(max(self.rows, 1)),
                 "dedup_ratio": logical / float(max(physical, 1)),
                 "cow_copies": self.cow_copies,
+                "forks": self.forks,
                 "evictions": self.evictions,
                 "radix_hits": self.radix_hits,
                 "radix_entries": len(self._radix),

@@ -689,19 +689,21 @@ def test_fresh_process_restores_all_executables_with_zero_compiles(tmp_path):
 
 
 def test_bench_decode_smoke_cli():
-    """tools/bench_serving.py --decode --paged --spec --smoke is the
-    tier-1 CI hook: open-loop mixed-length workload asserting
-    continuous-vs-offline bit-identity for EVERY request in EVERY mode
-    (paged block-size sweep, speculative leg), zero retraces after
-    warmup, occupancy > 1.5x the request-at-a-time baseline, radix
-    dedup > 1 on the share-heavy paged leg, and speculative
-    steps-per-token < 1."""
+    """tools/bench_serving.py --decode --paged --spec --sample --beam
+    --smoke is the tier-1 CI hook: open-loop mixed-length workload
+    asserting continuous-vs-offline bit-identity for EVERY request in
+    EVERY mode (paged block-size sweep, speculative leg, committed-
+    sampling replay under two shuffled admission orders, COW beam
+    search), zero retraces after warmup, occupancy > 1.5x the
+    request-at-a-time baseline, radix dedup > 1 on the share-heavy
+    paged leg, speculative steps-per-token < 1, and block-pool
+    conservation across beam fork/prune."""
     env = dict(os.environ)
     env["PADDLE_TPU_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
-         "--decode", "--paged", "--spec", "--smoke"],
+         "--decode", "--paged", "--spec", "--sample", "--beam", "--smoke"],
         capture_output=True, text=True, timeout=560, env=env,
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -714,6 +716,11 @@ def test_bench_decode_smoke_cli():
     paged = extra["paged"]["sweep"]
     assert any(leg["peak_dedup_ratio"] > 1.0 for leg in paged)
     assert all(leg["offline_mismatches"] == 0 for leg in paged)
+    assert extra["sample"]["bit_identical"]
+    assert extra["sample"]["retraces"] == 0
+    assert extra["beam"]["tokens_bit_identical"]
+    assert extra["beam"]["conservation_ok"]
+    assert extra["beam"]["beam_forks"] > 0
     assert extra["spec"]["steps_per_token"] < 1.0
     assert extra["spec"]["offline_mismatches"] == 0
     assert extra["spec"]["retraces"] == 0

@@ -22,20 +22,28 @@ completed requests grouped into admission-order batches of S, each
 holding every slot for max(tokens) iterations — what the engine would
 have done without iteration-level retirement).
 
+Generation modes (r17): `--sample` replays a committed-threefry sampled
+workload through TWO shuffled admission orders and bit-compares both
+against the offline reference; `--beam` runs width-3 COW beam search and
+bit-compares every ranked hypothesis against the offline beam reference
+while asserting block-pool conservation across fork/prune.
+
 `--smoke` runs a seconds-scale configuration and asserts the invariants
 (all served, zero retrace after warmup; for --decode also continuous-
 vs-offline bit-identity, occupancy gain > 1.5x, and the KERNEL parity
 leg: the same paged+chunked+speculative workload under
 PADDLE_TPU_KERNELS=off vs =interpret must produce byte-identical
-tokens) — wired into tier-1 CI by tests/test_serving.py and
-tests/test_decode.py.
+tokens; for --sample/--beam also replay bit-identity, zero retraces
+after warmup, and beam block-conservation) — wired into tier-1 CI by
+tests/test_serving.py and tests/test_decode.py.
 
 Usage:
   python tools/bench_serving.py [--mode closed|open] [--requests 512]
       [--clients 8] [--rate 200] [--replicas 2] [--max-batch 8]
       [--seq 0] [--deadline-ms 0] [--smoke]
   python tools/bench_serving.py --decode [--requests 128] [--slots 8]
-      [--max-len 64] [--rates 50,200,800] [--smoke]
+      [--max-len 64] [--rates 50,200,800] [--paged] [--spec]
+      [--sample] [--beam] [--smoke]
 """
 
 import argparse
@@ -298,6 +306,8 @@ def run_decode(args, rng):
 
     paged = _paged_sweep(args, rng) if args.paged else None
     spec = _spec_leg(args, rng) if args.spec else None
+    sampled = _sample_leg(args, rng) if args.sample_leg else None
+    beam = _beam_leg(args, rng) if args.beam_leg else None
     kernel_parity = _kernel_modes_leg(args) if args.smoke else None
 
     engine.shutdown()
@@ -331,6 +341,10 @@ def run_decode(args, rng):
         report["extra"]["paged"] = paged
     if spec is not None:
         report["extra"]["spec"] = spec
+    if sampled is not None:
+        report["extra"]["sample"] = sampled
+    if beam is not None:
+        report["extra"]["beam"] = beam
     if kernel_parity is not None:
         report["extra"]["kernel_parity"] = kernel_parity
     print(json.dumps(report))
@@ -354,6 +368,14 @@ def run_decode(args, rng):
             assert spec["offline_mismatches"] == 0, spec
             assert spec["steps_per_token"] < 1.0, spec
             assert spec["retraces"] == 0, spec
+        if sampled is not None:
+            assert sampled["bit_identical"], sampled
+            assert sampled["retraces"] == 0, sampled
+        if beam is not None:
+            assert beam["tokens_bit_identical"], beam
+            assert beam["conservation_ok"], beam
+            assert beam["beam_forks"] > 0, beam
+            assert beam["retraces"] == 0, beam
         print("DECODE_SMOKE_OK")
     return 0
 
@@ -404,6 +426,101 @@ def _kernel_modes_leg(args):
         "modes": ["off", "interpret"],
         "requests": len(off),
         "bit_identical": off == interp,
+    }
+
+
+def _sample_leg(args, rng):
+    """Committed-threefry sampled decode (r17): the SAME sampled workload
+    admitted in TWO shuffled orders must byte-equal the offline
+    whole-sequence reference both times — the stream is keyed per
+    (request seed, emitted-token index), so batchmates, slots, and
+    arrival timing never enter it. Zero retraces: the policy runs on the
+    host over the one compiled logits fetch."""
+    from paddle_tpu.serving.decode import (
+        GenerationEngine,
+        SamplingParams,
+        build_decoder_model,
+    )
+
+    engine = GenerationEngine(queue_depth=args.queue_depth,
+                              breaker_threshold=0)
+    entry = engine.register_model(lambda: build_decoder_model(
+        vocab_size=args.vocab, hidden=args.hidden, num_layers=args.layers,
+        slots=args.slots, max_len=args.max_len, block_size=4,
+        name="bench_sample", version="1"))
+    n = max(args.slots * 2, 8)
+    prompts = [[int(t) for t in rng.randint(0, args.vocab,
+                                            size=int(rng.randint(1, 6)))]
+               for _ in range(n)]
+    sp = SamplingParams(temperature=0.9, top_k=8, top_p=0.95, seed=1234)
+    refs = [entry.offline_decode(p, 6, sampling=sp) for p in prompts]
+    jits0 = _jit_count()
+    engine.start()
+    identical = True
+    for order_seed in (0, 1):
+        order = np.random.RandomState(order_seed).permutation(n)
+        resps = {}
+        for i in order:
+            resps[int(i)] = engine.submit(prompts[i], max_new_tokens=6,
+                                          sampling=sp)
+        outs = [[int(t) for t in resps[i].result(timeout=300)["tokens"]]
+                for i in range(n)]
+        identical = identical and outs == refs
+    st = entry.stats()
+    engine.shutdown()
+    return {
+        "requests": n,
+        "admission_orders": 2,
+        "params": sp.describe(),
+        "bit_identical": identical,
+        "sampled_tokens": st["sampled_tokens"],
+        "retraces": _jit_count() - jits0,
+    }
+
+
+def _beam_leg(args, rng):
+    """Width-3 COW beam search (r17): every ranked hypothesis byte-equals
+    the offline beam reference; forks/prunes are counted and the block
+    pool's free/cached/live partition is re-asserted after retirement
+    (conservation across fork = refcount++ / prune = release)."""
+    from paddle_tpu.serving.decode import (
+        BeamParams,
+        GenerationEngine,
+        build_decoder_model,
+    )
+
+    engine = GenerationEngine(queue_depth=args.queue_depth,
+                              breaker_threshold=0)
+    entry = engine.register_model(lambda: build_decoder_model(
+        vocab_size=args.vocab, hidden=args.hidden, num_layers=args.layers,
+        slots=args.slots, max_len=args.max_len, block_size=4, eos_id=0,
+        name="bench_beam", version="1"))
+    prompts = [[int(t) for t in rng.randint(1, args.vocab,
+                                            size=int(rng.randint(2, 6)))]
+               for _ in range(4)]
+    refs = [entry.offline_beam(p, 6, BeamParams(3)) for p in prompts]
+    jits0 = _jit_count()
+    engine.start()
+    identical = True
+    for p, ref in zip(prompts, refs):
+        got = engine.submit(p, max_new_tokens=6,
+                            beam_width=3).result(timeout=300)
+        identical = identical and (
+            [[int(t) for t in h["tokens"]] for h in got["beams"]]
+            == [list(rt) for rt, _rs in ref])
+    entry.block_pool.check_conservation()
+    st = entry.stats()
+    conserved = st["block_pool"]["blocks_live"] == 0
+    engine.shutdown()
+    return {
+        "requests": len(prompts),
+        "width": 3,
+        "tokens_bit_identical": identical,
+        "beam_forks": st["beam_forks"],
+        "beam_prunes": st["beam_prunes"],
+        "beam_finished": st["beam_finished"],
+        "conservation_ok": conserved,
+        "retraces": _jit_count() - jits0,
     }
 
 
@@ -557,6 +674,12 @@ def main(argv=None):
     ap.add_argument("--spec", action="store_true",
                     help="decode: speculative-decoding leg "
                          "(steps-per-token, acceptance rate)")
+    ap.add_argument("--sample", dest="sample_leg", action="store_true",
+                    help="decode: committed-threefry sampled leg "
+                         "(shuffled-admission replay bit-identity)")
+    ap.add_argument("--beam", dest="beam_leg", action="store_true",
+                    help="decode: COW beam-search leg (offline "
+                         "reference bit-identity + block conservation)")
     ap.add_argument("--verify", type=int, default=8,
                     help="decode: requests/rate checked against offline "
                          "(--smoke checks every request)")

@@ -11,8 +11,8 @@ perturb thread interleavings:
     stalls (and for how long) is a pure function of (seed, L, n) —
     replaying a seed replays the exact stall schedule;
   * ``resilience.faults`` stall rules at the existing sites
-    (decode.step/prefill/inject, lookup.pull/push, dataio.read) with
-    per-rule seeded probability.
+    (decode.step/prefill/inject/sample, lookup.pull/push, dataio.read)
+    with per-rule seeded probability.
 
 Every scenario asserts a BIT-EXACT property against an unstressed
 serial reference (decode tokens == offline decode, embedding host tier
@@ -186,22 +186,34 @@ def _small_decode_model(name, slots=2, max_len=10):
 
 def scenario_decode(seed, n_requests=6):
     from paddle_tpu.resilience import faults
-    from paddle_tpu.serving.decode import GenerationEngine
+    from paddle_tpu.serving.decode import (
+        BeamParams,
+        GenerationEngine,
+        SamplingParams,
+    )
 
     rng = random.Random((seed, "decode"))
     prompts = [[rng.randrange(16) for _ in range(rng.randrange(1, 5))]
                for _ in range(n_requests)]
     max_news = [rng.randrange(1, 5) for _ in range(n_requests)]
+    # odd requests run the r17 committed-sampling policy: the stream is
+    # keyed per (seed, emitted-index), so the stall schedule must not be
+    # able to change a single byte of it
+    samplings = [SamplingParams(temperature=0.8, top_k=6, seed=seed + i)
+                 if i % 2 else None for i in range(n_requests)]
 
     engine = GenerationEngine(queue_depth=32, breaker_threshold=0)
     engine.set_tenant("a", weight=2.0)
     engine.set_tenant("b", weight=1.0, max_in_flight=1)
     entry = engine.register_model(
         lambda: _small_decode_model(f"stress{seed}"))
-    refs = [entry.offline_decode(p, n) for p, n in zip(prompts, max_news)]
+    refs = [entry.offline_decode(p, n, sampling=sp)
+            for p, n, sp in zip(prompts, max_news, samplings)]
+    beam_ref = entry.offline_beam(prompts[0], 3, BeamParams(2))
 
     faults.configure(_stall_rules(
-        seed, ["decode.step", "decode.prefill", "decode.inject"]))
+        seed, ["decode.step", "decode.prefill", "decode.inject",
+               "decode.sample"]))
     try:
         engine.start()
         resps = {}
@@ -212,6 +224,7 @@ def scenario_decode(seed, n_requests=6):
                 for i in range(k, n_requests, 2):
                     resps[i] = engine.submit(
                         prompts[i], max_new_tokens=max_news[i],
+                        sampling=samplings[i],
                         tenant="a" if i % 3 else "b")
                     time.sleep(0.001 * ((seed + i) % 3))
             except BaseException as e:
@@ -229,14 +242,25 @@ def scenario_decode(seed, n_requests=6):
             assert got == refs[i], (
                 f"seed {seed} request {i}: continuous {got} != offline "
                 f"{refs[i]} — schedule changed the answer")
+        # COW beam search under the same stall schedule: ranked
+        # hypotheses byte-equal the offline reference, pool conserved
+        beam = engine.submit(prompts[0], max_new_tokens=3,
+                             beam_width=2).result(timeout=120)
+        got_beams = [[int(t) for t in h["tokens"]] for h in beam["beams"]]
+        assert got_beams == [list(rt) for rt, _rs in beam_ref], (
+            f"seed {seed} beam: {got_beams} != {beam_ref}")
+        entry.block_pool.check_conservation()
     finally:
         faults.reset()
         engine.shutdown()
     st = entry.stats()
-    assert st["completed"] == n_requests, st["completed"]
+    assert st["completed"] == n_requests + 1, st["completed"]
     assert st["failed"] == 0 and st["step_failures"] == 0
-    return {"requests": n_requests,
+    assert st["sampled_tokens"] > 0
+    return {"requests": n_requests + 1,
             "decode_steps": st["decode_steps"],
+            "sampled_tokens": st["sampled_tokens"],
+            "beam_forks": st["beam_forks"],
             "occupancy": round(st["occupancy"], 3)}
 
 
@@ -401,6 +425,21 @@ def _drive_decode_evidence():
         entry._step()
     assert r1.done() and r2.done() and dead.done()
     assert entry.stats()["completed"] == 2
+    # r17 generation modes on this same thread: beam fork/prune walks
+    # blocks-under-slot chains, draft-KV walks decode.draft ->
+    # decode.blocks (the declared proposal-slot chain)
+    engine.register_model(
+        lambda: _small_decode_model("evidence_d", slots=2, max_len=8))
+    b = engine.submit([1, 2], max_new_tokens=2, model="evidence",
+                      beam_width=2)
+    s = engine.submit([3, 1], max_new_tokens=2, model="evidence",
+                      draft_model="evidence_d", spec_k=2)
+    for _ in range(12):
+        if b.done() and s.done():
+            break
+        entry._iterate()
+    assert b.done() and s.done()
+    entry.block_pool.check_conservation()
     engine.stats()
 
 
