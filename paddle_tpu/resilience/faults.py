@@ -77,6 +77,16 @@ tools/stress_concurrency.py):
   the draw is host arithmetic on already-fetched logits, not a device
   boundary, so ``raise`` models no real failure here: use ``stall``
   schedules at this site.
+* ``decode.spill`` / ``decode.resume`` — fired when the scheduler
+  PARKS an in-flight session under arena exhaustion (its private KV
+  rows spill to the host tier, the slot frees) and when a parked
+  session RESUMES (rows re-injected — or recomputed from the committed
+  tokens when the tier entry was evicted/quarantined). ``stall``
+  perturbs park/resume interleavings against admissions and decode
+  steps; the stress harness proves no schedule changes a byte of any
+  preempted-then-resumed stream. Like ``decode.sample``, the spill is
+  host bookkeeping (the device reads are plain fetches), so ``stall``
+  is the modeled failure mode here.
 
 Fleet failover sites (r12, ``serving/fleet/`` + tools/chaos_serve.py):
 

@@ -77,14 +77,17 @@ from paddle_tpu.serving.decode.generate.beam import (
     finished_ranking as beam_finished_ranking,
 )
 from paddle_tpu.serving.decode.generate.beam import select as beam_select
+from paddle_tpu.serving.brownout import BrownoutController
 from paddle_tpu.serving.decode.metrics import DecodeMetrics
 from paddle_tpu.serving.decode.model import NEG_INF, DecodeModel
 from paddle_tpu.serving.decode.pool import (
     BlockPool,
     PrefixCache,
     SlotPool,
+    block_hashes,
     prompt_key,
 )
+from paddle_tpu.serving.decode.tier import HostKVTier
 from paddle_tpu.serving.engine import _ReplicaBreaker
 from paddle_tpu.serving.queue import RequestQueue
 from paddle_tpu.serving.request import (
@@ -157,6 +160,14 @@ class _ArenaInvalidError(RuntimeError):
     whole KV pool — not just the admitting request — is undefined."""
 
 
+class _DeferAdmission(Exception):
+    """Raised out of ``_acquire_blocks`` when the arena is exhausted and
+    the request cannot be admitted right now, but WILL fit later (parked
+    sessions hold its blocks, or victims could not be preempted safely).
+    The admission loop parks the request on ``_pending`` and retries
+    every iteration — never a hard failure."""
+
+
 class _TenantState:
     __slots__ = ("weight", "max_in_flight", "max_queued", "in_flight",
                  "queued", "vtime")
@@ -185,7 +196,7 @@ class _Slot:
 
     __slots__ = ("request", "mode", "cursor", "last_token", "generated",
                  "blocks", "row_map", "plen", "done", "shared_len", "toks",
-                 "sampling", "grammar", "beam", "score",
+                 "sampling", "grammar", "beam", "score", "seq",
                  "d_entry", "d_slot", "d_blocks", "d_row_map", "d_cursor")
 
     def __init__(self, request, mode="decode"):
@@ -196,6 +207,7 @@ class _Slot:
         self.generated = []
         self.blocks = []
         self.row_map = None
+        self.seq = 0            # admission order (default victim policy)
         self.plen = len(request.prompt)
         self.done = 0           # chunked prefill: prompt positions landed
         self.shared_len = 0     # positions served by radix-shared blocks
@@ -232,6 +244,26 @@ class _BeamGroup:
         self.spare = []
 
 
+class _ParkedSession:
+    """One preempted in-flight session waiting off-device. ``states``
+    holds the live ``_Slot`` objects (host state — sampling stream,
+    grammar cursor, committed tokens — travels with them untouched);
+    ``keys`` the host-tier keys of each hypothesis's spilled KV rows
+    (empty for spec mode, which holds no target arena rows). Resume is
+    FIFO: re-acquire slots + blocks, re-inject (or recompute) the rows,
+    and the session continues byte-identically."""
+
+    __slots__ = ("request", "mode", "states", "keys", "group", "parked_at")
+
+    def __init__(self, request, mode, states, keys, group=None):
+        self.request = request
+        self.mode = mode
+        self.states = states
+        self.keys = keys
+        self.group = group
+        self.parked_at = time.perf_counter()
+
+
 class _ModelEntry:
     """One hosted (model, version): programs + executables + slot batch +
     block pool + its scheduler thread. All slot/arena/block mutation
@@ -248,6 +280,20 @@ class _ModelEntry:
         self._slots = [None] * model.slots
         self._blocks = BlockPool(model.num_blocks, model.block_size)
         self._prefix = PrefixCache(prefix_cache_size)
+        # graceful degradation (r18): host-RAM KV tier, parked sessions,
+        # deferred admissions, and the brownout severity ladder. The
+        # pool writes registered blocks back to the tier at LRU eviction
+        # (decode.blocks -> decode.tier); reads go through the engine so
+        # the device rows come off the live arena.
+        self._tier = HostKVTier(capacity_bytes=engine._host_tier_bytes)
+        self._blocks.attach_tier(self._tier, read_rows=self._read_block_rows)
+        self._parked = []       # [_ParkedSession] FIFO
+        self._pending = []      # [GenerationRequest] deferred admissions
+        self._brownout = BrownoutController()
+        self._bt_seen = 0       # brownout transitions already counted
+        self._admit_seq = 0
+        self._chunk_throttle = False
+        self.victim_policy = None   # callable([slot ids]) -> slot id
         self._breaker = (
             _ReplicaBreaker(breaker_threshold, breaker_cooldown_s)
             if breaker_threshold and breaker_threshold > 0 else None
@@ -409,9 +455,14 @@ class _ModelEntry:
         with self._cond:
             for r in self._queue.expire():
                 self._reject_expired(r)
+            # shutdown drains parked sessions and deferred admissions
+            # too: capacity frees as slots retire, so they resume and
+            # finish rather than abandoning their futures
             if (self._stop and self._queue.empty()
-                    and self._pool.active_count == 0):
+                    and self._pool.active_count == 0
+                    and not self._parked and not self._pending):
                 return True
+        self._brownout_tick()
         if self._breaker is not None and not self._stop:
             verdict, wait_s = self._breaker.gate()
             if verdict == "wait":
@@ -434,7 +485,9 @@ class _ModelEntry:
                 except Exception:
                     self._breaker_event(self._breaker.record_failure())
                     return False
-        admitted = self._admit_free_slots()
+        # parked sessions and deferred admissions get first claim on
+        # freed capacity — FIFO, before any new pick from the queue
+        admitted = self._service_parked() + self._admit_free_slots()
         progressed = self._advance_prefills() + self._advance_spec()
         if not any(st is not None and st.mode in ("decode", "beam")
                    for st in self._slots):
@@ -465,6 +518,11 @@ class _ModelEntry:
     # -- admission (blocks + prefill/inject into a free slot) -------------
     def _admit_free_slots(self):
         picked = []
+        # brownout L3+: LOW-lane dispatch quota drops to zero — queued
+        # LOW requests wait out the pressure episode instead of landing
+        # on an oversubscribed arena
+        lanes = (Priority.LANES if self._brownout.level < 3
+                 else tuple(p for p in Priority.LANES if p != Priority.LOW))
         with self._cond:
             rows = 0
             while self._pool.free_count - rows > 0:
@@ -472,7 +530,8 @@ class _ModelEntry:
                 # width slots (seed + first-selection forks) before the
                 # next pick runs
                 req = self._engine._pick(
-                    self._queue, max_rows=self._pool.free_count - rows)
+                    self._queue, max_rows=self._pool.free_count - rows,
+                    lanes=lanes)
                 if req is None:
                     break
                 picked.append(req)
@@ -481,48 +540,70 @@ class _ModelEntry:
             self._queue.note_drained()
         for req in picked:
             self._engine._tenant_unqueue(req.tenant)
-            if req.expired():
-                # picked but dead: release the pick-time in-flight
-                # reservation; no slot to free
-                self._engine._tenant_unflight(req.tenant)
-                self._metrics.incr("deadline_missed")
-                req.response._complete(error=DeadlineExceededError(
-                    "deadline expired before prefill"))
-                self._metrics.observe_request(req)
-                continue
-            slot = self._pool.acquire()
-            try:
-                self._prefill_into(req, slot)
-            except _ArenaInvalidError as e:
-                # donated inject failed: like a step failure, every
-                # in-flight sequence is lost (failed loudly), the
-                # outcome drives the breaker, and the arena resets
-                self._slots[slot] = None
-                self._engine._tenant_unflight(req.tenant)
-                self._metrics.incr("failed")
-                req.response._complete(error=RequestError(
-                    f"request {req.id} failed in inject: {e}"))
-                self._metrics.observe_request(req)
-                self._metrics.incr("step_failures")
-                self._probe_relaunched = False
-                if self._breaker is not None:
-                    self._breaker_event(self._breaker.record_failure())
-                self._reject_all_slots(lambda r: ReplicaLostError(
-                    f"request {r.id} lost to arena "
-                    f"failure during admission: {e}"))
-                self._reset_arenas()
-                # the reset arena is valid (zeroed): the REMAINING picked
-                # requests still admit — dropping them would abandon
-                # their futures and leak their tenants' queued counters
-            except Exception as e:  # request-attributed, not replica health
-                self._pool.release(slot)
-                self._slots[slot] = None
-                self._engine._tenant_unflight(req.tenant)
-                self._metrics.incr("failed")
-                req.response._complete(error=RequestError(
-                    f"request {req.id} failed in prefill: {e}"))
-                self._metrics.observe_request(req)
+            if self._admit_one(req) == "deferred":
+                # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+                self._pending.append(req)
         return len(picked)
+
+    def _admit_one(self, req):
+        """Admit one request (freshly picked or retried from
+        ``_pending``) into a free slot. The caller's pick-time tenant
+        in-flight reservation is held throughout; it is released here on
+        every terminal outcome and KEPT on "deferred" (the request is
+        still committed to this entry — it just waits for arena
+        capacity). Returns "admitted" | "deferred" | "done"."""
+        if req.expired():
+            # picked but dead: release the pick-time in-flight
+            # reservation; no slot to free
+            self._engine._tenant_unflight(req.tenant)
+            self._metrics.incr("deadline_missed")
+            req.response._complete(error=DeadlineExceededError(
+                "deadline expired before prefill"))
+            self._metrics.observe_request(req)
+            return "done"
+        slot = self._pool.acquire()
+        if slot is None:
+            # only reachable on a _pending retry (fresh picks are
+            # budgeted against free_count): wait for a retirement
+            return "deferred"
+        try:
+            self._prefill_into(req, slot)
+        except _DeferAdmission:
+            self._pool.release(slot)
+            self._slots[slot] = None
+            return "deferred"
+        except _ArenaInvalidError as e:
+            # donated inject failed: like a step failure, every
+            # in-flight sequence is lost (failed loudly), the
+            # outcome drives the breaker, and the arena resets
+            self._slots[slot] = None
+            self._engine._tenant_unflight(req.tenant)
+            self._metrics.incr("failed")
+            req.response._complete(error=RequestError(
+                f"request {req.id} failed in inject: {e}"))
+            self._metrics.observe_request(req)
+            self._metrics.incr("step_failures")
+            self._probe_relaunched = False
+            if self._breaker is not None:
+                self._breaker_event(self._breaker.record_failure())
+            self._reject_all_slots(lambda r: ReplicaLostError(
+                f"request {r.id} lost to arena "
+                f"failure during admission: {e}"))
+            self._reset_arenas()
+            # the reset arena is valid (zeroed): the REMAINING picked
+            # requests still admit — dropping them would abandon
+            # their futures and leak their tenants' queued counters
+            return "done"
+        except Exception as e:  # request-attributed, not replica health
+            self._pool.release(slot)
+            self._slots[slot] = None
+            self._engine._tenant_unflight(req.tenant)
+            self._metrics.incr("failed")
+            req.response._complete(error=RequestError(
+                f"request {req.id} failed in prefill: {e}"))
+            self._metrics.observe_request(req)
+            return "done"
+        return "admitted"
 
     def _row_of(self, st, p):
         b = st.blocks[p // self._model.block_size]
@@ -539,31 +620,426 @@ class _ModelEntry:
             st.row_map[lo:hi] = b.row0 + np.arange(hi - lo)
 
     def _acquire_blocks(self, req):
+        """Acquire the prompt's block chain, parking victims instead of
+        hard-failing under exhaustion. Loud failure is reserved for the
+        one unfixable case — the prompt alone can never fit the pool.
+        Otherwise victims are preempted (spilled to the host tier, to
+        resume byte-identically) until the prompt fits; if that is not
+        possible right now, ``_DeferAdmission`` sends the request to
+        ``_pending`` with its tenant reservation intact."""
         blocks, shared_len = self._blocks.acquire_for_prompt(req.prompt)
-        if blocks is None:
-            self._metrics.incr("blocks_exhausted")
+        if blocks is not None:
+            return blocks, shared_len
+        m = self._model
+        self._metrics.incr("blocks_exhausted")
+        if (len(req.prompt) + m.block_size - 1) // m.block_size \
+                > m.num_blocks:
+            self._metrics.incr("blocks_failed_total")
             raise RuntimeError(
                 f"block pool exhausted ({self._blocks.stats()['blocks_free']}"
-                f" free of {self._model.num_blocks}); shorten the prompt, "
-                "retire traffic, or host the model with more blocks")
+                f" free of {m.num_blocks}) and the prompt alone can never "
+                "fit; shorten the prompt or host the model with more blocks")
+        # don't preempt on behalf of NEW work while earlier preempted
+        # sessions are still waiting — they have first claim on capacity
+        while blocks is None and not self._parked:
+            if not self._park_victim(req):
+                break
+            blocks, shared_len = self._blocks.acquire_for_prompt(req.prompt)
+        self._metrics.incr("blocks_parked_total")
+        if blocks is None:
+            self._metrics.incr("admissions_deferred")
+            raise _DeferAdmission()
         return blocks, shared_len
+
+    # -- preemption / host-tier spill / resume ----------------------------
+    def _read_block_rows(self, b):
+        """Tier write-back reader: one registered block's live arena rows
+        (called by the pool inside ``decode.blocks`` at LRU eviction —
+        before the evictee's rows can be overwritten by its successor)."""
+        out = []
+        for kn, vn in self._model.state_names:
+            k = np.asarray(self._scope.find_var(kn))
+            v = np.asarray(self._scope.find_var(vn))
+            out.append((np.array(k[b.row0:b.row0 + b.size_used]),
+                        np.array(v[b.row0:b.row0 + b.size_used])))
+        return out
+
+    def _read_rows(self, row_map, n):
+        """One slot's KV rows ``[0:n)`` off the live arena, per layer."""
+        idx = np.asarray(row_map[:n], dtype=np.int64)
+        out = []
+        for kn, vn in self._model.state_names:
+            k = np.asarray(self._scope.find_var(kn))
+            v = np.asarray(self._scope.find_var(vn))
+            out.append((np.array(k[idx]), np.array(v[idx])))
+        return out
+
+    def _park_victim(self, req):
+        """Pick and park one decode-mode victim to free blocks for
+        ``req``. Policy is a seam (tests shuffle it); the default preempts
+        the most recently admitted session — oldest work is closest to
+        finishing and freeing everything anyway."""
+        cands = [s for s in range(self._model.slots)
+                 if self._slots[s] is not None
+                 and self._slots[s].mode == "decode"
+                 and self._slots[s].request is not req]
+        if not cands:
+            return False
+        if self.victim_policy is not None:
+            pick = self.victim_policy(cands)
+        else:
+            pick = max(cands, key=lambda s: self._slots[s].seq)
+        return self._park_slot(pick)
+
+    def _park_slot(self, s):
+        """Preempt one live slot: spill its private KV rows ``[0:cursor)``
+        to the host tier, free its blocks + slot (+ draft footprint), and
+        queue the session for FIFO resume. Host state (sampling stream,
+        grammar cursor, committed tokens) stays on the parked ``_Slot``
+        untouched — resume is byte-identical by construction. Returns
+        False when the session cannot be parked (host tier exhausted, or
+        it can never be resumed because its lifetime footprint exceeds
+        the whole pool)."""
+        st = self._slots[s]
+        if st is None or st.mode not in ("decode", "spec"):
+            return False
+        req = st.request
+        m = self._model
+        if st.mode == "spec":
+            # no target arena rows: the park is pure host state. The
+            # draft-KV footprint (if any) is released; resume falls back
+            # to replay proposals — same committed tokens either way.
+            with profiler.RecordEvent("decode::spill"):
+                faults.fire("decode.spill")
+                self._release_draft_locked(st)
+            self._slots[s] = None
+            self._pool.release(s)
+            # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+            self._parked.append(_ParkedSession(req, "spec", [st], []))
+            self._metrics.incr("sessions_parked")
+            return True
+        need = (st.plen + req.max_new + m.block_size - 1) // m.block_size
+        if need > m.num_blocks:
+            return False
+        key = f"park:{req.id}:0"
+        with profiler.RecordEvent("decode::spill"):
+            faults.fire("decode.spill")
+            rows = self._read_rows(st.row_map, st.cursor)
+            toks = (list(req.prompt) + list(st.generated))[:st.cursor]
+            if not self._tier.put(key, rows, st.cursor, tokens=toks):
+                return False
+        self._slots[s] = None
+        self._pool.release(s)
+        self._blocks.release(st.blocks)
+        st.blocks = []
+        self._release_draft_locked(st)
+        # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+        self._parked.append(_ParkedSession(req, "decode", [st], [key]))
+        self._metrics.incr("sessions_parked")
+        return True
+
+    def _park_group(self, group):
+        """Preempt a whole beam group: every live hypothesis spills its
+        rows (rank-keyed), the group releases ALL its slots (spares
+        included), and resume rebuilds ``order`` in the same rank order —
+        selection tie-breaking stays bit-identical."""
+        req = group.request
+        m = self._model
+        live = [(sid, self._slots[sid]) for sid in group.order]
+        need = sum((st.cursor + m.block_size - 1) // m.block_size
+                   for _, st in live)
+        if need > m.num_blocks:
+            return False
+        keys = []
+        with profiler.RecordEvent("decode::spill"):
+            faults.fire("decode.spill")
+            for rank, (sid, st) in enumerate(live):
+                key = f"park:{req.id}:{rank}"
+                rows = self._read_rows(st.row_map, st.cursor)
+                toks = (list(req.prompt) + list(st.generated))[:st.cursor]
+                if not self._tier.put(key, rows, st.cursor, tokens=toks):
+                    for k in keys:
+                        # lockdep: ok(HostKVTier is internally locked — decode.tier, a leaf under decode.blocks)
+                        self._tier.discard(k)
+                    return False
+                keys.append(key)
+        states = []
+        for sid, st in live:
+            self._slots[sid] = None
+            self._pool.release(sid)
+            self._blocks.release(st.blocks)
+            st.blocks = []
+            states.append(st)
+        for sid in group.spare:
+            self._pool.release(sid)
+        group.spare = []
+        group.order = []
+        # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+        self._parked.append(
+            _ParkedSession(req, "beam", states, keys, group=group))
+        self._metrics.incr("sessions_parked")
+        return True
+
+    def _service_parked(self):
+        """Resume parked sessions (FIFO, stop at the first that does not
+        fit yet), then retry deferred admissions. Runs at the top of
+        every iteration, before new picks — preempted work has first
+        claim on freed capacity."""
+        progressed = 0
+        while self._parked:
+            ps = self._parked[0]
+            if ps.request.expired():
+                # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+                self._parked.pop(0)
+                self._drop_parked(ps, DeadlineExceededError(
+                    "deadline expired while parked under arena pressure"))
+                continue
+            if not self._resume_session(ps):
+                break
+            # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+            self._parked.pop(0)
+            progressed += 1
+        if not self._parked and self._pending:
+            pend, self._pending = self._pending, []
+            for req in pend:
+                if self._admit_one(req) == "deferred":
+                    # lockdep: ok(single writer: the scheduler thread; submit-side readers only probe emptiness (GIL-atomic) and tolerate staleness)
+                    self._pending.append(req)
+                else:
+                    progressed += 1
+        return progressed
+
+    def _drop_parked(self, ps, error):
+        for key in ps.keys:
+            # lockdep: ok(HostKVTier is internally locked — decode.tier, a leaf under decode.blocks)
+            self._tier.discard(key)
+        self._engine._tenant_unflight(ps.request.tenant)
+        self._metrics.incr("deadline_missed"
+                           if isinstance(error, DeadlineExceededError)
+                           else "failed")
+        ps.request.response._complete(error=error)
+        self._metrics.observe_request(ps.request)
+
+    def _resume_session(self, ps):
+        """Re-admit one parked session. Returns False when capacity is
+        still insufficient (caller retries next iteration); True when the
+        session left the parked list — resumed, or terminally failed via
+        an arena loss during re-injection."""
+        m = self._model
+        if ps.mode == "spec":
+            s = self._pool.acquire()
+            if s is None:
+                return False
+            with profiler.RecordEvent("decode::resume"):
+                faults.fire("decode.resume")
+                self._slots[s] = ps.states[0]
+            self._metrics.incr("sessions_resumed")
+            return True
+        if ps.mode == "decode":
+            st = ps.states[0]
+            s = self._pool.acquire()
+            if s is None:
+                return False
+            blocks = self._blocks.acquire_rows(st.cursor)
+            if blocks is None:
+                self._pool.release(s)
+                return False
+            st.blocks = blocks
+            st.shared_len = 0
+            self._rebuild_row_map(st)
+            self._slots[s] = st
+            with profiler.RecordEvent("decode::resume"):
+                faults.fire("decode.resume")
+                ok = self._inject_rows(st, ps.keys[0])
+            if not ok:
+                return True     # arena lost; session rejected with the rest
+            self._metrics.incr("sessions_resumed")
+            return True
+        # beam: all live hypotheses come back together, in rank order
+        group = ps.group
+        got = []
+        ok = True
+        for st in ps.states:
+            s = self._pool.acquire()
+            blocks = (self._blocks.acquire_rows(st.cursor)
+                      if s is not None else None)
+            if s is None or blocks is None:
+                if s is not None:
+                    self._pool.release(s)
+                ok = False
+                break
+            got.append((s, st, blocks))
+        if not ok:
+            for s, st, blocks in got:
+                self._pool.release(s)
+                self._blocks.release(blocks)
+            return False
+        group.order = []
+        for s, st, blocks in got:
+            st.blocks = blocks
+            st.shared_len = 0
+            self._rebuild_row_map(st)
+            self._slots[s] = st
+            group.order.append(s)
+        # re-establish the group's width reservation, best-effort: forks
+        # need spares, and admission must not steal them back first
+        while len(group.order) + len(group.spare) < group.width:
+            sid = self._pool.acquire()
+            if sid is None:
+                break
+            group.spare.append(sid)
+        with profiler.RecordEvent("decode::resume"):
+            faults.fire("decode.resume")
+            for rank, (s, st, blocks) in enumerate(got):
+                if not self._inject_rows(st, ps.keys[rank]):
+                    for key in ps.keys:
+                        # lockdep: ok(HostKVTier is internally locked — decode.tier, a leaf under decode.blocks)
+                        self._tier.discard(key)
+                    return True     # arena lost; group rejected with the rest
+        self._metrics.incr("sessions_resumed")
+        return True
+
+    def _inject_rows(self, st, key):
+        """Re-inject a resumed session's KV rows ``[0:cursor)``. The tier
+        entry is consumed if present and CRC-clean; otherwise (evicted or
+        quarantined) the rows are RECOMPUTED from the committed tokens —
+        byte-identical, because a causal KV row is a pure function of its
+        token prefix. Returns False on arena loss (the donated inject
+        failed; ``_arena_lost`` already rejected every slot, this session
+        included)."""
+        m = self._model
+        n = st.cursor
+        # lockdep: ok(HostKVTier is internally locked — decode.tier, a leaf under decode.blocks)
+        ent = self._tier.pop(key)
+        if ent is not None and ent.size_used == n:
+            kv = ent.kv_rows
+        else:
+            toks = (list(st.request.prompt) + list(st.generated))[:n]
+            fetches = self._run("prefill", self._prefill_feeds(toks))
+            kvr = [np.asarray(f) for f in fetches[1:]]
+            kv = [(kvr[2 * i][0, :n], kvr[2 * i + 1][0, :n])
+                  for i in range(len(m.state_names))]
+            self._metrics.incr("resume_replays")
+        inj_rows = np.full((m.max_len,), m.rows, dtype="int64")
+        inj_rows[:n] = st.row_map[:n]
+        inj = {DecodeModel.INJ_ROWS: inj_rows}
+        for i, (kn, vn) in enumerate(m.inject_kv_feeds):
+            karr = np.zeros((1, m.max_len, m.hidden), "float32")
+            varr = np.zeros((1, m.max_len, m.hidden), "float32")
+            karr[0, :n] = kv[i][0]
+            varr[0, :n] = kv[i][1]
+            inj[kn] = karr
+            inj[vn] = varr
+        try:
+            self._run("inject", inj)
+        except Exception as e:
+            self._arena_lost(f"resume inject failure: {e}")
+            return False
+        return True
+
+    def _restore_from_tier(self, st):
+        """Chunked admission's host-tier fast path: contiguous full
+        prompt blocks just past the radix-shared prefix whose rows were
+        written back at eviction re-INJECT instead of re-running chunk
+        prefill — prefix-cache reach is bounded by host RAM, not HBM.
+        Returns the prompt position covered through (0 = no extension);
+        only applies from a block boundary, since a shared partial tail
+        already occupies the next block index."""
+        m = self._model
+        bs = m.block_size
+        if st.shared_len % bs != 0:
+            return 0
+        prompt = st.request.prompt
+        hashes = block_hashes(prompt, bs)
+        start = st.shared_len // bs
+        ents = []
+        idx = start
+        while idx < len(hashes) and (idx + 1) * bs <= st.plen:
+            ent = self._tier.get("blk:" + hashes[idx])
+            if ent is None or ent.size_used != bs:
+                break
+            ents.append(ent)
+            idx += 1
+        if not ents:
+            return 0
+        lo, hi = start * bs, idx * bs
+        inj_rows = np.full((m.max_len,), m.rows, dtype="int64")
+        inj_rows[lo:hi] = st.row_map[lo:hi]
+        inj = {DecodeModel.INJ_ROWS: inj_rows}
+        for i, (kn, vn) in enumerate(m.inject_kv_feeds):
+            karr = np.zeros((1, m.max_len, m.hidden), "float32")
+            varr = np.zeros((1, m.max_len, m.hidden), "float32")
+            for j, ent in enumerate(ents):
+                p = lo + j * bs
+                karr[0, p:p + bs] = ent.kv_rows[i][0]
+                varr[0, p:p + bs] = ent.kv_rows[i][1]
+            inj[kn] = karr
+            inj[vn] = varr
+        try:
+            with profiler.RecordEvent("decode::inject"):
+                self._run("inject", inj)
+        except Exception as e:
+            raise _ArenaInvalidError(str(e)) from e
+        self._metrics.incr("tier_hits", len(ents))
+        return hi
+
+    # -- brownout ----------------------------------------------------------
+    def _brownout_tick(self):
+        """One severity evaluation per scheduler iteration. Occupancy
+        saturates while anything is parked or deferred — the arena is
+        over-subscribed even if the instantaneous row count dipped."""
+        occ = self._blocks.stats()["occupancy"]
+        if self._parked or self._pending:
+            occ = 1.0
+        qp = self._queue.pressure()
+        self._brownout.step(occupancy=occ,
+                            queue_seconds=qp["queue_seconds"],
+                            deadline=qp["deadline"])
+        n = len(self._brownout.transitions)
+        if n > self._bt_seen:
+            self._metrics.incr("brownout_transitions", n - self._bt_seen)
+            self._bt_seen = n
+
+    def _shed_confirmed(self):
+        """Live pressure re-check guarding the two REJECT gates (L4
+        shed, L3 beam cap). Severity is sampled by the scheduler tick
+        and decays hysteretically, so right after a burst clears it can
+        overstate the instantaneous state — degrading quality on a
+        stale reading is harmless, but turning a request away is not.
+        Read-only: no controller mutation, safe from the submit
+        thread."""
+        if self._parked or self._pending:
+            return True
+        try:
+            occ = self._blocks.stats()["occupancy"]
+        except Exception:
+            occ = 0.0
+        qp = self._queue.pressure()
+        live = max(occ, qp["queue_seconds"], qp["deadline"])
+        return live >= self._brownout.exit[self._brownout.level - 1]
 
     def _prefill_into(self, req, slot):
         m = self._model
         req.dispatch_time = time.perf_counter()
-        if req.draft_key is not None:
+        self._admit_seq += 1
+        # brownout L1/L2: shed OUTPUT-INVISIBLE quality first — committed
+        # tokens are identical with or without speculation/draft-KV, only
+        # the step count changes
+        severity = self._brownout.level
+        if req.draft_key is not None and severity < 2:
             # speculative: no TARGET arena footprint — verification
             # re-derives every KV it needs inside the (stateless) batch
             # prefill. With draft_kv the proposals get their own slot +
             # blocks on the DRAFT entry (O(1) per proposed token);
             # admission failure there degrades to replay proposals.
             st = _Slot(req, mode="spec")
+            st.seq = self._admit_seq
             st.toks = list(req.prompt)
             st.sampling = req.sampling
             if req.grammar is not None:
                 st.grammar = GrammarConstraint(req.grammar)
             self._slots[slot] = st
-            if req.draft_kv:
+            if req.draft_kv and severity < 1:
                 draft = self._engine._entries.get(req.draft_key)
                 if draft is not None:
                     self._admit_draft_kv(st, draft)
@@ -576,12 +1052,16 @@ class _ModelEntry:
                 and plen > m.chunk_tokens):
             blocks, shared_len = self._acquire_blocks(req)
             st = _Slot(req, mode="prefill")
+            st.seq = self._admit_seq
             st.blocks = blocks
             st.shared_len = shared_len
             # the FINAL chunk always runs (it produces the last-position
             # logits), even when the radix served every block
             st.done = min(shared_len, plen - 1)
             self._rebuild_row_map(st)
+            restored = self._restore_from_tier(st)
+            if restored > st.done:
+                st.done = min(restored, plen - 1)
             self._slots[slot] = st
             self._metrics.incr("admitted")
             self._metrics.tenant_incr("admitted", req.tenant)
@@ -607,6 +1087,7 @@ class _ModelEntry:
             self._metrics.observe_prefill(time.perf_counter() - t0)
         blocks, shared_len = self._acquire_blocks(req)
         st = _Slot(req, mode="decode")
+        st.seq = self._admit_seq
         st.blocks = blocks
         st.shared_len = shared_len
         self._rebuild_row_map(st)
@@ -679,6 +1160,13 @@ class _ModelEntry:
                 and self._slots[s].mode == "prefill"]
         if not pref:
             return 0
+        # brownout L2+: halve the chunk budget (one chunk every OTHER
+        # iteration) — admitted long prompts land later, but in-flight
+        # decode slots keep their step cadence under pressure
+        if self._brownout.level >= 2:
+            self._chunk_throttle = not self._chunk_throttle
+            if self._chunk_throttle:
+                return 0
         s = pref[self._pref_rr % len(pref)]
         self._pref_rr += 1
         st = self._slots[s]
@@ -1354,10 +1842,21 @@ class _ModelEntry:
                         f"request {st.request.id} failed: {e}"), slot=s)
                 continue
             if blocks is None:
+                # mid-generation exhaustion: park the session (spill to
+                # the host tier, resume byte-identically later) instead
+                # of failing; loud only when the host tier cannot absorb
+                # it or the session can never be resumed
                 self._metrics.incr("blocks_exhausted")
+                parked = (self._park_group(st.beam) if st.mode == "beam"
+                          else self._park_slot(s))
+                if parked:
+                    self._metrics.incr("blocks_parked_total")
+                    continue
+                self._metrics.incr("blocks_failed_total")
                 err = RequestError(
                     f"request {st.request.id} failed: block pool "
-                    "exhausted mid-generation")
+                    "exhausted mid-generation and the host KV tier "
+                    "cannot absorb the session")
                 if st.mode == "beam":
                     self._reject_beam_group(st.beam, err)
                 else:
@@ -1434,6 +1933,8 @@ class _ModelEntry:
         for group in groups:
             if group.request.response.done():
                 continue    # rejected while another slot was being fed
+            if not group.order:
+                continue    # parked while another slot was being fed
             # commit this step's KV append per live hypothesis, collect
             # its (device-masked) logits row in HYPOTHESIS order, then
             # run the shared selection rule once for the whole group
@@ -1584,6 +2085,11 @@ class _ModelEntry:
                               else None),
             "tenant_tokens": self._metrics.tenant_counts("tokens"),
             "tenant_completed": self._metrics.tenant_counts("completed"),
+            "host_tier": self._tier.stats(),
+            "brownout_severity": self._brownout.level,
+            "brownout": self._brownout.snapshot(),
+            "parked_sessions": len(self._parked),
+            "pending_admissions": len(self._pending),
         })
 
     @property
@@ -1610,7 +2116,7 @@ class GenerationEngine:
 
     def __init__(self, place=None, queue_depth=256, breaker_threshold=3,
                  breaker_cooldown_s=1.0, prefix_cache_size=64,
-                 hbm_budget_mb=None, label=None):
+                 hbm_budget_mb=None, host_tier_mb=64, label=None):
         import paddle_tpu as fluid
 
         if place is None:
@@ -1627,6 +2133,8 @@ class GenerationEngine:
         self._breaker_cooldown_s = breaker_cooldown_s
         self._prefix_cache_size = prefix_cache_size
         self._hbm_budget_mb = hbm_budget_mb
+        # per-entry host-RAM KV tier budget (spill/write-back target)
+        self._host_tier_bytes = int(host_tier_mb) << 20
         self._entries = {}        # (name, version) -> _ModelEntry
         self._latest = {}         # name -> version (last registered)
         self._reg_order = []      # keys in registration order (latest wins)
@@ -1781,7 +2289,7 @@ class GenerationEngine:
             st = self._tenant(tenant)
             st.in_flight = max(st.in_flight - 1, 0)
 
-    def _pick(self, queue, max_rows=None):
+    def _pick(self, queue, max_rows=None, lanes=None):
         """Weighted-fair pick (caller holds queue.lock): first non-empty
         priority lane wins (strict priority), then the lane's queued
         tenant with the smallest virtual time, skipping tenants at their
@@ -1790,9 +2298,11 @@ class GenerationEngine:
         ``max_rows`` is the admission round's remaining slot budget: a
         tenant whose head request needs more rows (a beam) is skipped
         for the round — head-of-line within the tenant is deliberate,
-        per-tenant FIFO is the ordering contract."""
+        per-tenant FIFO is the ordering contract. ``lanes`` restricts the
+        eligible priority lanes (brownout L3 zeroes the LOW-lane
+        dispatch quota this way — queued LOW waits, it is not lost)."""
         with self._tenant_lock:
-            for lane in Priority.LANES:
+            for lane in (lanes if lanes is not None else Priority.LANES):
                 requests = queue.lane(lane)
                 if not requests:
                     continue
@@ -1897,6 +2407,19 @@ class GenerationEngine:
         tenant = str(tenant)
         entry.metrics.incr("submitted")
         entry.metrics.tenant_incr("submitted", tenant)
+        severity = entry._brownout.level
+        if (severity >= 4 and priority != Priority.HIGH
+                and entry._shed_confirmed()):
+            # brownout L4: the ladder's last rung — shed non-HIGH at the
+            # door with a measured retry-after instead of queueing work
+            # the drain rate says will miss its deadline anyway
+            entry.metrics.incr("rejected")
+            entry.metrics.incr("brownout_shed")
+            entry.metrics.tenant_incr("rejected", tenant)
+            raise RejectedError(
+                f"brownout {entry._brownout.name}: shedding non-HIGH "
+                "traffic under overload",
+                retry_after_s=entry._queue.retry_after_estimate(1))
         self._validate(m, prompt_ids, max_new_tokens, priority, entry)
         if isinstance(sampling, dict):
             sampling = SamplingParams(**sampling)
@@ -1915,6 +2438,17 @@ class GenerationEngine:
             if draft_model is not None:
                 self._bad(entry, "beam search does not compose with "
                                  "speculative decoding")
+            if (severity >= 3 and beam.width > entry._brownout.beam_cap
+                    and entry._shed_confirmed()):
+                # brownout L3: wide beams multiply slot + block footprint;
+                # cap NEW admissions (in-flight groups keep their width)
+                entry.metrics.incr("rejected")
+                entry.metrics.incr("brownout_shed")
+                entry.metrics.tenant_incr("rejected", tenant)
+                raise RejectedError(
+                    f"brownout {entry._brownout.name}: beam width capped "
+                    f"at {entry._brownout.beam_cap} under pressure",
+                    retry_after_s=entry._queue.retry_after_estimate(1))
         if grammar is not None:
             if not isinstance(grammar, CompiledGrammar):
                 self._bad(entry, "grammar must be a CompiledGrammar")

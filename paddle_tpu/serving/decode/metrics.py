@@ -43,6 +43,17 @@ class DecodeMetrics(ServingMetrics):
         "beam_prunes", "beam_finished",
         # circuit breaker relaunch (AOT-warmed replacement replicas)
         "relaunches",
+        # graceful degradation (r18): arena exhaustion now splits into
+        # park-with-retry (session spilled to the host tier, resumed
+        # byte-identically later) vs loud failure (host tier exhausted
+        # or the request can never fit); "blocks_exhausted" stays the
+        # umbrella total of both outcomes
+        "blocks_parked_total", "blocks_failed_total",
+        "sessions_parked", "sessions_resumed", "resume_replays",
+        "tier_hits", "admissions_deferred",
+        # brownout ladder (serving/brownout.py): witnessed transitions
+        # and L4 sheds
+        "brownout_transitions", "brownout_shed",
     )
 
     def __init__(self, engine_label=None, registry=None):

@@ -26,7 +26,7 @@ __all__ = ["ReplicaHealth"]
 
 class ReplicaHealth:
     __slots__ = ("threshold", "cooldown_s", "breaker", "dead",
-                 "death_reason", "deaths", "last_seen")
+                 "death_reason", "deaths", "last_seen", "severity")
 
     def __init__(self, threshold=3, cooldown_s=1.0):
         self.threshold = int(threshold)
@@ -37,6 +37,11 @@ class ReplicaHealth:
         self.death_reason = None
         self.deaths = 0
         self.last_seen = None
+        # brownout severity (serving/brownout.py) last sampled from the
+        # replica's engine stats by the router's health pass: 0 = full
+        # service .. 4 = shedding; the router biases dispatch away from
+        # browned-out replicas and sheds fleet-wide at 4
+        self.severity = 0
 
     # -- routing gate ------------------------------------------------------
     def routable(self):

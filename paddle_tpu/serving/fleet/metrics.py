@@ -33,6 +33,9 @@ class FleetMetrics:
         # worker-replacement swaps inside a deploy() pass)
         "scale_ups", "scale_downs", "deploys", "replaced_deploys",
         "stolen_queued",
+        # brownout (r18): fleet-level sheds when EVERY routable replica
+        # reports severity 4 (non-HIGH turned away at the router door)
+        "brownout_shed",
     )
 
     def __init__(self, router_label, registry=None):

@@ -327,6 +327,18 @@ class FleetRouter:
             if self._stop:
                 raise RejectedError("fleet router is draining",
                                     retry_after_s=0.0)
+            if priority != Priority.HIGH:
+                sevs = [self._health[r].severity for r in self._routable()]
+                if sevs and min(sevs) >= 4:
+                    # fleet-wide brownout L4: every routable replica is
+                    # already shedding non-HIGH — turn it away at the
+                    # router door instead of burning a dispatch sweep
+                    self._metrics.incr("brownout_shed")
+                    self._metrics.incr("rejected_shed")
+                    raise RejectedError(
+                        "fleet brownout: every routable replica is "
+                        "shedding non-HIGH traffic",
+                        retry_after_s=_SHED_COLD_HINT_S)
             self._next_id += 1
             rid = self._next_id
             if model is None:
@@ -381,6 +393,15 @@ class FleetRouter:
                 self._replicas[rid].load(), rid))
             if self._replicas[spill].load() < self._replicas[target].load():
                 target = spill
+        # brownout bias: an affinity target at severity >= 3 gives way
+        # to the least-browned-out (then least-loaded) candidate —
+        # affinity saves prefill, but a capped replica costs more than
+        # the prefill it saves
+        if self._health[target].severity >= 3:
+            calm = min(cands, key=lambda rid: (
+                self._health[rid].severity, self._replicas[rid].load(), rid))
+            if self._health[calm].severity < self._health[target].severity:
+                target = calm
         return target
 
     def _try_dispatch(self, rr):
@@ -624,6 +645,18 @@ class FleetRouter:
         self._metrics.observe_latency(
             time.perf_counter() - rr.submit_time)
 
+    @staticmethod
+    def _severity_of(stats):
+        """Max brownout severity across a replica's hosted entries (0
+        when the stats shape predates the ladder — subprocess workers on
+        an older wheel report full service, not an error)."""
+        try:
+            models = stats.get("engine", {}).get("models", {})
+            return max((int(ms.get("brownout_severity", 0) or 0)
+                        for ms in models.values()), default=0)
+        except Exception:
+            return 0
+
     def _health_pass(self):
         with self._lock:
             items = [(rid, self._replicas[rid], self._health[rid])
@@ -638,6 +671,18 @@ class FleetRouter:
                 self._note_replica_failure(rid, e, during="health")
                 continue
             self._note_replica_success(rid)
+            # sample brownout severity alongside the heartbeat (I/O
+            # outside the lock, like every other RPC here): the router
+            # biases dispatch away from browned-out replicas and sheds
+            # fleet-wide when every routable one reports L4
+            try:
+                sev = self._severity_of(handle.stats())
+            except Exception:
+                sev = 0
+            with self._lock:
+                h = self._health.get(rid)
+                if h is not None:
+                    h.severity = sev
         with self._lock:
             self._metrics.set_healthy(len(self._routable()))
 
@@ -841,9 +886,13 @@ class FleetRouter:
                     "load": self._replicas[rid].load(),
                     "deaths": self._health[rid].deaths,
                     "draining": rid in self._draining,
+                    "severity": self._health[rid].severity,
                 }
                 for rid in sorted(self._replicas)
             }
+            fleet_severity = max(
+                (self._health[rid].severity for rid in self._routable()),
+                default=0)
             inflight = sum(1 for rr in self._inflight.values()
                            if rr.state == "inflight")
             parked = sum(1 for rr in self._inflight.values()
@@ -854,6 +903,7 @@ class FleetRouter:
             "inflight": inflight,
             "parked": parked,
             "pinned_versions": pinned,
+            "fleet_severity": fleet_severity,
             "last_scaleup_traces": self.last_scaleup_traces,
         })
 

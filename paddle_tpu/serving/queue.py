@@ -215,6 +215,43 @@ class RequestQueue:
             rows, self._deferred_rows = self._deferred_rows, 0
             self._note_drained(rows, time.perf_counter())
 
+    def pressure(self, now=None, horizon_s=1.0):
+        """Normalized pressure signals for the brownout controller
+        (serving/brownout.py), sampled once per scheduler iteration:
+
+        * ``queue_seconds`` — queued rows over the measured drain rate,
+          normalized against ``horizon_s`` (1.0 == a full horizon of
+          work is backed up). Zero before the first drain sample: an
+          idle queue must not brown out on its cold-start hint.
+        * ``deadline`` — ``1 - headroom / budget`` for the most urgent
+          queued request (0 fresh, 1 at expiry); 0 when nothing queued
+          carries a deadline.
+        * ``depth_frac`` — queued rows over ``max_depth``.
+        """
+        now = now if now is not None else time.perf_counter()
+        with self.lock:
+            depth = self._depth
+            rate = self._drain_rate
+            worst = 0.0
+            for lane in self._lanes.values():
+                for r in lane:
+                    if r.deadline is None:
+                        continue
+                    budget = r.deadline - r.submit_time
+                    if budget <= 0.0:
+                        worst = 1.0
+                        continue
+                    frac = 1.0 - (r.deadline - now) / budget
+                    worst = max(worst, min(max(frac, 0.0), 1.0))
+        qs = 0.0
+        if depth > 0 and rate > 0.0:
+            qs = min((depth / rate) / float(horizon_s), 1.0)
+        return {
+            "queue_seconds": qs,
+            "deadline": worst,
+            "depth_frac": depth / float(max(self.max_depth, 1)),
+        }
+
     # -- introspection -----------------------------------------------------
     def depth(self):
         """Queued rows (admission unit: a 4-row request costs 4)."""

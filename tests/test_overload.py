@@ -1,0 +1,346 @@
+"""Graceful degradation under pressure (ISSUE 18).
+
+The acceptance contract: block-pool exhaustion NEVER silently loses or
+needlessly fails work — sessions that cannot keep their arena rows park
+(KV spilled to the host-RAM tier, slot freed) and later resume
+byte-identical to an uninterrupted run, in every generation mode and
+for every victim-selection policy; a corrupted host-tier entry is
+quarantined by its CRC and the resume recomputes the KV from the token
+history instead of reading garbage; admission defers (measured
+retry-after) rather than hard-failing unless the request can NEVER fit;
+the brownout ladder escalates immediately, de-escalates hysteretically,
+and its two REJECT rungs (L4 shed, L3 beam cap) only fire while live
+pressure confirms the severity; and the committed
+OVERLOAD_EVIDENCE_r18.json re-derives live.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from paddle_tpu.serving.brownout import BrownoutController
+from paddle_tpu.serving.decode import (
+    GenerationEngine,
+    SamplingParams,
+    build_decoder_model,
+)
+from paddle_tpu.serving.decode.tier import HostKVTier
+from paddle_tpu.serving.request import (
+    Priority,
+    RejectedError,
+    RequestError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tight_model(name, slots=2, num_blocks=6, max_len=16, block_size=2):
+    return build_decoder_model(
+        vocab_size=32, hidden=8, num_layers=1, slots=slots,
+        max_len=max_len, block_size=block_size, num_blocks=num_blocks,
+        name=name, version="1")
+
+
+def _drain(entry, resps, iters=800):
+    for _ in range(iters):
+        if all(r.done() for r in resps):
+            return
+        entry._iterate()
+    raise AssertionError("hand-stepped drain did not converge")
+
+
+# ---------------------------------------------------------------------------
+# host KV tier (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_host_tier_put_get_lru_and_capacity():
+    import numpy as np
+
+    tier = HostKVTier(capacity_bytes=1024)   # 4 entries of 256 B
+    rows = [(np.ones((4, 8), "float32"), np.ones((4, 8), "float32"))]
+    assert tier.put("blk:a", rows, 4, tokens=(1, 2, 3, 4))
+    assert "blk:a" in tier and len(tier) == 1
+    ent = tier.get("blk:a")
+    assert ent is not None and ent.size_used == 4
+    assert np.array_equal(ent.kv_rows[0][0], rows[0][0])
+    # LRU: filling past capacity evicts the stalest entry, never errors
+    for i in range(8):
+        assert tier.put(f"blk:{i}", rows, 4, tokens=(i,))
+    assert "blk:a" not in tier
+    assert tier.stats()["evictions"] >= 1
+    # an entry that ALONE exceeds the budget is the only refusal
+    tiny = HostKVTier(capacity_bytes=8)
+    assert not tiny.put("blk:x", rows, 4, tokens=(1,))
+    assert tiny.stats()["rejected"] == 1
+
+
+def test_host_tier_crc_quarantines_corruption():
+    import numpy as np
+
+    tier = HostKVTier(capacity_bytes=1 << 20)
+    rows = [(np.arange(32, dtype="float32").reshape(4, 8),
+             np.zeros((4, 8), "float32"))]
+    tier.put("park:7:0", rows, 4, tokens=(1, 2, 3, 4))
+    assert tier.stats()["spills"] == 1       # park: keys count as spills
+    tier.corrupt_entry("park:7:0")
+    # a corrupt entry reads as a MISS, never as wrong bytes
+    assert tier.pop("park:7:0") is None
+    st = tier.stats()
+    assert st["corrupt_dropped"] == 1 and st["misses"] == 1
+    assert "park:7:0" not in tier
+
+
+# ---------------------------------------------------------------------------
+# brownout controller (unit, hand-stepped, no threads)
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_escalates_immediately_to_highest_rung():
+    ctl = BrownoutController()
+    assert ctl.step(occupancy=0.2) == 0
+    assert ctl.step(occupancy=0.97) == 4     # straight to L4, no ladder
+    (t,) = ctl.transitions
+    assert t["from"] == 0 and t["to"] == 4
+    assert t["trigger"] == "occupancy" and t["value"] == 0.97
+
+
+def test_brownout_deescalates_one_level_per_hold_window():
+    ctl = BrownoutController(hold=3)
+    ctl.step(occupancy=0.97)
+    for expect in (4, 4, 3):                 # 3 clear steps -> one level
+        assert ctl.step(occupancy=0.1) == expect
+    for expect in (3, 3, 2):
+        assert ctl.step(occupancy=0.1) == expect
+
+
+def test_brownout_hysteresis_band_holds_without_flapping():
+    ctl = BrownoutController()               # enter[2]=0.85, exit[2]=0.70
+    ctl.step(occupancy=0.9)                  # -> L3
+    assert ctl.level == 3
+    for _ in range(10):                      # inside the band: no motion
+        assert ctl.step(occupancy=0.75) == 3
+    assert len(ctl.transitions) == 1
+
+
+def test_brownout_clear_streak_resets_on_pressure_blip():
+    ctl = BrownoutController(hold=3)
+    ctl.step(occupancy=0.97)
+    ctl.step(occupancy=0.1)
+    ctl.step(occupancy=0.1)
+    ctl.step(occupancy=0.9)                  # blip: streak must reset
+    for expect in (4, 4, 3):
+        assert ctl.step(occupancy=0.1) == expect
+
+
+def test_brownout_trigger_names_the_binding_signal():
+    ctl = BrownoutController()
+    ctl.step(occupancy=0.3, queue_seconds=0.96, deadline=0.5)
+    assert ctl.transitions[-1]["trigger"] == "queue_seconds"
+
+
+# ---------------------------------------------------------------------------
+# preemption / resume
+# ---------------------------------------------------------------------------
+
+
+def _victim_policies():
+    return {
+        "default": None,                         # newest admission
+        "oldest": lambda cands: min(cands, key=lambda s: s.seq),
+        "shuffled": lambda cands: sorted(
+            cands, key=lambda s: (s.seq * 2654435761) % 97)[0],
+    }
+
+
+@pytest.mark.parametrize("policy", sorted(_victim_policies()))
+def test_preempt_resume_bit_identity_any_victim(policy):
+    """Four sessions against a pool that serves ~two: whichever victim
+    the policy picks, every stream finishes byte-identical to the
+    uninterrupted offline reference, nothing fails, and the pool
+    conserves."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _tight_model(f"ov_vic_{policy}", slots=3, num_blocks=8))
+    entry.victim_policy = _victim_policies()[policy]
+    prompts = [[1 + i, 2 + i, 3 + i, 4 + i] for i in range(4)]
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    _drain(entry, resps)
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    assert outs == refs
+    assert st["failed"] == 0
+    assert st["sessions_parked"] >= 1
+    assert st["sessions_parked"] == st["sessions_resumed"]
+    entry.block_pool.check_conservation()
+
+
+def test_preempt_resume_sampled_stream_bit_identity():
+    """The committed threefry stream is keyed per (seed, emitted index)
+    — a park/resume in the middle of it must not advance or rewind a
+    single draw."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(lambda: _tight_model("ov_samp"))
+    sp = SamplingParams(temperature=0.8, top_k=6, seed=11)
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    refs = [entry.offline_decode(p, 6, sampling=sp) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6, sampling=sp)
+             for p in prompts]
+    _drain(entry, resps)
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    assert outs == refs and st["sessions_parked"] >= 1
+
+
+def test_corruption_walkback_recomputes_not_garbage():
+    """Flip one byte of a parked session's host-tier entry: the CRC
+    quarantine must turn the resume into a replay-recompute
+    (``resume_replays``) — same bytes out, one counter up."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(lambda: _tight_model("ov_crc_t"))
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    corrupted = False
+    for _ in range(800):
+        if all(r.done() for r in resps):
+            break
+        if entry._parked and not corrupted:
+            for key in entry._parked[0].keys:
+                entry._tier.corrupt_entry(key)
+            corrupted = True
+        entry._iterate()
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    assert corrupted, "no session ever parked — the test proved nothing"
+    assert outs == refs
+    assert st["resume_replays"] >= 1
+    assert st["host_tier"]["corrupt_dropped"] >= 1
+
+
+def test_admission_defers_until_capacity_then_completes():
+    """2x-capacity burst: every accepted request completes — exhaustion
+    parks or defers, it never fails a request that can fit."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(lambda: _tight_model("ov_defer"))
+    prompts = [[1 + i, 2 + i, 3 + i, 4 + i] for i in range(4)]
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    _drain(entry, resps)
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    assert outs == refs
+    assert st["failed"] == 0 and st["completed"] == len(prompts)
+    assert st["blocks_failed_total"] == 0
+
+
+def test_never_fit_prompt_still_fails_loudly():
+    """The ONE legitimate hard failure: a prompt whose blocks exceed
+    the whole pool can never be served — parking everyone else would
+    not help, so it fails loudly at admission, attributed."""
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(lambda: _tight_model("ov_neverfit"))
+    with pytest.raises(RequestError, match="can never fit"):
+        r = engine.submit(list(range(1, 14)), max_new_tokens=2)
+        _drain(entry, [r])
+        r.result(timeout=60)
+    assert entry.metrics.count("blocks_failed_total") == 1
+    engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the two REJECT rungs: stale severity must not shed
+# ---------------------------------------------------------------------------
+
+
+def test_l4_shed_requires_live_pressure():
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(lambda: _tight_model("ov_shed"))
+    entry._brownout.level = 4
+    # severity says shed, but the engine is idle: admission must pass
+    r = engine.submit([1, 2], max_new_tokens=2)
+    _drain(entry, [r])
+    assert [int(t) for t in r.result(timeout=60)["tokens"]]
+    # now live pressure confirms it: non-HIGH is turned away with a
+    # measured retry-after, HIGH still lands
+    entry._pending.append(object())
+    try:
+        with pytest.raises(RejectedError) as exc:
+            engine.submit([1, 2], max_new_tokens=2)
+        assert exc.value.retry_after_s is not None
+        assert entry.metrics.count("brownout_shed") == 1
+        high = engine.submit([1, 2], max_new_tokens=2,
+                             priority=Priority.HIGH)
+    finally:
+        entry._pending.pop()
+    entry._brownout.level = 0
+    _drain(entry, [high])
+    assert [int(t) for t in high.result(timeout=60)["tokens"]]
+    engine.shutdown()
+
+
+def test_l3_beam_cap_requires_live_pressure():
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _tight_model("ov_cap", slots=3, num_blocks=12))
+    entry._brownout.level = 3
+    # idle engine: a wide beam admits despite the stale severity
+    r = engine.submit([1, 2], max_new_tokens=2, beam_width=3)
+    _drain(entry, [r])
+    assert r.result(timeout=60)["beams"]
+    entry._pending.append(object())
+    try:
+        with pytest.raises(RejectedError, match="beam width capped"):
+            engine.submit([1, 2], max_new_tokens=2, beam_width=3)
+        # at or under the cap still admits
+        ok = engine.submit([1, 2], max_new_tokens=2, beam_width=2)
+    finally:
+        entry._pending.pop()
+    entry._brownout.level = 0
+    _drain(entry, [ok])
+    assert ok.result(timeout=60)["beams"]
+    engine.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# evidence drift gate
+# ---------------------------------------------------------------------------
+
+
+def test_overload_evidence_r18_committed():
+    """The committed overload evidence must re-derive LIVE: the
+    hand-stepped preemption/corruption/ledger legs and the scripted
+    brownout trace reproduce exactly the committed invariants section.
+    Drift means the degradation machinery changed behavior without
+    regenerating evidence: run `python tools/overload_report.py
+    --evidence OVERLOAD_EVIDENCE_r18.json`."""
+    path = os.path.join(REPO, "OVERLOAD_EVIDENCE_r18.json")
+    assert os.path.exists(path), "OVERLOAD_EVIDENCE_r18.json missing"
+    with open(path) as f:
+        committed = json.load(f)
+    tool = _load_tool("overload_report")
+    invariants, _measured = tool.deterministic_sections()
+    fresh = json.loads(json.dumps(invariants))
+    assert tool.check_invariants(fresh) == []
+    for key in ("preemption", "corruption", "ledger", "brownout"):
+        assert fresh[key] == committed["invariants"][key], (
+            f"overload evidence drift in '{key}'")

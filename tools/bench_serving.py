@@ -308,6 +308,8 @@ def run_decode(args, rng):
     spec = _spec_leg(args, rng) if args.spec else None
     sampled = _sample_leg(args, rng) if args.sample_leg else None
     beam = _beam_leg(args, rng) if args.beam_leg else None
+    overload = (_overload_leg(args, rng)
+                if (args.overload_leg or args.smoke) else None)
     kernel_parity = _kernel_modes_leg(args) if args.smoke else None
 
     engine.shutdown()
@@ -345,6 +347,8 @@ def run_decode(args, rng):
         report["extra"]["sample"] = sampled
     if beam is not None:
         report["extra"]["beam"] = beam
+    if overload is not None:
+        report["extra"]["overload"] = overload
     if kernel_parity is not None:
         report["extra"]["kernel_parity"] = kernel_parity
     print(json.dumps(report))
@@ -376,6 +380,15 @@ def run_decode(args, rng):
             assert beam["conservation_ok"], beam
             assert beam["beam_forks"] > 0, beam
             assert beam["retraces"] == 0, beam
+        if overload is not None:
+            p = overload["park"]
+            assert overload["bit_identical"], overload
+            assert p["failed"] == 0, overload
+            assert overload["goodput_admitted"] == 1.0, overload
+            assert p["parked"] >= 1 and p["resumed"] >= 1, overload
+            assert p["completed"] >= overload["shed_only"]["completed"], \
+                overload
+            assert p["retraces"] == 0, overload
         print("DECODE_SMOKE_OK")
     return 0
 
@@ -642,6 +655,91 @@ def _spec_leg(args, rng):
     }
 
 
+def _overload_leg(args, rng):
+    """r18 graceful-degradation leg: the SAME 2x-overload open-loop
+    burst through an undersized block pool (12 rows, 2 slots), once
+    with the host KV tier enabled (exhaustion parks, sessions resume)
+    and once with it zeroed (parking impossible — the shed-only
+    baseline where mid-generation exhaustion fails the request). The
+    park leg must lose NOTHING it admitted (goodput-of-admitted 1.0,
+    every completion bit-identical to offline) and complete at least as
+    many requests as the shed-only baseline, with zero retraces — the
+    spill/re-inject path reuses the admission inject/prefill
+    executables. The brownout ladder runs hot through the burst; its
+    transition log is returned as the overload witness."""
+    from paddle_tpu.serving.decode import GenerationEngine, build_decoder_model
+
+    n = 8
+    prompts = [[int(t) for t in rng.randint(0, args.vocab, size=4)]
+               for _ in range(n)]
+
+    def drive(host_tier_mb, name):
+        engine = GenerationEngine(queue_depth=n * 2 + 8,
+                                  breaker_threshold=0,
+                                  host_tier_mb=host_tier_mb)
+        entry = engine.register_model(lambda: build_decoder_model(
+            vocab_size=args.vocab, hidden=args.hidden,
+            num_layers=args.layers, slots=2, max_len=16, block_size=2,
+            num_blocks=6, name=name, version="1"))
+        refs = [entry.offline_decode(p, 6) for p in prompts]
+        engine.start()
+        # warm: one request per slot drained, then close the jit gate
+        for r in [engine.submit([1, 2], max_new_tokens=2)
+                  for _ in range(2)]:
+            r.result(timeout=120)
+        jits0 = _jit_count()
+        resps = []
+        shed = 0
+        for p in prompts:
+            time.sleep(0.001)
+            try:
+                resps.append(engine.submit(p, max_new_tokens=6))
+            except Exception:
+                resps.append(None)     # brownout shed at the door
+                shed += 1
+        completed = failed = mismatches = 0
+        for r, ref in zip(resps, refs):
+            if r is None:
+                continue
+            try:
+                out = [int(t) for t in r.result(timeout=300)["tokens"]]
+                completed += 1
+                if out != ref:
+                    mismatches += 1
+            except Exception:
+                failed += 1
+        st = entry.stats()
+        engine.shutdown()
+        return {
+            "admitted": n - shed, "shed": shed,
+            "completed": completed, "failed": failed,
+            "mismatches": mismatches,
+            "parked": st["sessions_parked"],
+            "resumed": st["sessions_resumed"],
+            "resume_replays": st["resume_replays"],
+            "host_tier": {k: st["host_tier"][k]
+                          for k in ("spills", "writebacks", "hits",
+                                    "rejected")},
+            "brownout_transitions":
+                len(st["brownout"]["transitions"]),
+            "brownout_peak": max(
+                [t["to"] for t in st["brownout"]["transitions"]],
+                default=0),
+            "retraces": _jit_count() - jits0,
+        }
+
+    park = drive(64, "bench_ov")
+    shed_only = drive(0, "bench_ov_shed")
+    return {
+        "requests": n,
+        "park": park,
+        "shed_only": shed_only,
+        "goodput_admitted": round(
+            park["completed"] / max(park["admitted"], 1), 3),
+        "bit_identical": park["mismatches"] == 0,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
@@ -680,6 +778,10 @@ def main(argv=None):
     ap.add_argument("--beam", dest="beam_leg", action="store_true",
                     help="decode: COW beam-search leg (offline "
                          "reference bit-identity + block conservation)")
+    ap.add_argument("--overload", dest="overload_leg",
+                    action="store_true",
+                    help="decode: r18 degradation leg (park/resume vs "
+                         "shed-only goodput under a 2x open-loop burst)")
     ap.add_argument("--verify", type=int, default=8,
                     help="decode: requests/rate checked against offline "
                          "(--smoke checks every request)")

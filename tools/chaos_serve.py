@@ -61,11 +61,13 @@ def make_builder(cfg, version="1"):
     def builder():
         from paddle_tpu.serving.decode import build_decoder_model
 
+        extra = {k: cfg[k] for k in ("block_size", "num_blocks")
+                 if k in cfg}
         return build_decoder_model(
             vocab_size=cfg["vocab_size"], hidden=cfg["hidden"],
             num_layers=cfg["num_layers"], slots=cfg["slots"],
             max_len=cfg["max_len"], name=cfg["model_name"],
-            version=version,
+            version=version, **extra,
         )
     return builder
 
@@ -262,6 +264,136 @@ def run_scenario(cfg):
     return report
 
 
+def run_overload_scenario(cfg):
+    """r18 overload leg: kill a replica WHILE it holds parked sessions.
+
+    Two replicas with deliberately undersized block pools (12 rows, 2
+    slots) serve an open-loop burst that oversubscribes the arenas, so
+    the engines continuously park/resume sessions through the host KV
+    tier. The ``replica.kill`` fault is armed the moment a replica is
+    OBSERVED holding a parked session — the death lands while that
+    session's KV lives only in the (now dead) replica's host tier. The
+    router must re-dispatch everything the victim held — parked
+    included — with ZERO loss and BIT-IDENTICAL bytes (a re-dispatched
+    park restarts from the prompt on the new replica; decode determinism
+    makes the restart invisible). The stream runs on the HIGH lane so
+    the brownout ladder degrades but never sheds: this leg measures
+    preemption + failover, not shedding."""
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving.fleet import FleetRouter, LocalReplica
+    from paddle_tpu.serving.request import Priority
+
+    ocfg = dict(cfg, model_name="chaos_ov", slots=2, max_len=16,
+                block_size=2, num_blocks=6, replicas=2,
+                requests=max(8, cfg["requests"] // 2))
+    rng = random.Random((ocfg["seed"], "overload"))
+    prompts = [[rng.randrange(ocfg["vocab_size"]) for _ in range(4)]
+               for _ in range(ocfg["requests"])]
+    refs = offline_references(ocfg, prompts)
+    builder = make_builder(ocfg)
+
+    def factory(index):
+        return LocalReplica.create(f"r{index}", index, builder,
+                                   queue_depth=ocfg["requests"] * 2 + 8)
+
+    router = FleetRouter(
+        replica_factory=factory, health_interval_s=0.02,
+        min_replicas=ocfg["replicas"], max_replicas=ocfg["replicas"] + 1,
+        autoscale=True, breaker_threshold=3,
+        label=f"chaos-ov-{ocfg['seed']}",
+    )
+    for i in range(ocfg["replicas"]):
+        router.add_replica(factory(i))
+    router.start()
+    responses = []
+    armed = False
+    victim_rank = None
+    parked_at_kill = 0
+    try:
+        for p in prompts:
+            responses.append(router.submit(
+                p, max_new_tokens=ocfg["max_new"],
+                priority=Priority.HIGH))
+        # watch the replicas until one holds a parked session, then arm
+        # the kill on ITS rank; fall back to rank 0 if every park
+        # resolved before we caught one mid-flight
+        deadline = time.monotonic() + 30
+        while not armed and time.monotonic() < deadline:
+            if all(r.done() for r in responses):
+                break
+            for i in range(ocfg["replicas"]):
+                rep = router._replicas.get(f"r{i}")
+                if rep is None or getattr(rep, "engine", None) is None:
+                    continue
+                try:
+                    st = rep.engine.entry(
+                        ocfg["model_name"], "1").stats()
+                except KeyError:
+                    continue
+                if st["parked_sessions"] >= 1:
+                    victim_rank = i
+                    parked_at_kill = st["parked_sessions"]
+                    break
+            if victim_rank is not None:
+                faults.configure([{
+                    "site": "replica.kill", "action": "raise",
+                    "rank": victim_rank, "id": "chaos-kill-r18",
+                }])
+                armed = True
+            else:
+                time.sleep(0.001)
+        if not armed and not all(r.done() for r in responses):
+            victim_rank = 0
+            faults.configure([{
+                "site": "replica.kill", "action": "raise",
+                "rank": 0, "id": "chaos-kill-r18",
+            }])
+            armed = True
+        outs = [[int(t) for t in r.result(timeout=240)["tokens"]]
+                for r in responses]
+        fired = {}
+        inj = faults.get_injector()
+        if inj is not None:
+            fired = {k: v["fired"] for k, v in inj.rule_stats().items()}
+        stats = router.stats()
+    finally:
+        faults.reset()
+        router.shutdown()
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    check(stats["accepted"] == ocfg["requests"],
+          f"overload: accepted {stats['accepted']} != "
+          f"{ocfg['requests']}")
+    check(stats["completed"] == stats["accepted"],
+          f"overload: ZERO-LOSS VIOLATED — accepted {stats['accepted']} "
+          f"completed {stats['completed']} (failed={stats['failed']})")
+    bad = [i for i, (p, o) in enumerate(zip(prompts, outs))
+           if o != refs[tuple(p)]]
+    check(not bad,
+          f"overload: BIT-IDENTITY VIOLATED on requests {bad[:5]}")
+    killed = fired.get("chaos-kill-r18", 0)
+    check(killed == 1 if armed else killed == 0,
+          f"overload: replica.kill fired {killed} times (armed={armed})")
+    return {
+        "config": {k: ocfg[k] for k in sorted(ocfg)},
+        "invariants": {
+            "accepted": stats["accepted"],
+            "completed": stats["completed"],
+            "lost": stats["accepted"] - stats["completed"],
+            "bit_identical": not bad,
+            "kill_fired": killed == 1,
+            "parked_at_kill": parked_at_kill,
+            "victim": victim_rank,
+        },
+        "failures": failures,
+    }
+
+
 def default_cfg(args):
     return {
         "replicas": args.replicas,
@@ -316,6 +448,9 @@ def main(argv=None):
                     help="open-loop inter-arrival gap")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale run + invariant asserts (CI)")
+    ap.add_argument("--overload", action="store_true",
+                    help="r18 leg only: kill a replica while it holds "
+                         "parked sessions (smoke runs this too)")
     ap.add_argument("--evidence", metavar="OUT.json",
                     help="write the fleet evidence file")
     ap.add_argument("--json", action="store_true", dest="as_json")
@@ -325,7 +460,25 @@ def main(argv=None):
         logging.ERROR)
     cfg = default_cfg(args)
     t0 = time.perf_counter()
+    if args.overload and not args.smoke:
+        ov = run_overload_scenario(cfg)
+        wall = time.perf_counter() - t0
+        print(json.dumps(ov, indent=1))
+        if ov["failures"]:
+            for f in ov["failures"]:
+                print(f"CHAOS FAIL: {f}", file=sys.stderr)
+            return 1
+        inv = ov["invariants"]
+        print(f"CHAOS_OVERLOAD_OK requests={inv['accepted']} "
+              f"lost={inv['lost']} parked_at_kill={inv['parked_at_kill']} "
+              f"victim=r{inv['victim']} wall={wall:.1f}s")
+        return 0
     report = run_scenario(cfg)
+    if args.smoke or args.overload:
+        ov = run_overload_scenario(cfg)
+        report["overload"] = {"config": ov["config"],
+                              "invariants": ov["invariants"]}
+        report["failures"] = report["failures"] + ov["failures"]
     wall = time.perf_counter() - t0
     if args.evidence:
         _write_evidence(args.evidence, report)

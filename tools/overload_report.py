@@ -1,0 +1,473 @@
+#!/usr/bin/env python
+"""Overload evidence: graceful degradation under block-pool pressure.
+
+Emits OVERLOAD_EVIDENCE_r18.json, the committed witness for the r18
+robustness contract:
+
+  * **preemption bit-identity** — hand-stepped (no scheduler thread)
+    park/resume episodes in every generation mode (greedy, sampled,
+    beam, speculative): an undersized block pool forces sessions to
+    spill their KV rows to the host tier mid-generation and resume
+    later; every finished stream must byte-equal the uninterrupted
+    offline reference. Deterministic, so these sections are
+    DRIFT-GATED: tests/test_overload.py recomputes them live and any
+    divergence from the committed file is a failure.
+  * **corruption walk-back** — a parked session's host-tier entry is
+    deliberately corrupted; the CRC check must quarantine it and the
+    resume must fall back to recomputing the KV from the token history
+    (``resume_replays``), still byte-identical.
+  * **zero-loss ledger** — a 2x-capacity burst through the same tight
+    pool: every ACCEPTED request completes (parks are invisible), the
+    accounting identity ``accepted == completed`` holds with zero
+    failures, and the full token set digests identically across runs.
+  * **brownout ladder** — the BrownoutController replayed over a
+    scripted pressure trace: escalation is immediate, de-escalation is
+    hysteretic (``hold`` consecutive clear evaluations per level), and
+    the exact transition list is committed.
+  * **p99-of-admitted** (measured, NOT drift-gated — wall-clock) — the
+    p99 latency of admitted requests under the burst stays within a
+    bounded multiple of the uncontended baseline.
+
+Usage:
+  python tools/overload_report.py [--evidence OVERLOAD_EVIDENCE_r18.json]
+      [--json]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# p99 gate: generous (CPU timing on a shared container) but bounded
+P99_RATIO_BOUND = 15.0
+P99_FLOOR_S = 2.0
+
+VOCAB, HIDDEN, LAYERS = 32, 8, 1
+
+
+def _digest(payload):
+    return hashlib.sha256(json.dumps(payload).encode()).hexdigest()
+
+
+def _build(name, slots, num_blocks, max_len=16, block_size=2):
+    from paddle_tpu.serving.decode import build_decoder_model
+
+    return build_decoder_model(
+        vocab_size=VOCAB, hidden=HIDDEN, num_layers=LAYERS, slots=slots,
+        max_len=max_len, block_size=block_size, num_blocks=num_blocks,
+        name=name, version="1")
+
+
+def _drain(entry, resps, iters=600):
+    for _ in range(iters):
+        if all(r.done() for r in resps):
+            return
+        entry._iterate()
+    raise AssertionError(
+        f"hand-stepped drain did not converge in {iters} iterations")
+
+
+def _leg_greedy(sampling=None):
+    """Two sessions against a 12-row pool: both fit alone, not
+    together — one parks mid-generation and resumes after the other
+    retires. Hand-stepped, so the park/resume schedule is a pure
+    function of the code."""
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    mode = "greedy" if sampling is None else "sampled"
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _build(f"ov_{mode}", slots=2, num_blocks=6))
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    refs = [entry.offline_decode(p, 6, sampling=sampling)
+            for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6, sampling=sampling)
+             for p in prompts]
+    _drain(entry, resps)
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    return {
+        "mode": mode,
+        "requests": len(prompts),
+        "parked": st["sessions_parked"],
+        "resumed": st["sessions_resumed"],
+        "spills": st["host_tier"]["spills"],
+        "bit_identical": outs == refs,
+        "tokens_digest": _digest(outs),
+    }
+
+
+def _leg_beam():
+    """A width-2 beam group and a greedy competitor against a 20-row
+    pool: joint demand exceeds it, either party can fit alone — the
+    exhausted one parks (the beam group spills PER-HYPOTHESIS, rank
+    keyed) and resumes to byte-identical ranked hypotheses."""
+    from paddle_tpu.serving.decode import BeamParams, GenerationEngine
+
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _build("ov_beam", slots=3, num_blocks=10))
+    comp_prompt, beam_prompt = [1, 2, 3, 4], [5, 6, 7, 8]
+    comp_ref = entry.offline_decode(comp_prompt, 8)
+    beam_ref = entry.offline_beam(beam_prompt, 6, BeamParams(2))
+    comp = engine.submit(comp_prompt, max_new_tokens=8)
+    beam = engine.submit(beam_prompt, max_new_tokens=6, beam_width=2)
+    _drain(entry, [comp, beam])
+    comp_out = [int(t) for t in comp.result(timeout=60)["tokens"]]
+    beam_out = [[int(t) for t in h["tokens"]]
+                for h in beam.result(timeout=60)["beams"]]
+    st = entry.stats()
+    engine.shutdown()
+    ok = (comp_out == comp_ref
+          and beam_out == [list(rt) for rt, _rs in beam_ref])
+    return {
+        "mode": "beam",
+        "requests": 2,
+        "parked": st["sessions_parked"],
+        "resumed": st["sessions_resumed"],
+        "spills": st["host_tier"]["spills"],
+        "bit_identical": ok,
+        "tokens_digest": _digest([comp_out, beam_out]),
+    }
+
+
+def _leg_spec():
+    """A speculative session (no target-arena footprint) decoding
+    alongside two greedy competitors whose joint demand oversubscribes
+    the pool: the competitors park/resume around it and every stream —
+    the speculative one included — stays byte-identical. Only the
+    bit-identity half is drift-gated: whether the spec admission kept
+    its draft-KV slot depends on the brownout level at admission time,
+    which tracks wall-clock queue pressure."""
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _build("ov_spec_t", slots=3, num_blocks=8))
+    engine.register_model(
+        lambda: _build("ov_spec_d", slots=2, num_blocks=16))
+    spec_prompt = [3, 1, 3, 1]
+    comp_prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    spec_ref = entry.offline_decode(spec_prompt, 6)
+    comp_refs = [entry.offline_decode(p, 6) for p in comp_prompts]
+    comps = [engine.submit(p, max_new_tokens=6, model="ov_spec_t")
+             for p in comp_prompts]
+    spec = engine.submit(spec_prompt, max_new_tokens=6,
+                         model="ov_spec_t", draft_model="ov_spec_d",
+                         spec_k=2)
+    _drain(entry, comps + [spec])
+    comp_outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+                 for r in comps]
+    spec_out = [int(t) for t in spec.result(timeout=60)["tokens"]]
+    st = entry.stats()
+    engine.shutdown()
+    ok = comp_outs == comp_refs and spec_out == spec_ref
+    return {
+        "mode": "spec",
+        "requests": 3,
+        "parked": st["sessions_parked"],
+        "resumed": st["sessions_resumed"],
+        "bit_identical": ok,
+        "tokens_digest": _digest([spec_out] + comp_outs),
+    }
+
+
+def _leg_corruption():
+    """CRC walk-back: park a session, flip one byte of its host-tier
+    entry, resume. The tier must quarantine the corrupt entry (a miss,
+    never a wrong read) and the resume must recompute the KV from the
+    token history — the checkpoint.py quarantine idiom applied to the
+    spill tier."""
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    engine = GenerationEngine(queue_depth=16, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _build("ov_crc", slots=2, num_blocks=6))
+    prompts = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    corrupted = 0
+    for _ in range(600):
+        if all(r.done() for r in resps):
+            break
+        if entry._parked and not corrupted:
+            for key in entry._parked[0].keys:
+                entry._tier.corrupt_entry(key)
+                corrupted += 1
+        entry._iterate()
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    return {
+        "mode": "corruption_walkback",
+        "corrupted_entries": corrupted,
+        "corrupt_dropped": st["host_tier"]["corrupt_dropped"],
+        "resume_replays": st["resume_replays"],
+        "parked": st["sessions_parked"],
+        "resumed": st["sessions_resumed"],
+        "bit_identical": outs == refs,
+        "tokens_digest": _digest(outs),
+    }
+
+
+def _leg_ledger():
+    """Zero-loss ledger under a 2x burst: 8 requests against a pool
+    that serves 2 at a time, submitted up front and hand-stepped to
+    drain. The accounting identity the evidence commits: accepted ==
+    completed, failed == 0 — parks and the host tier make overload a
+    LATENCY event, never a loss event."""
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    engine = GenerationEngine(queue_depth=32, breaker_threshold=0)
+    entry = engine.register_model(
+        lambda: _build("ov_ledger", slots=2, num_blocks=6))
+    prompts = [[(3 * i + j) % VOCAB for j in range(1, 5)]
+               for i in range(8)]
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+    _drain(entry, resps, iters=1200)
+    outs = [[int(t) for t in r.result(timeout=60)["tokens"]]
+            for r in resps]
+    st = entry.stats()
+    engine.shutdown()
+    return {
+        "accepted": len(resps),
+        "completed": st["completed"],
+        "failed": st["failed"],
+        "lost": len(resps) - st["completed"],
+        "bit_identical": outs == refs,
+        "tokens_digest": _digest(outs),
+    }, {
+        "ledger_parked": st["sessions_parked"],
+        "ledger_resumed": st["sessions_resumed"],
+        "ledger_spills": st["host_tier"]["spills"],
+        "ledger_writebacks": st["host_tier"]["writebacks"],
+        "ledger_brownout_transitions":
+            len(st["brownout"]["transitions"]),
+    }
+
+
+def _leg_brownout():
+    """The severity ladder replayed over a scripted pressure trace —
+    the controller is clockless and threadless, so the transition list
+    is exact: a spike escalates straight to L4, the decay walks down
+    one level per ``hold`` clear evaluations, and a value inside the
+    hysteresis band (0.72 between exit 0.70 and enter 0.85) holds L3
+    without flapping."""
+    from paddle_tpu.serving.brownout import BrownoutController
+
+    ctl = BrownoutController()
+    trace = (
+        [("occupancy", 0.2)] * 2          # quiet
+        + [("occupancy", 0.97)]           # spike: straight to L4
+        + [("queue_seconds", 0.9)] * 2    # stays hot on a second signal
+        + [("occupancy", 0.72)] * 8       # in L3's hysteresis band
+        + [("occupancy", 0.3)] * 12       # clear: ladder walks down
+    )
+    levels = []
+    for sig, val in trace:
+        levels.append(ctl.step(**{sig: val}))
+    return {
+        "trace_len": len(trace),
+        "levels": levels,
+        "peak": max(levels),
+        "final": levels[-1],
+        "transitions": ctl.snapshot()["transitions"],
+        "enter": list(ctl.enter),
+        "exit": list(ctl.exit),
+        "hold": ctl.hold,
+    }
+
+
+def _p99(samples):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(int(len(s) * 0.99), len(s) - 1)]
+
+
+def _leg_p99():
+    """Wall-clock leg (measured, not drift-gated): p99 of ADMITTED
+    requests under the 2x burst vs an uncontended sequential baseline
+    through an identical engine. Parking trades latency for loss — the
+    trade is only honest if the latency stays bounded."""
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    def run(name, burst):
+        engine = GenerationEngine(queue_depth=32, breaker_threshold=0)
+        engine.register_model(
+            lambda: _build(name, slots=2, num_blocks=6))
+        engine.start()
+        prompts = [[(3 * i + j) % VOCAB for j in range(1, 5)]
+                   for i in range(8)]
+        lats = []
+        shed = 0
+        if burst:
+            pend = []
+            for p in prompts:
+                try:
+                    pend.append((engine.submit(p, max_new_tokens=6),
+                                 time.perf_counter()))
+                except Exception:
+                    shed += 1
+            for r, t0 in pend:
+                r.result(timeout=240)
+                lats.append(time.perf_counter() - t0)
+        else:
+            for p in prompts:
+                t0 = time.perf_counter()
+                engine.submit(p, max_new_tokens=6).result(timeout=240)
+                lats.append(time.perf_counter() - t0)
+        engine.shutdown()
+        return lats, shed
+
+    base, _ = run("ov_p99_base", burst=False)
+    over, shed = run("ov_p99_burst", burst=True)
+    p99_base, p99_over = _p99(base), _p99(over)
+    bound = max(P99_RATIO_BOUND * p99_base, P99_FLOOR_S)
+    return {
+        "p99_baseline_ms": round(p99_base * 1e3, 1),
+        "p99_admitted_ms": round(p99_over * 1e3, 1),
+        "p99_bound_ms": round(bound * 1e3, 1),
+        "bounded": p99_over <= bound,
+        "admitted": len(over),
+        "shed": shed,
+    }
+
+
+def deterministic_sections():
+    """Everything the drift gate recomputes: hand-stepped, clockless,
+    single-threaded. The SAME function backs ``--evidence`` and
+    tests/test_overload.py::test_overload_evidence_r18_committed."""
+    from paddle_tpu.serving.decode import SamplingParams
+
+    preemption = [
+        _leg_greedy(),
+        _leg_greedy(SamplingParams(temperature=0.8, top_k=6, seed=7)),
+        _leg_beam(),
+        _leg_spec(),
+    ]
+    corruption = _leg_corruption()
+    ledger, ledger_measured = _leg_ledger()
+    brownout = _leg_brownout()
+    # the spec leg's park/resume COUNTS ride on wall-clock brownout
+    # state at admission; gate only its schedule-independent half
+    gated_preemption = []
+    for leg in preemption:
+        keep = {"mode", "requests", "bit_identical", "tokens_digest"}
+        if leg["mode"] != "spec":
+            keep |= {"parked", "resumed", "spills"}
+        gated_preemption.append(
+            {k: v for k, v in leg.items() if k in keep})
+    invariants = {
+        "preemption": gated_preemption,
+        "corruption": {k: corruption[k] for k in
+                       ("mode", "corrupted_entries", "bit_identical",
+                        "tokens_digest")},
+        "ledger": ledger,
+        "brownout": brownout,
+    }
+    measured = dict(ledger_measured)
+    measured["corruption_resume_replays"] = corruption["resume_replays"]
+    measured["corruption_corrupt_dropped"] = \
+        corruption["corrupt_dropped"]
+    measured["spec_parked"] = preemption[3]["parked"]
+    measured["spec_resumed"] = preemption[3]["resumed"]
+    return invariants, measured
+
+
+def check_invariants(invariants):
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    for leg in invariants["preemption"]:
+        check(leg["bit_identical"],
+              f"{leg['mode']}: BIT-IDENTITY VIOLATED across park/resume")
+        if "parked" in leg:
+            check(leg["parked"] >= 1 and leg["resumed"] >= 1,
+                  f"{leg['mode']}: no preemption happened "
+                  f"(parked={leg.get('parked')}) — the leg proved "
+                  "nothing")
+    check(invariants["corruption"]["bit_identical"],
+          "corruption walk-back: BIT-IDENTITY VIOLATED")
+    check(invariants["corruption"]["corrupted_entries"] >= 1,
+          "corruption walk-back: nothing was corrupted")
+    led = invariants["ledger"]
+    check(led["lost"] == 0 and led["failed"] == 0,
+          f"ledger: ZERO-LOSS VIOLATED — accepted {led['accepted']} "
+          f"completed {led['completed']} failed {led['failed']}")
+    check(led["bit_identical"], "ledger: BIT-IDENTITY VIOLATED")
+    bo = invariants["brownout"]
+    check(bo["peak"] == 4 and bo["final"] == 0,
+          f"brownout: ladder did not traverse L4 and return to L0 "
+          f"(peak={bo['peak']} final={bo['final']})")
+    check(len(bo["transitions"]) >= 5,
+          f"brownout: {len(bo['transitions'])} transitions — the "
+          "scripted trace should walk up once and down four times")
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--evidence", metavar="OUT.json",
+                    help="write the committed overload evidence file")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--skip-p99", action="store_true",
+                    help="deterministic sections only (the drift-gated "
+                         "half)")
+    args = ap.parse_args(argv)
+
+    invariants, measured = deterministic_sections()
+    failures = check_invariants(invariants)
+    if not args.skip_p99:
+        p99 = _leg_p99()
+        measured.update(p99)
+        if not p99["bounded"]:
+            failures.append(
+                f"p99-of-admitted {p99['p99_admitted_ms']}ms exceeds "
+                f"bound {p99['p99_bound_ms']}ms")
+    payload = {
+        "issue": 18,
+        "generated_by": ("python tools/overload_report.py --evidence "
+                         "OVERLOAD_EVIDENCE_r18.json"),
+        "drift_gates": [
+            "tests/test_overload.py::"
+            "test_overload_evidence_r18_committed",
+        ],
+        "invariants": invariants,
+        # informational: wall-clock / schedule-dependent, NOT gated
+        "measured": measured,
+    }
+    if args.evidence:
+        with open(args.evidence, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        led = invariants["ledger"]
+        print(f"wrote {args.evidence}: lost={led['lost']} "
+              f"modes_bit_identical="
+              f"{all(l['bit_identical'] for l in invariants['preemption'])} "
+              f"brownout_peak={invariants['brownout']['peak']}")
+    if args.as_json or not args.evidence:
+        print(json.dumps(payload, indent=None if args.as_json else 1))
+    if failures:
+        for f in failures:
+            print(f"OVERLOAD FAIL: {f}", file=sys.stderr)
+        return 1
+    print("OVERLOAD_REPORT_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

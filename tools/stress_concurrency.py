@@ -11,7 +11,8 @@ perturb thread interleavings:
     stalls (and for how long) is a pure function of (seed, L, n) —
     replaying a seed replays the exact stall schedule;
   * ``resilience.faults`` stall rules at the existing sites
-    (decode.step/prefill/inject/sample, lookup.pull/push, dataio.read)
+    (decode.step/prefill/inject/sample/spill/resume, lookup.pull/push,
+    dataio.read)
     with per-rule seeded probability.
 
 Every scenario asserts a BIT-EXACT property against an unstressed
@@ -175,12 +176,12 @@ def scenario_queue(seed, n_per_thread=60, threads=4):
 # ---------------------------------------------------------------------------
 
 
-def _small_decode_model(name, slots=2, max_len=10):
+def _small_decode_model(name, slots=2, max_len=10, **kw):
     from paddle_tpu.serving.decode import build_decoder_model
 
     return build_decoder_model(
         vocab_size=16, hidden=8, num_layers=1, slots=slots,
-        max_len=max_len, eos_id=None, name=name, version="1",
+        max_len=max_len, eos_id=None, name=name, version="1", **kw,
     )
 
 
@@ -213,7 +214,7 @@ def scenario_decode(seed, n_requests=6):
 
     faults.configure(_stall_rules(
         seed, ["decode.step", "decode.prefill", "decode.inject",
-               "decode.sample"]))
+               "decode.sample", "decode.spill", "decode.resume"]))
     try:
         engine.start()
         resps = {}
@@ -257,11 +258,55 @@ def scenario_decode(seed, n_requests=6):
     assert st["completed"] == n_requests + 1, st["completed"]
     assert st["failed"] == 0 and st["step_failures"] == 0
     assert st["sampled_tokens"] > 0
+    overload = _decode_overload_leg(seed)
     return {"requests": n_requests + 1,
             "decode_steps": st["decode_steps"],
             "sampled_tokens": st["sampled_tokens"],
             "beam_forks": st["beam_forks"],
-            "occupancy": round(st["occupancy"], 3)}
+            "occupancy": round(st["occupancy"], 3),
+            "parked": overload["parked"],
+            "resumed": overload["resumed"]}
+
+
+def _decode_overload_leg(seed):
+    """r18 preemption under the stall schedule: an undersized block
+    pool forces one of two in-flight sessions to park (KV rows spill to
+    the host tier through decode.spill) and resume (decode.resume) —
+    stalls inside the spill/re-inject window must not change a byte of
+    either stream."""
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving.decode import GenerationEngine
+
+    rng = random.Random((seed, "overload"))
+    prompts = [[rng.randrange(16) for _ in range(4)] for _ in range(2)]
+    engine = GenerationEngine(queue_depth=8, breaker_threshold=0)
+    entry = engine.register_model(lambda: _small_decode_model(
+        f"stress_ov{seed}", slots=2, max_len=16, block_size=2,
+        num_blocks=6))
+    refs = [entry.offline_decode(p, 6) for p in prompts]
+    faults.configure(_stall_rules(
+        seed, ["decode.step", "decode.prefill", "decode.inject",
+               "decode.sample", "decode.spill", "decode.resume"]))
+    try:
+        engine.start()
+        resps = [engine.submit(p, max_new_tokens=6) for p in prompts]
+        for i, resp in enumerate(resps):
+            got = [int(t) for t in resp.result(timeout=120)["tokens"]]
+            assert got == refs[i], (
+                f"seed {seed} overload request {i}: {got} != {refs[i]} "
+                f"— a spill/resume interleaving changed the answer")
+        entry.block_pool.check_conservation()
+    finally:
+        faults.reset()
+        engine.shutdown()
+    st = entry.stats()
+    # both prompts decode to 10 tokens against a 12-row pool: mid-gen
+    # exhaustion parks (never fails) — the pool CAN fit each alone
+    assert st["sessions_parked"] >= 1 and st["sessions_resumed"] >= 1, st
+    assert st["failed"] == 0, st
+    assert st["host_tier"]["spills"] >= 1, st["host_tier"]
+    return {"parked": st["sessions_parked"],
+            "resumed": st["sessions_resumed"]}
 
 
 # ---------------------------------------------------------------------------
@@ -440,6 +485,26 @@ def _drive_decode_evidence():
         entry._iterate()
     assert b.done() and s.done()
     entry.block_pool.check_conservation()
+    # r18 overload on this same thread: an undersized pool parks one of
+    # two in-flight sessions — the spill write-back runs tier.put under
+    # decode.blocks, witnessing the declared decode.blocks ->
+    # decode.tier edge; the resume walks it again via the host tier
+    ov = engine.register_model(
+        lambda: _small_decode_model("evidence_ov", slots=2, max_len=16,
+                                    block_size=2, num_blocks=6))
+    o1 = engine.submit([1, 2, 3, 4], max_new_tokens=6,
+                       model="evidence_ov")
+    o2 = engine.submit([5, 6, 7, 8], max_new_tokens=6,
+                       model="evidence_ov")
+    for _ in range(40):
+        if o1.done() and o2.done():
+            break
+        ov._iterate()
+    assert o1.done() and o2.done()
+    assert o1.error() is None and o2.error() is None
+    ost = ov.stats()
+    assert ost["sessions_parked"] >= 1 and ost["sessions_resumed"] >= 1
+    ov.block_pool.check_conservation()
     engine.stats()
 
 
