@@ -219,6 +219,11 @@ def _bench():
             extra["cost"] = _bench_cost(main_prog, data, fetches)
         except Exception as e:
             extra["cost"] = {"error": str(e)[:300]}
+    if not os.environ.get("PADDLE_TPU_BENCH_NO_PIPELINE"):
+        try:
+            extra["pipeline"] = _bench_pipeline()
+        except Exception as e:
+            extra["pipeline"] = {"error": str(e)[:300]}
     _emit(
         round(tokens_per_sec, 1),
         round(mfu / 0.5, 4),  # vs the >=50% MFU north star
@@ -249,6 +254,29 @@ def _bench_cost(main_prog, data, fetches):
         "bound_counts": rep.bound_counts(),
         "unknown_ops": sorted(rep.unknown_ops),
     }
+
+
+def _bench_pipeline():
+    """Pipeline-schedule evidence for `extra` (r20): the compiled slot
+    tables at the COST_EVIDENCE operating point (4 stages x 4
+    microbatches) — predicted vs table-walk realized bubble per schedule.
+    Pure schedule-compiler arithmetic, no devices; the realized numbers
+    must match PIPELINE_EVIDENCE_r20.json's step accounting."""
+    from paddle_tpu.parallel.pipeline_runtime.schedule import (
+        compile_schedule,
+    )
+
+    out = {"stages": 4, "num_microbatches": 4, "schedules": {}}
+    for kind in ("gpipe", "1f1b"):
+        sched = compile_schedule(kind, 4, 4)
+        out["schedules"][kind] = {
+            "interleave": sched.interleave,
+            "ticks": sched.num_ticks,
+            "predicted_bubble": round(sched.predicted(), 6),
+            "realized_bubble": round(sched.realized_bubble(), 6),
+            "peak_stash_slots": sched.peak_stash_slots(),
+        }
+    return out
 
 
 def _bench_decode():

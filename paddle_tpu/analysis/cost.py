@@ -526,6 +526,34 @@ def _sdpa_flops(ctx):
     return 4 * nq * s, nq
 
 
+def _moe_ffn_flops(ctx):
+    """moe_ffn (dense path): gate matmul + dispatch/combine einsums over
+    the [E, cap, H] capacity buffer + the two expert matmuls, with the
+    capacity defaulted exactly as ops/moe.py computes it
+    (``capacity or max(int(cf * T * 2 / E), 4)``). Gating softmax and
+    the expert activation go under transcendentals."""
+    x = ctx.in_shape("X")
+    gw = ctx.in_shape("GateW")
+    w1 = ctx.in_shape("W1")
+    fallback = 2 * (ctx.out_numel() or 0)
+    if x is None or gw is None or len(gw) < 2 or w1 is None:
+        return fallback, 0
+    h, e, f = gw[0], gw[1], w1[-1]
+    t = _numel(x)
+    if any(is_sym(d) for d in (h, e, f)) or not t or not h:
+        return fallback, 0
+    h, e, f = int(h), int(e), int(f)
+    t //= h
+    cap = int(ctx.op.attrs.get("capacity", 0) or 0)
+    if not cap:
+        cf = float(ctx.op.attrs.get("capacity_factor", 2.0) or 2.0)
+        cap = max(int(cf * t * 2 / e), 4)
+    gate = 2 * t * h * e
+    route = 4 * t * e * cap * h      # dispatch + combine dot-generals
+    expert = 4 * e * cap * h * f     # the two FFN matmuls per expert
+    return gate + route + expert, t * e + e * cap * f
+
+
 #: op type -> rule. A type absent here is priced by the default
 #: elementwise rule AND recorded in CostReport.unknown_ops — the
 #: property test pins unknown_ops == [] on every examples/ program.
@@ -541,6 +569,8 @@ _FLOP_RULES = {
     "scaled_dot_product_attention": _sdpa_flops,
     "scaled_dot_product_attention_grad":
         lambda ctx: tuple(2 * v for v in _sdpa_flops(ctx)),
+    "moe_ffn": _moe_ffn_flops,
+    "moe_ffn_grad": lambda ctx: tuple(2 * v for v in _moe_ffn_flops(ctx)),
     # layout / copies / bookkeeping: bytes, no flops
     "reshape2": _zero, "reshape": _zero, "reshape2_grad": _zero,
     "reshape_grad": _zero, "transpose2": _zero, "transpose": _zero,
@@ -1041,12 +1071,24 @@ def pipeline_bubble_report(program, *, shape_report=None, axis_sizes=None,
                 if info is not None and info.shape and \
                         not is_sym(info.shape[0]):
                     layers = int(info.shape[0])
-            bubble = (s - 1) / (m + s - 1) if s > 1 else 0.0
+            # schedule-aware (PipelinedStack(schedule=...)); programs
+            # with the default gpipe attr keep the exact committed
+            # COST_EVIDENCE_r16 entry, byte for byte
+            kind = op.attrs.get("schedule") or "gpipe"
+            if kind != "gpipe" and s > 1:
+                from paddle_tpu.parallel.pipeline_runtime.schedule import (
+                    predicted_bubble,
+                )
+
+                bubble = predicted_bubble(
+                    kind, s, m, op.attrs.get("interleave") or 2)
+            else:
+                bubble = (s - 1) / (m + s - 1) if s > 1 else 0.0
             out.append({
                 "op_index": op_index, "block": blk.idx,
                 "stage_axis": stage_axis, "stages": s,
                 "num_microbatches": m, "layers": layers,
-                "schedule": "gpipe",
+                "schedule": kind,
                 "bubble_fraction": round(bubble, 6),
             })
     return out

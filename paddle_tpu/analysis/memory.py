@@ -277,6 +277,60 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
                 extra[j] += saved
         return extra
 
+    def pipeline_extra(blk):
+        """Per-op-point activation-stash bytes of each pipeline_stack's
+        compiled schedule (pipeline_runtime/schedule.py liveness walk),
+        live across the fwd op -> its grad op, the span the microbatch
+        residuals survive — priced pre-compile exactly like remat. A
+        stage-less run (no mesh / stage axis 1) has no schedule and no
+        stash beyond normal liveness."""
+        extra = [0] * len(blk.ops)
+        for fi, op in enumerate(blk.ops):
+            if op.type != "pipeline_stack":
+                continue
+            stage_axis = op.attrs.get("stage_axis", "stage")
+            s = int(axis_sizes.get(stage_axis, 1) or 1)
+            if s <= 1:
+                continue
+            m = int(op.attrs.get("num_microbatches", 1) or 1)
+            xn = (op.inputs.get("X") or [None])[0]
+            stacked = op.inputs.get("StackedParams") or ()
+            if xn is None or not stacked:
+                continue
+            xb = bytes_of(xn, blk)
+            info = shape_report.get(stacked[0])
+            if xb is None or info is None or not info.shape or \
+                    is_sym(info.shape[0]):
+                continue
+            layers = int(info.shape[0])
+            from paddle_tpu.parallel.pipeline_runtime.memory import (
+                schedule_stash_bytes,
+            )
+            from paddle_tpu.parallel.pipeline_runtime.schedule import (
+                compile_schedule,
+            )
+
+            try:
+                sched = compile_schedule(
+                    op.attrs.get("schedule") or "gpipe", s, m,
+                    op.attrs.get("interleave"))
+            except ValueError:
+                continue
+            stash = schedule_stash_bytes(sched, xb // max(m, 1), layers)
+            # span: fwd op to its grad op (the residual lifetime); the
+            # grad op reads the fwd op's output-grads
+            outs = set(op.output_names())
+            gi = None
+            for j in range(len(blk.ops) - 1, fi, -1):
+                if blk.ops[j].type == "pipeline_stack_grad" and \
+                        outs & {n.replace("@GRAD", "")
+                                for n in blk.ops[j].input_names()}:
+                    gi = j
+                    break
+            for j in range(fi, (gi if gi is not None else fi) + 1):
+                extra[j] += stash
+        return extra
+
     def block_peak(blk, fetches, top=False):
         ud = usedef if top else UseDefMap(blk)
         live_after = [set() for _ in blk.ops]
@@ -294,10 +348,11 @@ def estimate_peak_hbm(program, *, feed_shapes=None, fetch_names=(),
             report.peak_op_index, report.peak_op_type = -1, "<entry>"
             report.timeline.append((-1, "<entry>", peak))
         extra = remat_extra(blk)
+        pextra = pipeline_extra(blk)
         for i, op in enumerate(blk.ops):
             if op.type in ("feed", "fetch"):
                 continue
-            b = live_bytes(blk, live_after[i]) + extra[i]
+            b = live_bytes(blk, live_after[i]) + extra[i] + pextra[i]
             b += fused_internal(op)
             for bi in sub_block_indices(op):
                 if bi not in sub_peaks:

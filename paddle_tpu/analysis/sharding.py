@@ -540,13 +540,39 @@ def analyze_sharding(program, mesh, *, spec_layout=None, param_rules=None,
             continue
         dt = dtype_of(name)
         if data_size > 1 and name in read:
-            # gradient synchronization over the data axes: bytes = the
-            # parameter's SHARD (this is why layout sharding shrinks wire);
-            # the ring spans EVERY axis the feeds shard over (dp×dcn runs
-            # sync across both tiers — what the hierarchical linter prices)
-            emit("all-reduce", "grad-sync", name,
-                 _shard_bytes(shape, spec, axis_sizes, dt), shape,
-                 _spec_str(spec), axes=data_axes or {batch_axis})
+            sync_axes = set(data_axes or {batch_axis})
+            zero_axes = _spec_axes(spec) & sync_axes
+            if zero_axes:
+                # ZeRO layout: the parameter is sharded over (some of) the
+                # feed-sharded axes, so GSPMD lowers its grad sync as a
+                # reduce-scatter over those axes plus an all-reduce of the
+                # 1/n shard over the REST — the two-level hierarchy the
+                # dcn linter asks for when the rest is the dcn tier. The
+                # decomposed events carry their own axes, so the
+                # hierarchical diagnostic (which prices all-reduces whose
+                # span mixes dcn with >1 ici device) stays quiet.
+                rs_spec = tuple(
+                    (tuple(a for a in (e or ()) if a not in zero_axes)
+                     or None)
+                    for e in (spec or ())
+                ) or None
+                emit("reduce-scatter", "grad-sync", name,
+                     _shard_bytes(shape, rs_spec, axis_sizes, dt), shape,
+                     _spec_str(spec), axes=sorted(zero_axes))
+                rest = sync_axes - zero_axes
+                if rest:
+                    emit("all-reduce", "grad-sync", name,
+                         _shard_bytes(shape, spec, axis_sizes, dt), shape,
+                         _spec_str(spec), axes=sorted(rest))
+            else:
+                # gradient synchronization over the data axes: bytes = the
+                # parameter's SHARD (this is why layout sharding shrinks
+                # wire); the ring spans EVERY axis the feeds shard over
+                # (dp×dcn runs sync across both tiers — what the
+                # hierarchical linter prices)
+                emit("all-reduce", "grad-sync", name,
+                     _shard_bytes(shape, spec, axis_sizes, dt), shape,
+                     _spec_str(spec), axes=data_axes or {batch_axis})
         if tensor_sharded and _is_replicated(spec) and len(shape) >= 1:
             # replicated parameter in a tensor-sharded program: its update
             # is computed shard-local (the activations feeding its grad

@@ -172,6 +172,16 @@ _SIGNATURES = {
     "square": OpSignature(dtype_family={"X": "float"}),
     "clip": OpSignature(),
     "expand": OpSignature(),
+    # r20 pipeline/MoE surface: pipeline_stack wraps a sub-block (the
+    # per-layer body is verified op-by-op through its own block), so the
+    # wrapper itself only pins the carried activation dtype; moe_ffn ties
+    # the routed activations to the stacked expert weights
+    "pipeline_stack": OpSignature(dtype_family={"X": "float"}),
+    "moe_ffn": OpSignature(
+        same_dtype=[("X", "GateW", "W1", "W2")],
+        dtype_family={"X": "float"},
+        ranks={"GateW": 2, "W1": 3, "B1": 2, "W2": 3, "B2": 2},
+    ),
 }
 
 

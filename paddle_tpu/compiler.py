@@ -147,6 +147,8 @@ class CompiledProgram:
         self._param_overrides = None  # exact name -> spec
         self._input_specs = None      # feed name -> spec (default: batch on 'data')
         self._axis_tags = None        # mesh axis -> 'ici'|'dcn' (cost stage)
+        self._pipeline_schedule = None   # 'gpipe'|'1f1b' (pipeline_stack)
+        self._pipeline_interleave = None  # 1f1b chunks/device (default 2)
         self._spec_layout = None      # SpecLayout | False (off) | None (auto)
         self._auto_layout_cache = {}  # (prog uid, version) -> SpecLayout|None
 
@@ -182,6 +184,8 @@ class CompiledProgram:
         input_specs=None,
         spec_layout=None,
         axis_tags=None,
+        pipeline_schedule=None,
+        pipeline_interleave=None,
     ):
         # spec_layout contract: an instance/True = that registry;
         # False = placement stays exactly as passed (pre-PR-9 behavior);
@@ -215,6 +219,25 @@ class CompiledProgram:
         # static diagnostic stage's two-level collective model; declaring
         # a 'dcn' axis arms the hierarchical-collective linter as an error
         self._axis_tags = dict(axis_tags) if axis_tags else None
+        # pipeline_schedule: schedule choice for pipeline_stack ops —
+        # compile-cache CONTENT (joins the cheap key and the lowering
+        # fingerprint), bound to the lowering via schedule_override so the
+        # op and the cache key can never disagree. Validated eagerly so a
+        # typo fails here, not mid-trace.
+        if pipeline_schedule is not None:
+            from paddle_tpu.parallel.pipeline_runtime.schedule import (
+                SCHEDULE_KINDS,
+            )
+
+            if pipeline_schedule not in SCHEDULE_KINDS:
+                raise EnforceError(
+                    f"with_parallel: unknown pipeline_schedule "
+                    f"{pipeline_schedule!r}; kinds are {SCHEDULE_KINDS}"
+                )
+        self._pipeline_schedule = pipeline_schedule
+        self._pipeline_interleave = (
+            int(pipeline_interleave) if pipeline_interleave else None
+        )
         if spec_layout is True:
             from paddle_tpu.parallel.spec_layout import SpecLayout
 
@@ -404,7 +427,8 @@ class CompiledProgram:
         # resolved kernel mode joins the cheap key (see executor.py)
         key = (self._program._uid, self._program._version, feed_sig,
                tuple(fetch_names), dgc_sparse,
-               _kernel_registry.resolved_mode())
+               _kernel_registry.resolved_mode(),
+               self._pipeline_schedule, self._pipeline_interleave)
         entry = self._cache.get(key)
         if dgc_sparse:
             # expand U/V accumulators to per-shard [n, ...] state; runs on
@@ -604,7 +628,11 @@ class CompiledProgram:
                     "input_specs": self._input_specs,
                     "axis_tags": self._axis_tags,
                 },
-                extra_fingerprint=(("dgc", dgc_sparse),),
+                extra_fingerprint=(
+                    ("dgc", dgc_sparse),
+                    ("pipe_sched", self._pipeline_schedule,
+                     self._pipeline_interleave),
+                ),
                 label="compiled_program",
             )
             entry.meta["scope_shardings"] = scope_shardings
@@ -639,14 +667,22 @@ class CompiledProgram:
         )
         rng_key = exe._next_rng_key(self._program)
         from paddle_tpu.parallel.env import mesh_context
+        from paddle_tpu.parallel.pipeline_runtime.runtime import (
+            schedule_override,
+        )
 
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             # mesh context: nested-shard_map ops (pipeline_stack) find the
-            # mesh during tracing, which happens inside this first call
+            # mesh during tracing, which happens inside this first call;
+            # the schedule override rides the same window so the choice in
+            # the cache key is the choice the op lowers
             span = ("compiled_program::trace_compile_execute"
                     if not entry.executed else "compiled_program::execute")
-            with mesh_context(mesh), trace_scope(span):
+            with mesh_context(mesh), \
+                    schedule_override(self._pipeline_schedule,
+                                      self._pipeline_interleave), \
+                    trace_scope(span):
                 fetches, updates = compiled(
                     feed_vals, donated_vals, readonly_vals, rng_key
                 )

@@ -36,6 +36,10 @@ class DistributedStrategy(BuildStrategy):
         # here) makes the hierarchical-allreduce linter a hard error
         self.mesh_axis_tags = None
         self.param_rules = None      # Megatron-style TP rule table
+        # pipeline_stack schedule: 'gpipe' | '1f1b' (+ interleave degree);
+        # run-time choice, joined into the compile-cache fingerprint
+        self.pipeline_schedule = None
+        self.pipeline_interleave = None
         self.param_specs = None      # exact name -> PartitionSpec
         self.input_specs = None      # feed name -> PartitionSpec
         # canonical sharding layer (parallel/spec_layout.py): a SpecLayout
@@ -100,6 +104,8 @@ class CollectiveOptimizer(DistributedOptimizer):
             input_specs=strategy.input_specs,
             spec_layout=strategy.spec_layout,
             axis_tags=strategy.mesh_axis_tags,
+            pipeline_schedule=strategy.pipeline_schedule,
+            pipeline_interleave=strategy.pipeline_interleave,
         )
         fleet._main_program = compiled
         return optimize_ops, params_grads

@@ -45,12 +45,26 @@ __all__ = ["PipelinedStack"]
 
 class PipelinedStack:
     def __init__(self, num_layers, num_microbatches=1, stage_axis="stage",
-                 ring_bindings=None, name=None):
+                 ring_bindings=None, schedule="gpipe", interleave=None,
+                 name=None):
         self.helper = LayerHelper("pipelined_stack", name=name)
         self.program = default_main_program()
         self.num_layers = int(num_layers)
         self.num_microbatches = int(num_microbatches)
         self.stage_axis = stage_axis
+        # schedule: 'gpipe' | '1f1b' (interleaved; `interleave` chunks per
+        # device, default 2). A program attr here is the DEFAULT — the
+        # run-time choice `with_parallel(pipeline_schedule=...)` overrides
+        # it and joins the compile-cache fingerprint (pipeline_runtime/).
+        from paddle_tpu.parallel.pipeline_runtime.schedule import (
+            SCHEDULE_KINDS,
+        )
+
+        enforce(schedule in SCHEDULE_KINDS,
+                f"PipelinedStack schedule must be one of {SCHEDULE_KINDS},"
+                f" got {schedule!r}")
+        self.schedule = schedule
+        self.interleave = int(interleave) if interleave else None
         # ring_id -> mesh axis for collectives inside the body (TP psum)
         self.ring_bindings = dict(ring_bindings or {})
         self._entered = False
@@ -166,6 +180,8 @@ class PipelinedStack:
                 "num_microbatches": self.num_microbatches,
                 "stage_axis": self.stage_axis,
                 "ring_bindings": self.ring_bindings,
+                "schedule": self.schedule,
+                "interleave": self.interleave,
             },
         )
         self._result = out

@@ -68,6 +68,23 @@ def _pipeline_stack(ins, attrs):
     L = stacked[0].shape[0]
     layer_ids = jnp.arange(L)
 
+    # schedule choice: op attr (PipelinedStack(schedule=...)), overridden
+    # by with_parallel(pipeline_schedule=...) via the thread-local the
+    # compiler binds around lowering — the same value it joined into the
+    # compile-cache fingerprint
+    from paddle_tpu.parallel.pipeline_runtime.runtime import (
+        current_schedule_override,
+    )
+
+    schedule_kind = attrs.get("schedule") or "gpipe"
+    interleave = attrs.get("interleave")
+    ov_kind, ov_v = current_schedule_override()
+    if ov_kind is not None:
+        schedule_kind = ov_kind
+        interleave = ov_v if ov_v is not None else None
+    elif ov_v is not None:
+        interleave = ov_v
+
     from paddle_tpu.parallel.env import current_mesh
 
     mesh = current_mesh()
@@ -78,7 +95,11 @@ def _pipeline_stack(ins, attrs):
     )
 
     if not on_mesh:
-        # degenerate path: scan the stacked layers over the full batch
+        # degenerate path: the SAME microbatch loop, minus the ring — per
+        # microbatch, scan the stacked layers. Looping microbatches (not
+        # scanning the full batch) keeps the per-gemm shapes identical to
+        # the pipelined arms, so single-device parity is BITWISE, not
+        # just allclose (the evidence gate's no-pipeline reference).
         body = _body_runner(
             sub, inner_x, inner_out, param_inner, ex, bindings, rng
         )
@@ -86,6 +107,15 @@ def _pipeline_stack(ins, attrs):
         def layer(h, p):
             return body(p, h), None
 
+        if num_mb > 1 and x.shape[0] % num_mb == 0:
+            from paddle_tpu.parallel.pipeline import split_microbatches
+
+            def run_mb(_, xm):
+                out, __ = lax.scan(layer, xm, (layer_ids, *stacked))
+                return _, out
+
+            _, outs = lax.scan(run_mb, 0, split_microbatches(x, num_mb))
+            return {"Out": [outs.reshape(x.shape)]}
         out, _ = lax.scan(layer, x, (layer_ids, *stacked))
         return {"Out": [out]}
 
@@ -93,6 +123,27 @@ def _pipeline_stack(ins, attrs):
         pipeline_apply,
         split_microbatches,
     )
+    from paddle_tpu.parallel.pipeline_runtime.runtime import (
+        interleave_permutation,
+        pipeline_apply_interleaved,
+    )
+    from paddle_tpu.parallel.pipeline_runtime.schedule import (
+        compile_schedule,
+    )
+
+    n_stage = mesh.shape[stage_axis]
+    # validates the (kind, stages, microbatches, interleave) tuple — a
+    # contention-ful 1f1b config fails HERE, pre-trace, with the why
+    sched = compile_schedule(schedule_kind, n_stage, num_mb, interleave)
+    if sched.kind == "1f1b":
+        # circular virtual-stage assignment: permute stacked rows (and
+        # layer_ids with them, so per-layer RNG folds follow the layer)
+        # BEFORE the P(stage) shard — device d holds chunks d, d+s, ...
+        perm = jnp.asarray(
+            interleave_permutation(L, n_stage, sched.interleave)
+        )
+        stacked = [p[perm] for p in stacked]
+        layer_ids = layer_ids[perm]
 
     # per-param specs for the non-stage dims (TP etc.), leading dim 'stage'
     extra_specs = attrs.get("param_specs") or [()] * len(stacked)
@@ -117,10 +168,16 @@ def _pipeline_stack(ins, attrs):
             sub, inner_x, inner_out, param_inner, ex_local, bindings, rng
         )
         x_mb = split_microbatches(x, num_mb)
-        outs = pipeline_apply(
-            body, (layer_ids, *stacked), x_mb, stage_axis,
-            collect="broadcast",
-        )
+        if sched.kind == "1f1b":
+            outs = pipeline_apply_interleaved(
+                body, (layer_ids, *stacked), x_mb, stage_axis,
+                sched.interleave, collect="broadcast",
+            )
+        else:
+            outs = pipeline_apply(
+                body, (layer_ids, *stacked), x_mb, stage_axis,
+                collect="broadcast",
+            )
         return outs.reshape(x.shape)
 
     out = _shard_map(

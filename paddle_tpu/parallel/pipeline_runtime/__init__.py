@@ -1,0 +1,58 @@
+"""Pipeline runtime subsystem: real schedules for `pipeline_stack`.
+
+PR 16 landed the static half of ROADMAP item 3 — `pipeline_bubble_report`
+commits the GPipe `(s-1)/(m+s-1)` bubble of every `pipeline_stack`
+pre-compile, mesh axes carry `ici`/`dcn` tags, and the
+`dcn-allreduce-not-hierarchical` linter prices the two-level saving. This
+package is the runtime half:
+
+* `schedule`  — a schedule compiler emitting per-(stage, microbatch, phase)
+  slot tables for `gpipe` and interleaved `1f1b`, with realized-bubble step
+  accounting and activation-stash liveness the memory analyzer prices
+  pre-compile exactly like remat.
+* `runtime`   — the interleaved circular execution over shard_map +
+  collective_permute (every device hosts `interleave` model chunks; a
+  microbatch laps the stage ring `interleave` times), composing with the
+  dp×fsdp×tp SpecLayout registry.
+* `hierarchy` — DCN×ICI two-level meshes: the grad-sync layout that
+  realizes the linted hierarchy (reduce-scatter over ICI, all-reduce of the
+  1/ici shard over DCN) and the optimized-HLO DCN-byte report asserting it.
+
+The schedule choice is compile-cache content: `CompiledProgram.
+with_parallel(pipeline_schedule=..., pipeline_interleave=...)` joins it
+into the lowering fingerprint the same way `kernel_sig`/`layout_sig` do,
+so flipping `gpipe`↔`1f1b` retraces and an identical config hits the
+memory tier.
+"""
+
+from paddle_tpu.parallel.pipeline_runtime.schedule import (
+    SCHEDULE_KINDS,
+    Schedule,
+    Slot,
+    compile_schedule,
+    predicted_bubble,
+)
+from paddle_tpu.parallel.pipeline_runtime.runtime import (
+    interleave_permutation,
+    pipeline_apply_interleaved,
+)
+from paddle_tpu.parallel.pipeline_runtime.hierarchy import (
+    dcn_crossing_collective_bytes,
+    hierarchical_param_axis,
+)
+from paddle_tpu.parallel.pipeline_runtime.memory import (
+    schedule_stash_bytes,
+)
+
+__all__ = [
+    "SCHEDULE_KINDS",
+    "Schedule",
+    "Slot",
+    "compile_schedule",
+    "predicted_bubble",
+    "pipeline_apply_interleaved",
+    "interleave_permutation",
+    "hierarchical_param_axis",
+    "dcn_crossing_collective_bytes",
+    "schedule_stash_bytes",
+]

@@ -1,0 +1,30 @@
+"""Activation-stash pricing for compiled schedules.
+
+The schedule table (schedule.py) already knows liveness — a forward
+slot's residuals stay resident until its mirrored backward slot runs, so
+`Schedule.peak_stash_slots()` is the exact peak count of concurrently
+live (chunk, microbatch) stashes on the worst device. This module turns
+slots into bytes so `analysis/memory.py` can price the pipeline stash
+pre-compile the way it prices remat: honestly. Interleaving buys BUBBLE,
+not stash — under the vjp-transposed backward all m*v chunk residuals of
+a device are live across the fwd->bwd flush, and the numbers here say so
+rather than advertising a saving the runtime does not deliver.
+"""
+
+__all__ = ["schedule_stash_bytes"]
+
+
+def schedule_stash_bytes(schedule, per_layer_activation_bytes,
+                         num_layers):
+    """Peak activation-stash bytes on the worst stage device.
+
+    One stash slot = one (chunk, microbatch) forward pass = one saved
+    residual per layer of the chunk, so bytes = peak_stash_slots *
+    layers_per_chunk * per_layer_activation_bytes, where
+    ``per_layer_activation_bytes`` is the per-MICROBATCH activation size
+    flowing between layers (batch already divided by num_microbatches).
+    """
+    k_total = schedule.num_stages * schedule.interleave
+    layers_per_chunk = max(1, int(num_layers) // max(1, k_total))
+    return int(schedule.peak_stash_slots() * layers_per_chunk *
+               int(per_layer_activation_bytes))

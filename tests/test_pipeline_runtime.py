@@ -1,0 +1,403 @@
+"""Pipeline runtime subsystem (ISSUE 20): schedule compiler slot tables,
+interleaved 1F1B runtime numerics, schedule-as-cache-content, DCN x ICI
+hierarchical grad-sync decomposition, stash pricing, and the
+PIPELINE_EVIDENCE_r20 drift gates.
+
+reference: python/paddle/fluid/optimizer.py:3414 PipelineOptimizer — the
+reference schedules pipeline sections across process groups; here the
+schedule is a compiled slot table executed inside one shard_map step.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.env import make_mesh
+from paddle_tpu.parallel.pipeline_runtime import (
+    compile_schedule,
+    interleave_permutation,
+    predicted_bubble,
+    schedule_stash_bytes,
+)
+from paddle_tpu.utils.enforce import EnforceError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# schedule compiler: closed forms, slot tables, memoization
+# ---------------------------------------------------------------------------
+
+
+def test_predicted_bubble_closed_forms():
+    # gpipe: (s-1)/(m+s-1) = 3/7 at 4x4
+    assert predicted_bubble("gpipe", 4, 4) == pytest.approx(3 / 7)
+    # interleaved 1f1b: ((v-1)(s-m)+s-1)/(m+s*v-1) = 3/11 at 4x4 v=2
+    assert predicted_bubble("1f1b", 4, 4, 2) == pytest.approx(3 / 11)
+    # one stage never bubbles
+    assert predicted_bubble("gpipe", 1, 4) == 0.0
+
+
+def test_schedule_tables_realize_the_closed_form():
+    for kind, v, slots_per_phase in (("gpipe", 1, 16), ("1f1b", 2, 32)):
+        sched = compile_schedule(kind, 4, 4, v if v > 1 else None)
+        assert len(sched.fwd_slots()) == slots_per_phase
+        assert len(sched.slots) == 2 * slots_per_phase
+        # stage_timeline asserts collision-freedom internally
+        for d in range(4):
+            line = sched.stage_timeline(d)
+            assert len(line) == sched.num_ticks
+        assert sched.realized_bubble() == pytest.approx(sched.predicted())
+
+
+def test_schedule_stash_slots_and_bytes_invariant():
+    """Interleave buys bubble, NOT stash: v scales the slot count but
+    shrinks the per-chunk layer count — bytes are identical."""
+    gp = compile_schedule("gpipe", 4, 4)
+    il = compile_schedule("1f1b", 4, 4, 2)
+    assert gp.peak_stash_slots() == 4
+    assert il.peak_stash_slots() == 8
+    per_mb = 512  # one microbatch's activation bytes
+    assert schedule_stash_bytes(gp, per_mb, 8) == \
+        schedule_stash_bytes(il, per_mb, 8) == 4096
+
+
+def test_compile_schedule_validates_and_memoizes():
+    with pytest.raises(ValueError):
+        compile_schedule("1f1b", 4, 8, 2)  # m > s: contention
+    with pytest.raises(ValueError):
+        compile_schedule("gpipe", 4, 4, 2)  # gpipe has no interleave
+    with pytest.raises(ValueError):
+        compile_schedule("zigzag", 4, 4)
+    a = compile_schedule("1f1b", 4, 4, 2)
+    b = compile_schedule("1f1b", 4, 4, 2)
+    assert a is b
+    assert a.fingerprint() == "1f1b:s4:m4:v2"
+
+
+def test_interleave_permutation_round_robin():
+    # L=8, S=4, v=2: device d holds chunks (d, d+4) -> row-major perm
+    assert list(interleave_permutation(8, 4, 2)) == [0, 4, 1, 5, 2, 6, 3, 7]
+    # v=1 is the identity (contiguous gpipe placement)
+    assert list(interleave_permutation(8, 4, 1)) == list(range(8))
+    with pytest.raises(EnforceError):
+        interleave_permutation(4, 4, 2)  # 4 % (4*2) != 0
+
+
+def test_invalid_schedule_rejected_at_build_time():
+    with pytest.raises(EnforceError):
+        fluid.layers.PipelinedStack(
+            num_layers=8, num_microbatches=4, schedule="zigzag"
+        )
+
+
+# ---------------------------------------------------------------------------
+# hierarchical grad-sync: analyzer decomposition + linter + HLO parser
+# ---------------------------------------------------------------------------
+
+
+def _mlp_16():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 16])
+        y = fluid.data("y", shape=[-1, 16])
+        h = fluid.layers.fc(x, size=32, act="relu", name="mlp.fc1")
+        p = fluid.layers.fc(h, size=16, name="mlp.fc2")
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(p, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_hierarchical_grad_sync_decomposition():
+    """ZeRO-sharding params over the ICI data axis turns the flat
+    two-tier all-reduce into reduce-scatter(ICI) + all-reduce(DCN shard)
+    in the analyzer's predicted events."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.analysis.sharding import analyze_sharding
+
+    mesh = make_mesh((2, 4), ("dcn", "data"))
+    ispec = {"x": P(("dcn", "data")), "y": P(("dcn", "data"))}
+    fs = {"x": (16, 16), "y": (16, 16)}
+
+    main, _s, _l = _mlp_16()
+    naive = analyze_sharding(main, mesh, input_specs=ispec, feed_shapes=fs)
+    gs = [e for e in naive.events if e.cause == "grad-sync"]
+    assert gs and all(e.kind == "all-reduce" for e in gs)
+    assert all(set(e.axes) == {"dcn", "data"} for e in gs)
+
+    main, _s, _l = _mlp_16()
+    pspecs = {p.name: P("data") for p in main.all_parameters()}
+    zero = analyze_sharding(main, mesh, param_specs=pspecs,
+                            input_specs=ispec, feed_shapes=fs)
+    gsz = [e for e in zero.events if e.cause == "grad-sync"]
+    kinds = {e.kind for e in gsz}
+    assert kinds == {"reduce-scatter", "all-reduce"}
+    for e in gsz:
+        if e.kind == "reduce-scatter":
+            assert set(e.axes) == {"data"}
+        else:
+            assert set(e.axes) == {"dcn"}
+    # the DCN payload shrinks by the ICI degree: the all-reduce moves
+    # 1/4 of what the reduce-scatter reduced
+    rs = {e.var: e.bytes for e in gsz if e.kind == "reduce-scatter"}
+    ar = {e.var: e.bytes for e in gsz if e.kind == "all-reduce"}
+    assert set(rs) == set(ar)
+    for var, full in rs.items():
+        assert ar[var] == full // 4, (var, full, ar[var])
+
+
+def test_hierarchical_linter_fires_naive_silent_on_decomposed():
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.analysis.cost import (
+        analyze_cost,
+        hierarchical_collective_diagnostics,
+    )
+
+    mesh_args = dict(
+        mesh=make_mesh((2, 4), ("dcn", "data")),
+        axis_tags={"dcn": "dcn", "data": "ici"},
+        input_specs={"x": P(("dcn", "data")), "y": P(("dcn", "data"))},
+        feed_shapes={"x": (16, 16), "y": (16, 16)},
+    )
+    main, _s, loss = _mlp_16()
+    naive = analyze_cost(main, fetch_names=[loss.name], **mesh_args)
+    assert hierarchical_collective_diagnostics(naive)
+
+    main, _s, loss = _mlp_16()
+    pspecs = {p.name: P("data") for p in main.all_parameters()}
+    zero = analyze_cost(main, fetch_names=[loss.name],
+                        param_specs=pspecs, **mesh_args)
+    assert hierarchical_collective_diagnostics(zero) == []
+
+
+def test_replica_group_parser_forms():
+    from paddle_tpu.parallel.pipeline_runtime.hierarchy import (
+        _parse_replica_groups,
+    )
+
+    expl = _parse_replica_groups(
+        "all-reduce(f32[16]), replica_groups={{0,2},{1,3}}")
+    assert expl == [[0, 2], [1, 3]]
+    # iota form: [2,4]<=[8] is 2 groups of 4, row-major
+    iota = _parse_replica_groups(
+        "all-gather(f32[4]), replica_groups=[2,4]<=[8]")
+    assert iota == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # iota with transpose: [4,2]<=[2,4]T(1,0) pairs (i, i+4)
+    tr = _parse_replica_groups(
+        "all-reduce(f32[4]), replica_groups=[4,2]<=[2,4]T(1,0)")
+    assert tr == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    # collective-permute edges parse as 2-member groups; self-edges
+    # collapse to one device (never crossing)
+    perm = _parse_replica_groups(
+        "collective-permute(f32[4]), "
+        "source_target_pairs={{0,2},{2,0},{1,1}}")
+    assert perm == [[0, 2], [0, 2], [1]]
+    # unparseable -> None (callers count it as crossing, never under)
+    assert _parse_replica_groups("all-reduce(f32[4])") is None
+
+
+# ---------------------------------------------------------------------------
+# memory: the schedule's activation stash is priced pre-compile
+# ---------------------------------------------------------------------------
+
+
+def _stack_model(schedule="gpipe", interleave=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8, 4, 16])
+        y = fluid.data("y", shape=[8, 4, 16])
+        stack = fluid.layers.PipelinedStack(
+            num_layers=8, num_microbatches=4,
+            schedule=schedule, interleave=interleave)
+        with stack.layer():
+            h = stack.input(x)
+            w = stack.layer_param([16, 16])
+            b = stack.layer_param([16], is_bias=True)
+            stack.output(fluid.layers.relu(fluid.layers.elementwise_add(
+                fluid.layers.matmul(h, w), b)))
+        out = stack()
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(out, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss, stack
+
+
+def test_memory_prices_schedule_stash():
+    from paddle_tpu.analysis.memory import estimate_peak_hbm
+    from paddle_tpu.analysis.sharding import analyze_sharding
+
+    fs = {"x": (8, 4, 16), "y": (8, 4, 16)}
+    peaks = {}
+    for kind, v in (("gpipe", None), ("1f1b", 2)):
+        main, _s, _l, _st = _stack_model(kind, v)
+        srep = analyze_sharding(main, make_mesh((4,), ("stage",)),
+                                feed_shapes=fs)
+        rep = estimate_peak_hbm(main, feed_shapes=fs, sharding_report=srep)
+        peaks[kind] = rep.peak_intermediate_bytes
+        # the pipeline_stack op's timeline point carries the stash:
+        # (L/s) chunks * full-X bytes / m per microbatch = 4096
+        row = next(b for i, t, b in rep.timeline if t == "pipeline_stack")
+        assert row >= 4096, (kind, row)
+    # same stash bytes under both schedules -> same priced peak
+    assert peaks["gpipe"] == peaks["1f1b"], peaks
+
+
+# ---------------------------------------------------------------------------
+# PIPELINE_EVIDENCE_r20 drift gates
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_evidence_r20_committed():
+    """The committed static half (schedule tables, bubbles, stash slots)
+    must be exactly what tools/pipeline_report.py re-derives."""
+    with open(os.path.join(REPO, "PIPELINE_EVIDENCE_r20.json")) as f:
+        committed = json.load(f)
+    fresh = _load_tool("pipeline_report").static_sections()
+    assert committed["static"] == fresh, (
+        "PIPELINE_EVIDENCE_r20.json static half drifted — regenerate "
+        "with `python tools/pipeline_report.py`")
+    # the committed live claims must all hold (pass flag is the tool's
+    # own gate; a committed failing report is a red build)
+    assert committed["pass"] is True
+    assert committed["training"]["gpipe_bit_identical"] is True
+    assert committed["training"]["1f1b_bit_identical"] is True
+    assert committed["hierarchy"]["claims"]["naive_exact_match"] is True
+    assert committed["hierarchy"]["claims"]["zero_linter_clean"] is True
+
+
+@pytest.mark.slow
+def test_pipeline_evidence_live_loss_streams():
+    """Live recompute of the training arms must reproduce the committed
+    float-hex loss streams bit-for-bit."""
+    with open(os.path.join(REPO, "PIPELINE_EVIDENCE_r20.json")) as f:
+        committed = json.load(f)
+    tool = _load_tool("pipeline_report")
+    fresh = tool.training_section()
+    for key in ("reference_loss_hex", "gpipe_loss_hex", "1f1b_loss_hex"):
+        assert fresh[key] == committed["training"][key], key
+    assert fresh["gpipe_bit_identical"] and fresh["1f1b_bit_identical"]
+
+
+# ---------------------------------------------------------------------------
+# live: 1f1b numerics + schedule-as-cache-content
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_1f1b_bit_identical_to_reference(rng):
+    """gpipe AND interleaved 1f1b on the 4-stage mesh reproduce the
+    single-device microbatched reference exactly (replicated feeds keep
+    the loss reduction unpartitioned)."""
+    from jax.sharding import PartitionSpec as P
+
+    feed = {"x": rng.randn(8, 4, 16).astype("float32"),
+            "y": rng.randn(8, 4, 16).astype("float32")}
+    exe = fluid.Executor(fluid.CPUPlace())
+    pvals = None
+    curves = {}
+    for arm, kind, v in (("ref", "gpipe", None), ("gpipe", "gpipe", None),
+                         ("1f1b", "1f1b", 2)):
+        main, startup, loss, stack = _stack_model(kind, v)
+        if pvals is None:
+            r = np.random.RandomState(11)
+            pvals = [r.randn(*p.shape).astype("float32") * 0.1
+                     for p in main.all_parameters()]
+        prog = main
+        if arm != "ref":
+            prog = fluid.CompiledProgram(main).with_parallel(
+                mesh=make_mesh((4,), ("stage",)), loss_name=loss.name,
+                input_specs={"x": P(), "y": P()},
+                param_specs=stack.param_spec_overrides(),
+            )
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for p, val in zip(main.all_parameters(), pvals):
+                scope.set(p.name, val)
+            curves[arm] = [
+                float(np.asarray(
+                    exe.run(prog, feed=feed, fetch_list=[loss])[0]
+                ).reshape(-1)[0])
+                for _ in range(3)
+            ]
+    assert curves["gpipe"] == curves["ref"], curves
+    assert curves["1f1b"] == curves["ref"], curves
+
+
+@pytest.mark.slow
+def test_schedule_flip_retraces_identical_config_hits(rng):
+    """pipeline_schedule joins the compile fingerprint: gpipe->1f1b on
+    the same Program retraces; rerunning 1f1b hits the memory tier."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    def jits():
+        return obs_metrics.registry().get("lowering_jit_total").value
+
+    feed = {"x": rng.randn(8, 4, 16).astype("float32"),
+            "y": rng.randn(8, 4, 16).astype("float32")}
+    main, startup, loss, stack = _stack_model("gpipe", None)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def run(schedule, interleave):
+        prog = fluid.CompiledProgram(main).with_parallel(
+            mesh=make_mesh((4,), ("stage",)), loss_name=loss.name,
+            input_specs={"x": P(), "y": P()},
+            param_specs=stack.param_spec_overrides(),
+            pipeline_schedule=schedule, pipeline_interleave=interleave,
+        )
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed=feed, fetch_list=[loss])
+
+    run("gpipe", None)
+    base = jits()
+    run("1f1b", 2)
+    assert jits() == base + 1, "schedule flip must retrace"
+    run("1f1b", 2)
+    assert jits() == base + 1, "identical schedule must hit the cache"
+
+
+def test_with_parallel_rejects_unknown_schedule():
+    main, _startup, loss, _stack = _stack_model()
+    with pytest.raises(EnforceError):
+        fluid.CompiledProgram(main).with_parallel(
+            mesh=make_mesh((4,), ("stage",)), loss_name=loss.name,
+            pipeline_schedule="zigzag",
+        )
+
+
+# ---------------------------------------------------------------------------
+# dygraph example: eager == to_static capture, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_recognize_digits_dygraph_capture_parity():
+    spec = importlib.util.spec_from_file_location(
+        "rd_dygraph",
+        os.path.join(REPO, "examples", "recognize_digits_dygraph.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    eager, captured = mod.main(steps=3, batch=16)
+    assert eager == captured
+    assert all(np.isfinite(v) for v in eager)

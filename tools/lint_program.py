@@ -411,6 +411,24 @@ def _cmd_cost(args):
             collective_kb=args.budget_collective_kb, min_mfu=args.min_mfu,
         )
         j = rep.to_json(ops_limit=16)
+        # show the 1F1B headroom next to each committed GPipe bubble —
+        # the number the pipeline runtime must beat (what-if only; the
+        # committed entry stays the program's own schedule). m > s has no
+        # contention-free interleaved window, so no what-if there.
+        from paddle_tpu.parallel.pipeline_runtime.schedule import (
+            predicted_bubble,
+        )
+
+        pipeline = []
+        for ent in j["pipeline"]:
+            ent = dict(ent)
+            s, m = ent["stages"], ent["num_microbatches"]
+            ent["bubble_1f1b_whatif"] = (
+                round(predicted_bubble("1f1b", s, m, 2), 6)
+                if s > 1 and m <= s else None
+            )
+            pipeline.append(ent)
+        j["pipeline"] = pipeline
         failures += _report(
             label, "cost", diags,
             extra={"machine": args.machine,

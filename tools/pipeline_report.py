@@ -1,0 +1,393 @@
+#!/usr/bin/env python
+"""PIPELINE_EVIDENCE_r20 generator: the pipeline runtime's claims, live.
+
+Round 20's subsystem (paddle_tpu/parallel/pipeline_runtime/) claims
+
+  1. schedule tables: compile_schedule emits collision-free slot tables
+     whose REALIZED bubble (walking the table the runtime executes)
+     matches the closed-form prediction, and interleaved 1F1B beats the
+     GPipe bubble 3/7 at 4 stages x 4 microbatches,
+  2. numerics: per-schedule training loss streams on a 4-stage mesh are
+     BIT-IDENTICAL to the single-device no-pipeline reference (replicated
+     feeds, microbatched fallback — same per-gemm shapes everywhere),
+  3. caching: the schedule is compile-cache content — flipping
+     gpipe<->1f1b on the same Program retraces, rerunning the identical
+     config hits the in-memory tier (observed via lowering_jit_total),
+  4. hierarchy: on a two-level DCN x ICI mesh the naive grad-sync
+     all-reduce crosses DCN at exactly the statically predicted payload
+     (replica-group parse of the optimized HLO), and the ZeRO-sharded
+     placement that the decomposed analyzer events describe strictly
+     reduces measured DCN-crossing bytes and silences the hierarchical
+     linter.
+
+tests/test_pipeline_runtime.py::test_pipeline_evidence_r20_committed
+re-derives the static half byte-for-byte; the slow live gate re-runs the
+training arms and compares the committed float-hex streams.
+
+Usage: python tools/pipeline_report.py [--out PIPELINE_EVIDENCE_r20.json]
+       python tools/pipeline_report.py --smoke   # static half only
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+EVIDENCE = "PIPELINE_EVIDENCE_r20.json"
+STAGES = 4
+MICROBATCHES = 4
+INTERLEAVE = 2
+LAYERS = 8
+TRAIN_STEPS = 4
+B, S, H = 8, 4, 16
+
+
+def static_sections():
+    """Schedule-table accounting — pure compile_schedule, no lowering.
+    The committed evidence's static half; the drift test recomputes this
+    byte-for-byte."""
+    from paddle_tpu.parallel.pipeline_runtime.schedule import (
+        compile_schedule,
+    )
+
+    schedules = {}
+    for kind, v in (("gpipe", None), ("1f1b", INTERLEAVE)):
+        sched = compile_schedule(kind, STAGES, MICROBATCHES, v)
+        tab = sched.to_table()
+        tab["fingerprint"] = sched.fingerprint()
+        schedules[kind] = tab
+    return {
+        "geometry": {"stages": STAGES, "microbatches": MICROBATCHES,
+                     "interleave": INTERLEAVE, "layers": LAYERS},
+        "schedules": schedules,
+        "claims": {
+            "gpipe_bubble": schedules["gpipe"]["realized_bubble"],
+            "1f1b_bubble": schedules["1f1b"]["realized_bubble"],
+            "1f1b_beats_gpipe": (schedules["1f1b"]["realized_bubble"]
+                                 < schedules["gpipe"]["realized_bubble"]),
+            "realized_matches_predicted": all(
+                t["realized_bubble"] == t["predicted_bubble"]
+                for t in schedules.values()),
+            # interleave buys bubble, NOT stash: same stash BYTES (slots
+            # scale by v, per-chunk layers shrink by v)
+            "stash_slots": {k: t["peak_stash_slots"]
+                            for k, t in schedules.items()},
+        },
+    }
+
+
+def _build_stack_model(schedule, interleave):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[B, S, H])
+        y = fluid.data("y", shape=[B, S, H])
+        stack = fluid.layers.PipelinedStack(
+            num_layers=LAYERS, num_microbatches=MICROBATCHES,
+            schedule=schedule, interleave=interleave,
+        )
+        with stack.layer():
+            h = stack.input(x)
+            w = stack.layer_param([H, H])
+            b = stack.layer_param([H], is_bias=True)
+            stack.output(fluid.layers.relu(fluid.layers.elementwise_add(
+                fluid.layers.matmul(h, w), b)))
+        out = stack()
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(out, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss, stack
+
+
+def _deterministic_params(main):
+    """Creation-order param values from a fixed seed — the streams must
+    reproduce across processes, so init never comes from the startup
+    RNG."""
+    import numpy as np
+
+    r = np.random.RandomState(7)
+    return [r.randn(*p.shape).astype("float32") * 0.1
+            for p in main.all_parameters()]
+
+
+def _train_arm(schedule, interleave, on_mesh, steps=TRAIN_STEPS):
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.env import make_mesh
+
+    main, startup, loss, stack = _build_stack_model(schedule, interleave)
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(B, S, H).astype("float32"),
+            "y": rng.randn(B, S, H).astype("float32")}
+    prog = main
+    if on_mesh:
+        mesh = make_mesh((STAGES,), ("stage",))
+        # replicated feeds: the loss mean must not be GSPMD-partitioned
+        # or the reduction order diverges from the reference by ulps
+        prog = fluid.CompiledProgram(main).with_parallel(
+            mesh=mesh, loss_name=loss.name,
+            input_specs={"x": P(), "y": P()},
+            param_specs=stack.param_spec_overrides(),
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for p, val in zip(main.all_parameters(), _deterministic_params(main)):
+            scope.set(p.name, val)
+        return [
+            float(np.asarray(
+                exe.run(prog, feed=feed, fetch_list=[loss.name])[0]
+            ).reshape(-1)[0])
+            for _ in range(steps)
+        ]
+
+
+def training_section():
+    """Live loss streams: ref (no mesh, microbatched fallback) vs gpipe
+    vs interleaved 1f1b on the 4-stage mesh — committed as float hex so
+    the gate is bitwise, not approximate."""
+    ref = _train_arm("gpipe", None, on_mesh=False)
+    gpipe = _train_arm("gpipe", None, on_mesh=True)
+    f1b = _train_arm("1f1b", INTERLEAVE, on_mesh=True)
+    return {
+        "mesh": {"shape": [STAGES], "axes": ["stage"]},
+        "steps": TRAIN_STEPS,
+        "reference_loss_hex": [v.hex() for v in ref],
+        "gpipe_loss_hex": [v.hex() for v in gpipe],
+        "1f1b_loss_hex": [v.hex() for v in f1b],
+        "reference_loss": ref,
+        "gpipe_bit_identical": gpipe == ref,
+        "1f1b_bit_identical": f1b == ref,
+    }
+
+
+def cache_section():
+    """Schedule is compile-cache content: flip retraces, repeat hits."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.parallel.env import make_mesh
+
+    def jit_total():
+        return obs_metrics.registry().get("lowering_jit_total").value
+
+    main, startup, loss, stack = _build_stack_model("gpipe", None)
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(B, S, H).astype("float32"),
+            "y": rng.randn(B, S, H).astype("float32")}
+    exe = fluid.Executor(fluid.CPUPlace())
+    mesh = make_mesh((STAGES,), ("stage",))
+
+    def run(schedule, interleave):
+        prog = fluid.CompiledProgram(main).with_parallel(
+            mesh=mesh, loss_name=loss.name,
+            input_specs={"x": P(), "y": P()},
+            param_specs=stack.param_spec_overrides(),
+            pipeline_schedule=schedule, pipeline_interleave=interleave,
+        )
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(prog, feed=feed, fetch_list=[loss.name])
+
+    base = jit_total()
+    run("gpipe", None)
+    after_first = jit_total()
+    run("1f1b", INTERLEAVE)
+    after_flip = jit_total()
+    run("1f1b", INTERLEAVE)
+    after_repeat = jit_total()
+    return {
+        "jit_compiles": {"first": after_first - base,
+                         "flip_to_1f1b": after_flip - after_first,
+                         "repeat_1f1b": after_repeat - after_flip},
+        "flip_retraces": after_flip > after_first,
+        "repeat_hits_memory_tier": after_repeat == after_flip,
+    }
+
+
+def _build_mlp():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[-1, 16])
+        y = fluid.data("y", shape=[-1, 16])
+        h = fluid.layers.fc(x, size=32, act="relu", name="mlp.fc1")
+        p = fluid.layers.fc(h, size=16, name="mlp.fc2")
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(p, y)))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def hierarchy_section():
+    """Two-level DCN x ICI mesh, two arms: naive (replicated params, one
+    flat grad-sync all-reduce spanning both tiers) and zero (params
+    ZeRO-sharded over the ICI data axis, analyzer emits reduce-scatter
+    over ICI + all-reduce of the shard over DCN). Gates: the naive arm's
+    measured DCN-crossing HLO bytes equal the static prediction EXACTLY;
+    the zero arm strictly reduces measured crossing bytes and the
+    hierarchical linter is silent on its decomposed events."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.analysis.cost import (
+        analyze_cost,
+        hierarchical_collective_diagnostics,
+    )
+    from paddle_tpu.analysis.sharding import analyze_sharding
+    from paddle_tpu.parallel.env import make_mesh
+    from paddle_tpu.parallel.pipeline_runtime.hierarchy import (
+        dcn_crossing_collective_bytes,
+    )
+    from paddle_tpu.utils.hlo import lower_parallel_step
+
+    mesh_shape, axes = (2, 4), ("dcn", "data")
+    tags = {"dcn": "dcn", "data": "ici"}
+    ispec = {"x": P(("dcn", "data")), "y": P(("dcn", "data"))}
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 16).astype("float32"),
+            "y": rng.randn(16, 16).astype("float32")}
+    fs = {k: v.shape for k, v in feed.items()}
+
+    out = {"mesh": {"shape": list(mesh_shape), "axes": list(axes)},
+           "axis_tags": tags}
+    for arm in ("naive", "zero"):
+        main, startup, loss = _build_mlp()
+        pspecs = None
+        if arm == "zero":
+            pspecs = {p.name: P("data") for p in main.all_parameters()}
+        srep = analyze_sharding(
+            main, make_mesh(mesh_shape, axes), param_specs=pspecs,
+            input_specs=ispec, feed_shapes=fs)
+        gs = [e for e in srep.events if e.cause == "grad-sync"]
+        predicted_crossing = sum(e.bytes for e in gs if "dcn" in e.axes)
+        crep = analyze_cost(
+            main, mesh=make_mesh(mesh_shape, axes), axis_tags=tags,
+            param_specs=pspecs, input_specs=ispec, feed_shapes=fs,
+            fetch_names=[loss.name])
+        linter = hierarchical_collective_diagnostics(crep)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main).with_parallel(
+                mesh=make_mesh(mesh_shape, axes), loss_name=loss.name,
+                param_specs=pspecs, input_specs=ispec)
+            lowered, _mesh = lower_parallel_step(
+                exe, prog, feed, [loss.name], scope)
+        rep = dcn_crossing_collective_bytes(
+            lowered.compile().as_text(), mesh_shape, axes, tags)
+        out[arm] = {
+            "grad_sync_events": [
+                {"kind": e.kind, "var": e.var, "bytes": e.bytes,
+                 "axes": sorted(e.axes)} for e in gs],
+            "predicted_dcn_crossing_bytes": predicted_crossing,
+            "measured_dcn_crossing_bytes": rep["crossing_bytes"],
+            "measured_dcn_local_bytes": rep["local_bytes"],
+            "linter_fired": len(linter),
+            "linter_codes": sorted({d.code for d in linter}),
+            "collectives": rep["collectives"],
+        }
+    naive, zero = out["naive"], out["zero"]
+    out["claims"] = {
+        "naive_exact_match": (naive["predicted_dcn_crossing_bytes"]
+                              == naive["measured_dcn_crossing_bytes"]),
+        "naive_linter_fired": naive["linter_fired"] > 0,
+        "zero_linter_clean": zero["linter_fired"] == 0,
+        "zero_reduces_crossing": (zero["measured_dcn_crossing_bytes"]
+                                  < naive["measured_dcn_crossing_bytes"]),
+        "measured_dcn_saving_bytes": (
+            naive["measured_dcn_crossing_bytes"]
+            - zero["measured_dcn_crossing_bytes"]),
+    }
+    return out
+
+
+def build_report(smoke=False):
+    report = {
+        "generated_by": "tools/pipeline_report.py",
+        "static": static_sections(),
+    }
+    if smoke:
+        return report
+    report["training"] = training_section()
+    report["cache"] = cache_section()
+    report["hierarchy"] = hierarchy_section()
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=EVIDENCE)
+    ap.add_argument("--smoke", action="store_true",
+                    help="static half only, compare against committed")
+    args = ap.parse_args(argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if args.smoke:
+        path = os.path.join(repo, EVIDENCE)
+        with open(path) as f:
+            committed = json.load(f)
+        fresh = static_sections()
+        if committed["static"] != fresh:
+            print("pipeline evidence DRIFT: static half != committed — "
+                  "regenerate with tools/pipeline_report.py")
+            return 1
+        print("pipeline evidence static half matches committed")
+        return 0
+
+    report = build_report()
+    failures = []
+    st = report["static"]["claims"]
+    if not st["1f1b_beats_gpipe"]:
+        failures.append("1f1b bubble does not beat gpipe")
+    if not st["realized_matches_predicted"]:
+        failures.append("realized bubble != closed-form prediction")
+    tr = report["training"]
+    if not (tr["gpipe_bit_identical"] and tr["1f1b_bit_identical"]):
+        failures.append("loss streams not bit-identical to reference")
+    ca = report["cache"]
+    if not (ca["flip_retraces"] and ca["repeat_hits_memory_tier"]):
+        failures.append("schedule flip/hit cache behavior wrong")
+    hi = report["hierarchy"]["claims"]
+    for k in ("naive_exact_match", "naive_linter_fired",
+              "zero_linter_clean", "zero_reduces_crossing"):
+        if not hi[k]:
+            failures.append(f"hierarchy claim failed: {k}")
+    report["pass"] = not failures
+    report["failures"] = failures
+
+    out_path = args.out if os.path.isabs(args.out) \
+        else os.path.join(repo, args.out)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out_path}: pass={report['pass']} "
+          f"bubbles gpipe={st['gpipe_bubble']} 1f1b={st['1f1b_bubble']} "
+          f"dcn saving={hi['measured_dcn_saving_bytes']}B")
+    for msg in failures:
+        print("FAIL:", msg)
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
